@@ -1,0 +1,20 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (SURVEY.md §7 / driver contract):
+multi-chip sharding semantics are validated without TPU hardware, the same
+way Trino's DistributedQueryRunner boots a multi-node cluster inside one JVM
+(testing/trino-testing/.../DistributedQueryRunner.java:107).
+
+Environment must be set before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,49 @@
+"""Unit tests for the columnar Batch/Column data model (Trino Page/Block
+analog; reference tests: core/trino-spi/src/test/.../TestPage.java)."""
+
+import numpy as np
+
+from trino_tpu.batch import (Batch, Field, Schema, batch_from_numpy,
+                             batch_to_numpy, decode_column, pad_capacity)
+from trino_tpu.types import BIGINT, VARCHAR, decimal
+
+
+def test_pad_capacity_buckets():
+    assert pad_capacity(1) == 1024
+    assert pad_capacity(1024) == 1024
+    assert pad_capacity(1025) == 2048
+
+
+def test_roundtrip_with_padding():
+    a = np.arange(10, dtype=np.int64)
+    b = np.array([1.5, 2.5] * 5, dtype=np.float32)
+    batch = batch_from_numpy([a, b])
+    assert batch.capacity == 1024
+    assert int(batch.live.sum()) == 10
+    arrays, valids = batch_to_numpy(batch)
+    np.testing.assert_array_equal(arrays[0], a)
+    np.testing.assert_allclose(arrays[1], b)
+    assert valids[0].all()
+
+
+def test_null_mask_roundtrip():
+    a = np.arange(4, dtype=np.int64)
+    valid = np.array([True, False, True, False])
+    batch = batch_from_numpy([a], valids=[valid])
+    arrays, valids = batch_to_numpy(batch)
+    np.testing.assert_array_equal(valids[0], valid)
+
+
+def test_schema_lookup_and_decode():
+    schema = Schema.of(
+        Field("k", BIGINT),
+        Field("s", VARCHAR, dictionary=("apple", "banana")),
+        Field("d", decimal(12, 2)),
+    )
+    assert schema.index_of("s") == 1
+    vals = decode_column(schema.field("s"),
+                         np.array([1, 0]), np.array([True, True]))
+    assert vals == ["banana", "apple"]
+    dec = decode_column(schema.field("d"),
+                        np.array([12345, -50]), np.array([True, False]))
+    assert dec == [123.45, None]

@@ -1,0 +1,175 @@
+"""Expression evaluation tests — the PageProcessor-equivalent layer.
+
+Reference tests: core/trino-main/src/test/.../operator/project/ and
+QueryAssertions expression assertions (SURVEY.md §4.1)."""
+
+import numpy as np
+import pytest
+
+from trino_tpu import ir
+from trino_tpu.batch import batch_from_numpy
+from trino_tpu.ops.project import (apply_filter, civil_from_days, eval_expr,
+                                   filter_project, rescale)
+from trino_tpu.types import BIGINT, BOOLEAN, DATE, DOUBLE, decimal
+
+
+def make_batch():
+    a = np.array([1, 2, 3, 4], dtype=np.int64)
+    b = np.array([10, 20, 30, 40], dtype=np.int64)
+    return batch_from_numpy([a, b], pad_multiple=4)
+
+
+def col(i, dtype=BIGINT, name=""):
+    return ir.ColumnRef(i, dtype, name)
+
+
+def lit(v, dtype=BIGINT):
+    return ir.Literal(v, dtype)
+
+
+def evaluate(expr, batch, n=4):
+    d, v = eval_expr(expr, batch)
+    return np.asarray(d)[:n], np.asarray(v)[:n]
+
+
+def test_arith_and_compare():
+    batch = make_batch()
+    d, v = evaluate(ir.arith('+', col(0), col(1)), batch)
+    np.testing.assert_array_equal(d, [11, 22, 33, 44])
+    assert v.all()
+    d, v = evaluate(ir.Compare('>', col(1), lit(20)), batch)
+    np.testing.assert_array_equal(d, [False, False, True, True])
+
+
+def test_decimal_arith_scales():
+    # 1.50 * 0.10 -> scale 4; 1.50 + 0.1 (scale1) -> scale 2
+    a = np.array([150, 250], dtype=np.int64)   # decimal(12,2)
+    batch = batch_from_numpy([a], pad_multiple=2)
+    c = col(0, decimal(12, 2))
+    prod = ir.arith('*', c, ir.Literal(10, decimal(2, 2)))  # 0.10
+    assert prod.dtype.scale == 4
+    d, _ = evaluate(prod, batch, n=2)
+    np.testing.assert_array_equal(d, [1500, 2500])  # 0.1500, 0.2500
+
+    s = ir.arith('+', c, ir.Literal(1, decimal(2, 1)))  # 0.1
+    assert s.dtype.scale == 2
+    d, _ = evaluate(s, batch, n=2)
+    np.testing.assert_array_equal(d, [160, 260])
+
+
+def test_rescale_half_up():
+    import jax.numpy as jnp
+    x = jnp.array([125, 135, -125, -135], dtype=jnp.int64)
+    out = np.asarray(rescale(x, 2, 1))
+    np.testing.assert_array_equal(out, [13, 14, -13, -14])
+
+
+def test_kleene_and_with_nulls():
+    a = np.array([1, 1, 0, 0], dtype=np.bool_)
+    valid = np.array([True, False, True, False])
+    batch = batch_from_numpy([a, a], valids=[valid, None], pad_multiple=4)
+    e = ir.Logical('and', (col(0, BOOLEAN), col(1, BOOLEAN)))
+    d, v = evaluate(e, batch)
+    # row0: T and T = T; row1: NULL and T = NULL; row2: F and F = F;
+    # row3: NULL and F = F (false dominates)
+    np.testing.assert_array_equal(v, [True, False, True, True])
+    np.testing.assert_array_equal(d & v, [True, False, False, False])
+
+
+def test_filter_nulls_excluded():
+    a = np.array([5, 6, 7, 8], dtype=np.int64)
+    valid = np.array([True, True, False, True])
+    batch = batch_from_numpy([a], valids=[valid], pad_multiple=4)
+    out = apply_filter(batch, ir.Compare('>', col(0), lit(5)))
+    np.testing.assert_array_equal(np.asarray(out.live)[:4],
+                                  [False, True, False, True])
+
+
+def test_between_and_in():
+    batch = make_batch()
+    d, _ = evaluate(ir.Between(col(0), lit(2), lit(3)), batch)
+    np.testing.assert_array_equal(d, [False, True, True, False])
+    d, _ = evaluate(ir.InList(col(0), (lit(1), lit(4))), batch)
+    np.testing.assert_array_equal(d, [True, False, False, True])
+
+
+def test_case_first_match_wins():
+    batch = make_batch()
+    e = ir.Case(
+        whens=(
+            (ir.Compare('<', col(0), lit(3)), lit(100)),
+            (ir.Compare('<', col(0), lit(4)), lit(200)),
+        ),
+        default=lit(300), dtype=BIGINT)
+    d, _ = evaluate(e, batch)
+    np.testing.assert_array_equal(d, [100, 100, 200, 300])
+
+
+def test_civil_from_days():
+    import jax.numpy as jnp
+    import datetime
+    days = []
+    expect = []
+    for s in ["1970-01-01", "1992-02-29", "1998-12-01", "2000-03-01",
+              "1995-01-27", "1900-01-01"]:
+        dt = datetime.date.fromisoformat(s)
+        days.append((dt - datetime.date(1970, 1, 1)).days)
+        expect.append((dt.year, dt.month, dt.day))
+    y, m, d = civil_from_days(jnp.asarray(days, dtype=jnp.int32))
+    for i, (ey, em, ed) in enumerate(expect):
+        assert (int(y[i]), int(m[i]), int(d[i])) == (ey, em, ed)
+
+
+def test_dict_predicate():
+    codes = np.array([0, 1, 2, 1], dtype=np.int32)
+    batch = batch_from_numpy([codes], pad_multiple=4)
+    from trino_tpu.types import VARCHAR
+    e = ir.DictPredicate(col(0, VARCHAR), (False, True, False))
+    d, _ = evaluate(e, batch)
+    np.testing.assert_array_equal(d, [False, True, False, True])
+
+
+def test_filter_project_jit_caches():
+    batch = make_batch()
+    f = ir.Compare('>=', col(0), lit(2))
+    p = (ir.arith('*', col(0), col(1)),)
+    out = filter_project(batch, f, p)
+    live = np.asarray(out.live)[:4]
+    np.testing.assert_array_equal(live, [False, True, True, True])
+    np.testing.assert_array_equal(np.asarray(out.columns[0].data)[:4],
+                                  [10, 40, 90, 160])
+
+
+def test_integer_division_truncates_toward_zero():
+    a = np.array([-7, 7, -7, 7], dtype=np.int64)
+    b = np.array([2, -2, -2, 2], dtype=np.int64)
+    batch = batch_from_numpy([a, b], pad_multiple=4)
+    d, v = evaluate(ir.arith('/', col(0), col(1)), batch)
+    np.testing.assert_array_equal(d, [-3, -3, 3, 3])
+    assert v.all()
+
+
+def test_division_by_zero_is_null():
+    a = np.array([7, 7, 7, 7], dtype=np.int64)
+    b = np.array([0, 2, 0, 1], dtype=np.int64)
+    batch = batch_from_numpy([a, b], pad_multiple=4)
+    d, v = evaluate(ir.arith('/', col(0), col(1)), batch)
+    np.testing.assert_array_equal(v, [False, True, False, True])
+
+
+def test_between_kleene_false_dominates_null():
+    # 5 BETWEEN 10 AND NULL -> FALSE (not NULL)
+    a = np.array([5], dtype=np.int64)
+    batch = batch_from_numpy([a], pad_multiple=1)
+    e = ir.Between(col(0), lit(10), ir.Literal(None, BIGINT))
+    d, v = evaluate(e, batch, n=1)
+    assert v[0] and not d[0]
+
+
+def test_cast_double_to_decimal_half_up():
+    import jax.numpy as jnp
+    a = np.array([2.5, -2.5, 2.4], dtype=np.float32)
+    batch = batch_from_numpy([a], pad_multiple=4)
+    e = ir.Cast(col(0, DOUBLE), decimal(4, 0))
+    d, _ = evaluate(e, batch, n=3)
+    np.testing.assert_array_equal(d, [3, -3, 2])

@@ -1,0 +1,20 @@
+"""trino_tpu — a TPU-native distributed SQL query engine.
+
+A brand-new framework with the capabilities of Trino (reference:
+/root/reference, see SURVEY.md): SQL text in, cost-based planning into
+fragmented distributed plans, and a columnar operator pipeline executed as
+JAX/XLA programs sharded over a TPU mesh.
+
+Where Trino generates JVM bytecode per query (sql/gen/ExpressionCompiler.java:38),
+we trace per-stage array programs and let XLA fuse them; where Trino shuffles
+serialized pages over HTTP (operator/HttpPageBufferClient.java:355), we use
+lax.all_to_all / psum collectives over ICI inside jitted stage programs.
+"""
+
+import jax
+
+# SQL semantics need 64-bit integers (BIGINT, scaled DECIMAL arithmetic).
+# This must run before any array is created anywhere in the package.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

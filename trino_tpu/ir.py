@@ -1,0 +1,188 @@
+"""Engine-internal typed expression IR.
+
+Reference: Trino lowers analyzed AST expressions to its own IR (sql/ir/, 29
+files: Call, Constant, Comparison, Logical, ...) which the bytecode compilers
+consume (sql/gen/ExpressionCompiler.java:38). Ours is the input to the JAX
+tracer in ops/project.py — jit + XLA fusion replaces bytecode generation.
+
+Every node is typed (``dtype``). The analyzer (planner/analyzer.py) produces
+only well-typed trees; the compiler assumes them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from .types import (BIGINT, BOOLEAN, DATE, DOUBLE, DataType, TypeKind,
+                    common_super_type, decimal)
+
+
+class Expr:
+    dtype: DataType
+
+
+@dataclass(frozen=True)
+class ColumnRef(Expr):
+    index: int          # position in the input batch
+    dtype: DataType
+    name: str = ""      # for debugging / explain
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: object       # python int/float/bool/str/None; DECIMAL as scaled int
+    dtype: DataType
+
+
+@dataclass(frozen=True)
+class Arith(Expr):
+    """+ - * / following Trino's decimal scale rules
+    (spi/type/DecimalOperators semantics for short decimals):
+    add/sub -> max scale; mul -> s1+s2; div -> lowered to DOUBLE."""
+    op: str             # '+', '-', '*', '/'
+    left: Expr
+    right: Expr
+    dtype: DataType
+
+
+@dataclass(frozen=True)
+class Negate(Expr):
+    arg: Expr
+    dtype: DataType
+
+
+@dataclass(frozen=True)
+class Compare(Expr):
+    op: str             # '=', '<>', '<', '<=', '>', '>='
+    left: Expr
+    right: Expr
+    dtype: DataType = BOOLEAN
+
+
+@dataclass(frozen=True)
+class Logical(Expr):
+    """AND/OR with Kleene three-valued logic (Trino sql/ir/Logical.java)."""
+    op: str             # 'and', 'or'
+    args: tuple         # tuple[Expr, ...]
+    dtype: DataType = BOOLEAN
+
+
+@dataclass(frozen=True)
+class Not(Expr):
+    arg: Expr
+    dtype: DataType = BOOLEAN
+
+
+@dataclass(frozen=True)
+class IsNull(Expr):
+    arg: Expr
+    negated: bool = False
+    dtype: DataType = BOOLEAN
+
+
+@dataclass(frozen=True)
+class InList(Expr):
+    arg: Expr
+    values: tuple       # tuple[Literal, ...] coerced to arg's physical rep
+    dtype: DataType = BOOLEAN
+
+
+@dataclass(frozen=True)
+class Between(Expr):
+    arg: Expr
+    low: Expr
+    high: Expr
+    dtype: DataType = BOOLEAN
+
+
+@dataclass(frozen=True)
+class Case(Expr):
+    """Searched CASE. whens = ((cond, value), ...)."""
+    whens: tuple
+    default: Optional[Expr]
+    dtype: DataType
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    arg: Expr
+    dtype: DataType
+
+
+@dataclass(frozen=True)
+class DictPredicate(Expr):
+    """Boolean predicate over a dictionary-encoded VARCHAR column, evaluated
+    host-side over the string pool into a code->bool lookup table at plan
+    time (LIKE, =, IN on strings). Device work is a single gather.
+
+    This is the TPU answer to Trino's LikeMatcher DFA (likematcher/) and
+    dictionary-aware processing in PageProcessor (SURVEY.md §7 strings)."""
+    arg: Expr           # must be a VARCHAR ColumnRef
+    lut: tuple          # tuple[bool, ...], len == dictionary size
+    dtype: DataType = BOOLEAN
+
+
+@dataclass(frozen=True)
+class ExtractField(Expr):
+    """EXTRACT(YEAR/MONTH/DAY FROM date_expr) — computes civil fields from
+    epoch days on device."""
+    part: str           # 'year', 'month', 'day'
+    arg: Expr
+    dtype: DataType = BIGINT
+
+
+# --------------------------------------------------------------------------
+# Constructors with type inference (used by the analyzer)
+# --------------------------------------------------------------------------
+
+def arith(op: str, left: Expr, right: Expr) -> Expr:
+    lt, rt = left.dtype, right.dtype
+    if op == '/':
+        # Trino returns DECIMAL with complex scale rules; we lower division
+        # to DOUBLE (documented deviation; exact where it matters — avg —
+        # is handled by aggregate finalizers).
+        if TypeKind.DOUBLE in (lt.kind, rt.kind) or \
+           TypeKind.DECIMAL in (lt.kind, rt.kind):
+            return Arith(op, left, right, DOUBLE)
+        return Arith(op, left, right, common_super_type(lt, rt))
+    if op == '*' and lt.kind is TypeKind.DECIMAL and rt.kind is TypeKind.DECIMAL:
+        out = decimal(min(18, lt.precision + rt.precision), lt.scale + rt.scale)
+        return Arith(op, left, right, out)
+    if {lt.kind, rt.kind} == {TypeKind.DATE} and op == '-':
+        return Arith(op, left, right, BIGINT)  # date difference in days
+    return Arith(op, left, right, common_super_type(lt, rt))
+
+
+def comparable(left: Expr, right: Expr) -> tuple:
+    """Common comparison type for two sides (analyzer inserts Casts)."""
+    return common_super_type(left.dtype, right.dtype)
+
+
+def walk(expr: Expr):
+    """Yield every node in the tree (pre-order)."""
+    yield expr
+    children = ()
+    if isinstance(expr, Arith):
+        children = (expr.left, expr.right)
+    elif isinstance(expr, (Negate, Not, Cast, ExtractField, DictPredicate)):
+        children = (expr.arg,)
+    elif isinstance(expr, IsNull):
+        children = (expr.arg,)
+    elif isinstance(expr, Compare):
+        children = (expr.left, expr.right)
+    elif isinstance(expr, Logical):
+        children = expr.args
+    elif isinstance(expr, InList):
+        children = (expr.arg,)
+    elif isinstance(expr, Between):
+        children = (expr.arg, expr.low, expr.high)
+    elif isinstance(expr, Case):
+        children = tuple(c for w in expr.whens for c in w) + \
+            ((expr.default,) if expr.default is not None else ())
+    for c in children:
+        yield from walk(c)
+
+
+def referenced_columns(expr: Expr) -> set:
+    return {n.index for n in walk(expr) if isinstance(n, ColumnRef)}

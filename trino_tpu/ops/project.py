@@ -1,0 +1,306 @@
+"""Expression evaluation: IR -> traced JAX ops (filter + project).
+
+This is the replacement for Trino's runtime bytecode generation tier:
+ExpressionCompiler/PageFunctionCompiler emit a per-query PageProcessor class
+(sql/gen/ExpressionCompiler.java:38, sql/gen/PageFunctionCompiler.java:103,
+operator/project/PageProcessor.java:56); we trace the expression tree into
+the enclosing jitted stage program and let XLA fuse the elementwise chain
+into the surrounding matmuls/reductions — codegen for free.
+
+Every expression evaluates to ``(data, valid)`` with SQL three-valued logic:
+- arithmetic/comparison: result valid = all inputs valid
+- AND/OR: Kleene logic (Trino sql/ir/Logical.java semantics)
+- filters treat NULL as false (WHERE semantics)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .. import ir
+from ..batch import Batch, Column
+from ..types import TypeKind
+
+# --------------------------------------------------------------------------
+# decimal rescaling (Trino HALF_UP semantics, DecimalConversions.java)
+# --------------------------------------------------------------------------
+
+
+def rescale(data: jax.Array, from_scale: int, to_scale: int) -> jax.Array:
+    if to_scale == from_scale:
+        return data
+    if to_scale > from_scale:
+        return data * (10 ** (to_scale - from_scale))
+    d = 10 ** (from_scale - to_scale)
+    half = d // 2
+    # round half away from zero, like Trino's HALF_UP
+    pos = (data + half) // d
+    neg = -((-data + half) // d)
+    return jnp.where(data >= 0, pos, neg)
+
+
+def _to_comparable(expr: ir.Expr, data: jax.Array, target) -> jax.Array:
+    """Rescale/convert one comparison operand to the common type."""
+    t = expr.dtype
+    if target.kind is TypeKind.DECIMAL:
+        if t.kind is TypeKind.DECIMAL:
+            return rescale(data, t.scale, target.scale)
+        return data.astype(jnp.int64) * (10 ** target.scale)
+    if target.kind is TypeKind.DOUBLE:
+        if t.kind is TypeKind.DECIMAL:
+            return data.astype(jnp.float32) / (10 ** t.scale)
+        return data.astype(jnp.float32)
+    return data
+
+
+# --------------------------------------------------------------------------
+# date decomposition (days since epoch -> civil), Hinnant's algorithm —
+# branch-free integer math, vectorizes cleanly on TPU
+# --------------------------------------------------------------------------
+
+
+def civil_from_days(days: jax.Array):
+    z = days.astype(jnp.int64) + 719468
+    # floor division is already era-correct for negative z (the C++ original
+    # adjusts by -146096 only because C++ division truncates)
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    year = jnp.where(m <= 2, y + 1, y)
+    return year, m, d
+
+
+# --------------------------------------------------------------------------
+# evaluator
+# --------------------------------------------------------------------------
+
+
+def eval_expr(expr: ir.Expr, batch: Batch):
+    """Evaluate an IR expression over a batch. Returns (data, valid)."""
+    n = batch.capacity
+
+    if isinstance(expr, ir.ColumnRef):
+        col = batch.columns[expr.index]
+        return col.data, col.valid
+
+    if isinstance(expr, ir.Literal):
+        if expr.value is None:
+            z = jnp.zeros(n, dtype=expr.dtype.np_dtype)
+            return z, jnp.zeros(n, dtype=jnp.bool_)
+        v = jnp.full(n, expr.value, dtype=expr.dtype.np_dtype)
+        return v, jnp.ones(n, dtype=jnp.bool_)
+
+    if isinstance(expr, ir.Arith):
+        ld, lv = eval_expr(expr.left, batch)
+        rd, rv = eval_expr(expr.right, batch)
+        valid = lv & rv
+        out = expr.dtype
+        lt, rt = expr.left.dtype, expr.right.dtype
+        if out.kind is TypeKind.DECIMAL:
+            if expr.op == '*':
+                res = ld.astype(jnp.int64) * rd.astype(jnp.int64)
+            else:
+                l = rescale(ld, lt.scale, out.scale) if lt.kind is TypeKind.DECIMAL \
+                    else ld.astype(jnp.int64) * (10 ** out.scale)
+                r = rescale(rd, rt.scale, out.scale) if rt.kind is TypeKind.DECIMAL \
+                    else rd.astype(jnp.int64) * (10 ** out.scale)
+                res = l + r if expr.op == '+' else l - r
+            return res, valid
+        if out.kind is TypeKind.DOUBLE:
+            l = _to_comparable(expr.left, ld, out)
+            r = _to_comparable(expr.right, rd, out)
+            if expr.op == '+':
+                res = l + r
+            elif expr.op == '-':
+                res = l - r
+            elif expr.op == '*':
+                res = l * r
+            else:
+                # division by zero yields NULL (documented deviation: Trino
+                # raises DIVISION_BY_ZERO; a vectorized engine can't raise
+                # per-row, so we degrade to NULL rather than emit a bogus
+                # value marked valid)
+                res = l / jnp.where(r == 0, jnp.float32(1), r)
+                valid = valid & (r != 0)
+            return res, valid
+        # integer-like (BIGINT/INTEGER/DATE)
+        l = ld.astype(out.np_dtype)
+        r = rd.astype(out.np_dtype)
+        if expr.op == '+':
+            res = l + r
+        elif expr.op == '-':
+            res = l - r
+        elif expr.op == '*':
+            res = l * r
+        else:
+            # SQL integer division truncates toward zero; // floors.
+            safe_r = jnp.where(r == 0, jnp.ones_like(r), r)
+            q = l // safe_r
+            rem = l - q * safe_r
+            q = q + jnp.where((rem != 0) & ((l < 0) != (r < 0)), 1, 0
+                              ).astype(q.dtype)
+            res = q
+            valid = valid & (r != 0)  # NULL on div-by-zero (see above)
+        return res, valid
+
+    if isinstance(expr, ir.Negate):
+        d, v = eval_expr(expr.arg, batch)
+        return -d, v
+
+    if isinstance(expr, ir.Compare):
+        target = ir.comparable(expr.left, expr.right)
+        ld, lv = eval_expr(expr.left, batch)
+        rd, rv = eval_expr(expr.right, batch)
+        l = _to_comparable(expr.left, ld, target)
+        r = _to_comparable(expr.right, rd, target)
+        op = expr.op
+        if op == '=':
+            res = l == r
+        elif op == '<>':
+            res = l != r
+        elif op == '<':
+            res = l < r
+        elif op == '<=':
+            res = l <= r
+        elif op == '>':
+            res = l > r
+        else:
+            res = l >= r
+        return res, lv & rv
+
+    if isinstance(expr, ir.Logical):
+        parts = [eval_expr(a, batch) for a in expr.args]
+        d, v = parts[0]
+        for (d2, v2) in parts[1:]:
+            if expr.op == 'and':
+                # Kleene AND: false dominates null
+                out_v = (v & v2) | (v & ~d) | (v2 & ~d2)
+                d = d & d2
+            else:
+                out_v = (v & v2) | (v & d) | (v2 & d2)
+                d = d | d2
+            v = out_v
+        return d, v
+
+    if isinstance(expr, ir.Not):
+        d, v = eval_expr(expr.arg, batch)
+        return ~d, v
+
+    if isinstance(expr, ir.IsNull):
+        d, v = eval_expr(expr.arg, batch)
+        res = v if expr.negated else ~v
+        return res, jnp.ones_like(v)
+
+    if isinstance(expr, ir.InList):
+        d, v = eval_expr(expr.arg, batch)
+        res = jnp.zeros_like(v)
+        for lit in expr.values:
+            res = res | (d == jnp.asarray(lit.value, dtype=d.dtype))
+        return res, v
+
+    if isinstance(expr, ir.Between):
+        # x BETWEEN lo AND hi == (x >= lo) AND (x <= hi) with Kleene AND
+        # (Trino rewrites the same way), so a definite FALSE on one side
+        # dominates a NULL on the other.
+        lowered = ir.Logical('and', (
+            ir.Compare('>=', expr.arg, expr.low),
+            ir.Compare('<=', expr.arg, expr.high),
+        ))
+        return eval_expr(lowered, batch)
+
+    if isinstance(expr, ir.Case):
+        default = expr.default
+        if default is not None:
+            acc_d, acc_v = eval_expr(default, batch)
+            acc_d = acc_d.astype(expr.dtype.np_dtype)
+        else:
+            acc_d = jnp.zeros(n, dtype=expr.dtype.np_dtype)
+            acc_v = jnp.zeros(n, dtype=jnp.bool_)
+        # reverse order: first matching WHEN wins
+        for cond, val in reversed(expr.whens):
+            cd, cv = eval_expr(cond, batch)
+            vd, vv = eval_expr(val, batch)
+            take = cd & cv
+            acc_d = jnp.where(take, vd.astype(expr.dtype.np_dtype), acc_d)
+            acc_v = jnp.where(take, vv, acc_v)
+        return acc_d, acc_v
+
+    if isinstance(expr, ir.Cast):
+        d, v = eval_expr(expr.arg, batch)
+        src, dst = expr.arg.dtype, expr.dtype
+        if src == dst:
+            return d, v
+        if dst.kind is TypeKind.DECIMAL:
+            if src.kind is TypeKind.DECIMAL:
+                return rescale(d, src.scale, dst.scale), v
+            if src.kind is TypeKind.DOUBLE:
+                # HALF_UP (away from zero), matching rescale(); jnp.round is
+                # half-to-even and would disagree at *.5
+                xs = d.astype(jnp.float32) * (10 ** dst.scale)
+                half_up = jnp.where(xs >= 0, jnp.floor(xs + 0.5),
+                                    jnp.ceil(xs - 0.5))
+                return half_up.astype(jnp.int64), v
+            return d.astype(jnp.int64) * (10 ** dst.scale), v
+        if dst.kind is TypeKind.DOUBLE:
+            if src.kind is TypeKind.DECIMAL:
+                return d.astype(jnp.float32) / (10 ** src.scale), v
+            return d.astype(jnp.float32), v
+        if dst.kind in (TypeKind.BIGINT, TypeKind.INTEGER):
+            if src.kind is TypeKind.DECIMAL:
+                return rescale(d, src.scale, 0).astype(dst.np_dtype), v
+            return d.astype(dst.np_dtype), v
+        if dst.kind is TypeKind.DATE:
+            return d.astype(jnp.int32), v
+        raise NotImplementedError(f"cast {src} -> {dst}")
+
+    if isinstance(expr, ir.DictPredicate):
+        d, v = eval_expr(expr.arg, batch)
+        lut = jnp.asarray(expr.lut, dtype=jnp.bool_)
+        codes = jnp.clip(d.astype(jnp.int32), 0, len(expr.lut) - 1)
+        return lut[codes], v
+
+    if isinstance(expr, ir.ExtractField):
+        d, v = eval_expr(expr.arg, batch)
+        year, month, day = civil_from_days(d)
+        res = {'year': year, 'month': month, 'day': day}[expr.part]
+        return res.astype(jnp.int64), v
+
+    raise NotImplementedError(f"eval of {type(expr).__name__}")
+
+
+def filter_mask(expr: ir.Expr, batch: Batch) -> jax.Array:
+    """WHERE semantics: NULL -> excluded."""
+    d, v = eval_expr(expr, batch)
+    return d & v
+
+
+def apply_filter(batch: Batch, expr: ir.Expr) -> Batch:
+    """Filter = AND into the live mask; no data movement (the TPU analog of
+    Trino's SelectedPositions, operator/project/SelectedPositions.java)."""
+    return batch.with_live(batch.live & filter_mask(expr, batch))
+
+
+def project(batch: Batch, exprs) -> Batch:
+    """Evaluate projection list into a new Batch (same capacity/live)."""
+    cols = []
+    for e in exprs:
+        d, v = eval_expr(e, batch)
+        cols.append(Column(data=d, valid=v))
+    return Batch(columns=tuple(cols), live=batch.live)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def filter_project(batch: Batch, filter_expr, project_exprs) -> Batch:
+    """Jitted fused filter+project — the PageProcessor equivalent
+    (operator/project/PageProcessor.java:99). Expressions are static
+    (hashable IR), so each distinct plan compiles once and is cached."""
+    b = apply_filter(batch, filter_expr) if filter_expr is not None else batch
+    return project(b, project_exprs)

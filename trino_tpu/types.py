@@ -1,0 +1,119 @@
+"""Type system for trino_tpu.
+
+Role of the reference's ``core/trino-spi`` type system (spi/type/Type.java,
+82 files): a fixed set of SQL logical types with a defined physical layout.
+Our physical layout is chosen for TPU/XLA rather than the JVM:
+
+- BIGINT / INTEGER      -> int64 / int32 arrays
+- DOUBLE                -> float32 on device (float64 is not TPU-native;
+                           finalization arithmetic runs host-side in f64)
+- BOOLEAN               -> bool arrays
+- DATE                  -> int32 days since 1970-01-01 (same as Trino)
+- DECIMAL(p, s)         -> int64 scaled by 10**s (Trino short decimal,
+                           spi/type/DecimalType.java); sums widened per
+                           ops/aggregate.py's accumulator policy
+- VARCHAR               -> int32 dictionary codes into a host-side string
+                           pool (Trino's DictionaryBlock generalized into
+                           the storage policy, spi/block/DictionaryBlock.java)
+
+Nullability is carried out-of-band as a per-column validity mask (Trino:
+per-block null mask, spi/block/Block.java). Three-valued logic lives in
+ops/project.py.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+
+class TypeKind(enum.Enum):
+    BIGINT = "bigint"
+    INTEGER = "integer"
+    DOUBLE = "double"
+    BOOLEAN = "boolean"
+    DATE = "date"
+    DECIMAL = "decimal"
+    VARCHAR = "varchar"
+
+
+@dataclass(frozen=True)
+class DataType:
+    """A SQL logical type. Hashable so schemas can key jit caches."""
+
+    kind: TypeKind
+    precision: Optional[int] = None  # DECIMAL only
+    scale: Optional[int] = None      # DECIMAL only
+
+    def __post_init__(self):
+        if self.kind is TypeKind.DECIMAL:
+            assert self.precision is not None and self.scale is not None
+            assert self.precision <= 18, "long decimals (>18 digits) not yet supported"
+
+    # ---- physical layout ------------------------------------------------
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return {
+            TypeKind.BIGINT: np.dtype(np.int64),
+            TypeKind.INTEGER: np.dtype(np.int32),
+            TypeKind.DOUBLE: np.dtype(np.float32),
+            TypeKind.BOOLEAN: np.dtype(np.bool_),
+            TypeKind.DATE: np.dtype(np.int32),
+            TypeKind.DECIMAL: np.dtype(np.int64),
+            TypeKind.VARCHAR: np.dtype(np.int32),  # dictionary codes
+        }[self.kind]
+
+    @property
+    def is_dictionary(self) -> bool:
+        return self.kind is TypeKind.VARCHAR
+
+    @property
+    def is_integerlike(self) -> bool:
+        return self.kind in (TypeKind.BIGINT, TypeKind.INTEGER, TypeKind.DATE,
+                             TypeKind.DECIMAL, TypeKind.VARCHAR)
+
+    def __repr__(self) -> str:
+        if self.kind is TypeKind.DECIMAL:
+            return f"decimal({self.precision},{self.scale})"
+        return self.kind.value
+
+
+BIGINT = DataType(TypeKind.BIGINT)
+INTEGER = DataType(TypeKind.INTEGER)
+DOUBLE = DataType(TypeKind.DOUBLE)
+BOOLEAN = DataType(TypeKind.BOOLEAN)
+DATE = DataType(TypeKind.DATE)
+VARCHAR = DataType(TypeKind.VARCHAR)
+
+
+def decimal(precision: int, scale: int) -> DataType:
+    return DataType(TypeKind.DECIMAL, precision, scale)
+
+
+def common_super_type(a: DataType, b: DataType) -> DataType:
+    """Result type of arithmetic coercion between two types.
+
+    Mirrors the spirit of Trino's TypeCoercion (sql/analyzer/TypeCoercion.java)
+    for the subset of types we support.
+    """
+    if a == b:
+        return a
+    kinds = {a.kind, b.kind}
+    if TypeKind.DOUBLE in kinds:
+        return DOUBLE
+    if a.kind is TypeKind.DECIMAL and b.kind is TypeKind.DECIMAL:
+        scale = max(a.scale, b.scale)
+        intd = max(a.precision - a.scale, b.precision - b.scale)
+        return decimal(min(18, intd + scale), scale)
+    if TypeKind.DECIMAL in kinds:
+        d = a if a.kind is TypeKind.DECIMAL else b
+        return d
+    if kinds == {TypeKind.BIGINT, TypeKind.INTEGER}:
+        return BIGINT
+    if TypeKind.DATE in kinds and kinds & {TypeKind.BIGINT, TypeKind.INTEGER}:
+        return DATE  # date +/- integer days
+    raise TypeError(f"no common type for {a} and {b}")
