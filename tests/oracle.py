@@ -1,0 +1,120 @@
+"""sqlite3-based correctness oracle.
+
+Reference pattern: Trino checks TPC-H results against the H2 database loaded
+with the same data (testing/trino-testing/.../H2QueryRunner.java:92). We load
+the generated TableData into sqlite3 and run a dialect-translated query.
+
+Dialect translation handles the TPC-H subset:
+- DATE 'x' literals (folding +/- INTERVAL arithmetic into a plain literal)
+- EXTRACT(YEAR FROM x) -> CAST(strftime('%Y', x) AS INTEGER)
+- decimals load as REAL; comparisons use tolerances
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+import sqlite3
+from typing import Iterable, List
+
+import numpy as np
+
+from trino_tpu.connectors.tpch.datagen import TableData
+from trino_tpu.types import TypeKind
+
+
+def _add_months(d: datetime.date, n: int) -> datetime.date:
+    y, m = divmod((d.year * 12 + d.month - 1) + n, 12)
+    # clamp day like SQL engines do
+    for day in range(d.day, 27, -1):
+        try:
+            return datetime.date(y, m + 1, day)
+        except ValueError:
+            continue
+    return datetime.date(y, m + 1, min(d.day, 28))
+
+
+def translate(sql: str) -> str:
+    """Trino dialect -> sqlite dialect for the supported subset."""
+
+    def fold_interval(m):
+        base = datetime.date.fromisoformat(m.group(1))
+        sign = 1 if m.group(2) == '+' else -1
+        n = int(m.group(3)) * sign
+        unit = m.group(4).lower()
+        if unit.startswith('year'):
+            out = _add_months(base, 12 * n)
+        elif unit.startswith('month'):
+            out = _add_months(base, n)
+        else:
+            out = base + datetime.timedelta(days=n)
+        return f"'{out.isoformat()}'"
+
+    sql = re.sub(
+        r"DATE\s+'(\d{4}-\d{2}-\d{2})'\s*([+-])\s*INTERVAL\s+'(\d+)'\s+"
+        r"(YEAR|MONTH|DAY)S?",
+        fold_interval, sql, flags=re.IGNORECASE)
+    sql = re.sub(r"DATE\s+'(\d{4}-\d{2}-\d{2})'", r"'\1'", sql,
+                 flags=re.IGNORECASE)
+    sql = re.sub(r"EXTRACT\s*\(\s*YEAR\s+FROM\s+([a-zA-Z_][\w.]*)\s*\)",
+                 r"CAST(strftime('%Y', \1) AS INTEGER)", sql,
+                 flags=re.IGNORECASE)
+    return sql
+
+
+def load_oracle(tables: Iterable[TableData]) -> sqlite3.Connection:
+    conn = sqlite3.connect(":memory:")
+    for t in tables:
+        cols = []
+        for f in t.schema:
+            k = f.dtype.kind
+            if k is TypeKind.VARCHAR or k is TypeKind.DATE:
+                cols.append(f"{f.name} TEXT")
+            elif k in (TypeKind.DOUBLE, TypeKind.DECIMAL):
+                cols.append(f"{f.name} REAL")
+            else:
+                cols.append(f"{f.name} INTEGER")
+        conn.execute(f"CREATE TABLE {t.name} ({', '.join(cols)})")
+        host_cols = []
+        for f, arr in zip(t.schema, t.columns):
+            k = f.dtype.kind
+            if k is TypeKind.VARCHAR:
+                pool = np.array(f.dictionary, dtype=object)
+                host_cols.append(pool[np.asarray(arr)])
+            elif k is TypeKind.DATE:
+                base = np.datetime64('1970-01-01')
+                host_cols.append((base + np.asarray(arr)).astype(str))
+            elif k is TypeKind.DECIMAL:
+                host_cols.append(np.asarray(arr) / (10 ** f.dtype.scale))
+            else:
+                host_cols.append(np.asarray(arr))
+        rows = list(zip(*[c.tolist() for c in host_cols]))
+        ph = ", ".join("?" * len(t.schema))
+        conn.executemany(f"INSERT INTO {t.name} VALUES ({ph})", rows)
+    conn.commit()
+    return conn
+
+
+def oracle_query(conn: sqlite3.Connection, sql: str) -> List[tuple]:
+    return conn.execute(translate(sql)).fetchall()
+
+
+def assert_rows_match(got: List[tuple], want: List[tuple],
+                      rel_tol: float = 1e-6, abs_tol: float = 1e-4,
+                      ordered: bool = True) -> None:
+    if not ordered:
+        got = sorted(got, key=repr)
+        want = sorted(want, key=repr)
+    assert len(got) == len(want), \
+        f"row count mismatch: got {len(got)}, want {len(want)}\n" \
+        f"got[:5]={got[:5]}\nwant[:5]={want[:5]}"
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert len(g) == len(w), f"row {i} arity: {g} vs {w}"
+        for j, (a, b) in enumerate(zip(g, w)):
+            if isinstance(a, float) or isinstance(b, float):
+                a_f, b_f = float(a), float(b)
+                ok = abs(a_f - b_f) <= max(abs_tol, rel_tol * max(
+                    abs(a_f), abs(b_f)))
+                assert ok, f"row {i} col {j}: {a_f} != {b_f}"
+            else:
+                assert a == b, f"row {i} col {j}: {a!r} != {b!r}"

@@ -1,0 +1,124 @@
+"""Aggregation kernel tests (reference: TestHashAggregationOperator and
+aggregation function tests, SURVEY.md §4.1)."""
+
+import numpy as np
+
+from trino_tpu.batch import batch_from_numpy
+from trino_tpu.ops.aggregate import (AggSpec, avg_decimal_finalize,
+                                     direct_group_aggregate,
+                                     global_aggregate, sort_group_aggregate)
+
+
+def np_groupby_sum(keys, vals, mask):
+    out = {}
+    for k, v, m in zip(keys, vals, mask):
+        if m:
+            out.setdefault(k, 0)
+            out[k] += v
+    return out
+
+
+def test_direct_group_sum_count():
+    codes = np.array([0, 1, 0, 2, 1, 0], dtype=np.int32)
+    vals = np.array([10, 20, 30, 40, 50, 60], dtype=np.int64)
+    batch = batch_from_numpy([codes, vals], pad_multiple=8)
+    out = direct_group_aggregate(
+        batch, (0,), (3,),
+        (AggSpec("sum", 1), AggSpec("count_star", None)))
+    live = np.asarray(out.live)
+    assert live[:3].all()
+    np.testing.assert_array_equal(np.asarray(out.columns[0].data)[:3],
+                                  [0, 1, 2])
+    np.testing.assert_array_equal(np.asarray(out.columns[1].data)[:3],
+                                  [100, 70, 40])
+    np.testing.assert_array_equal(np.asarray(out.columns[2].data)[:3],
+                                  [3, 2, 1])
+
+
+def test_direct_two_keys_mixed_radix():
+    k1 = np.array([0, 1, 1, 0], dtype=np.int32)
+    k2 = np.array([1, 0, 1, 1], dtype=np.int32)
+    v = np.array([1, 2, 3, 4], dtype=np.int64)
+    batch = batch_from_numpy([k1, k2, v], pad_multiple=4)
+    out = direct_group_aggregate(batch, (0, 1), (2, 2),
+                                 (AggSpec("sum", 2),))
+    # group ids: (0,0)=0 (dead), (0,1)=1 -> 5, (1,0)=2 -> 2, (1,1)=3 -> 3
+    live = np.asarray(out.live)
+    np.testing.assert_array_equal(live, [False, True, True, True])
+    np.testing.assert_array_equal(np.asarray(out.columns[2].data)[1:],
+                                  [5, 2, 3])
+
+
+def test_sum_nulls_and_empty_group_null():
+    codes = np.array([0, 0, 1], dtype=np.int32)
+    vals = np.array([5, 7, 9], dtype=np.int64)
+    valid = np.array([True, False, False])
+    batch = batch_from_numpy([codes, vals], valids=[None, valid],
+                             pad_multiple=4)
+    out = direct_group_aggregate(
+        batch, (0,), (2,), (AggSpec("sum", 1), AggSpec("count", 1)))
+    sums = np.asarray(out.columns[1].data)
+    sums_valid = np.asarray(out.columns[1].valid)
+    counts = np.asarray(out.columns[2].data)
+    assert sums[0] == 5 and sums_valid[0]
+    assert not sums_valid[1]          # all-NULL group -> sum is NULL
+    np.testing.assert_array_equal(counts[:2], [1, 0])
+
+
+def test_sort_group_matches_numpy_random():
+    rng = np.random.default_rng(7)
+    n = 5000
+    keys = rng.integers(0, 500, n).astype(np.int64)
+    vals = rng.integers(-1000, 1000, n).astype(np.int64)
+    batch = batch_from_numpy([keys, vals])
+    out = sort_group_aggregate(
+        batch, (0,),
+        (AggSpec("sum", 1), AggSpec("min", 1), AggSpec("max", 1),
+         AggSpec("count_star", None)),
+        1024)
+    live = np.asarray(out.live)
+    got_keys = np.asarray(out.columns[0].data)[live]
+    got_sums = np.asarray(out.columns[1].data)[live]
+    got_mins = np.asarray(out.columns[2].data)[live]
+    got_maxs = np.asarray(out.columns[3].data)[live]
+    want = np_groupby_sum(keys, vals, np.ones(n, bool))
+    assert len(got_keys) == len(want)
+    order = np.argsort(got_keys)
+    for i in order:
+        k = got_keys[i]
+        assert got_sums[i] == want[k]
+        sel = vals[keys == k]
+        assert got_mins[i] == sel.min() and got_maxs[i] == sel.max()
+
+
+def test_sort_group_null_keys_group_together():
+    keys = np.array([1, 1, 2], dtype=np.int64)
+    kvalid = np.array([False, False, True])
+    vals = np.array([10, 20, 30], dtype=np.int64)
+    batch = batch_from_numpy([keys, vals], valids=[kvalid, None],
+                             pad_multiple=4)
+    out = sort_group_aggregate(batch, (0,), (AggSpec("sum", 1),), 4)
+    live = np.asarray(out.live)
+    assert live.sum() == 2            # NULL group + key=2 group
+    kv = np.asarray(out.columns[0].valid)[live]
+    sums = np.asarray(out.columns[1].data)[live]
+    assert sorted(zip(kv.tolist(), sums.tolist())) == [(False, 30), (True, 30)]
+
+
+def test_global_aggregate_empty_input():
+    batch = batch_from_numpy([np.array([], dtype=np.int64)])
+    out = global_aggregate(batch, (AggSpec("sum", 0),
+                                   AggSpec("count", 0),
+                                   AggSpec("count_star", None)))
+    assert bool(out.live[0])
+    assert not bool(out.columns[0].valid[0])   # sum over empty -> NULL
+    assert int(out.columns[1].data[0]) == 0
+    assert int(out.columns[2].data[0]) == 0
+
+
+def test_avg_decimal_finalize_half_up():
+    sums = np.array([10, 11, -11, 7], dtype=np.int64)
+    counts = np.array([4, 2, 2, 2], dtype=np.int64)
+    # 10/4=2.5 -> 3; 11/2=5.5 -> 6; -11/2=-5.5 -> -6; 7/2=3.5 -> 4
+    np.testing.assert_array_equal(avg_decimal_finalize(sums, counts),
+                                  [3, 6, -6, 4])

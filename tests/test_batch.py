@@ -44,6 +44,11 @@ def test_schema_lookup_and_decode():
     vals = decode_column(schema.field("s"),
                          np.array([1, 0]), np.array([True, True]))
     assert vals == ["banana", "apple"]
+    from decimal import Decimal
     dec = decode_column(schema.field("d"),
                         np.array([12345, -50]), np.array([True, False]))
-    assert dec == [123.45, None]
+    assert dec == [Decimal("123.45"), None]
+    # exactness beyond 2^53 (float would corrupt the low digits)
+    big = decode_column(schema.field("d"),
+                        np.array([9007199254740995]), np.array([True]))
+    assert big == [Decimal("90071992547409.95")]

@@ -1,0 +1,156 @@
+"""Parser tests (reference: core/trino-parser test suite, TestSqlParser)."""
+
+import pytest
+
+from trino_tpu.sql import ast_nodes as A
+from trino_tpu.sql.parser import parse
+from trino_tpu.sql.tokenizer import SqlSyntaxError
+
+TPCH_Q1 = """
+SELECT l_returnflag, l_linestatus,
+       sum(l_quantity) AS sum_qty,
+       sum(l_extendedprice) AS sum_base_price,
+       sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+       avg(l_quantity) AS avg_qty,
+       avg(l_extendedprice) AS avg_price,
+       avg(l_discount) AS avg_disc,
+       count(*) AS count_order
+FROM lineitem
+WHERE l_shipdate <= DATE '1998-12-01' - INTERVAL '90' DAY
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus
+"""
+
+TPCH_Q3 = """
+SELECT l_orderkey,
+       sum(l_extendedprice * (1 - l_discount)) AS revenue,
+       o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING'
+  AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey
+  AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate
+LIMIT 10
+"""
+
+
+def test_parse_q1_shape():
+    q = parse(TPCH_Q1)
+    assert isinstance(q, A.Query)
+    assert len(q.select) == 10
+    assert q.select[2].alias == "sum_qty"
+    assert isinstance(q.relation, A.TableRef)
+    assert len(q.group_by) == 2
+    assert len(q.order_by) == 2
+    assert q.limit is None
+    # WHERE: l_shipdate <= DATE - INTERVAL
+    w = q.where
+    assert isinstance(w, A.BinaryOp) and w.op == "<="
+    assert isinstance(w.right, A.BinaryOp) and w.op == "<="
+    assert isinstance(w.right.left, A.DateLit)
+    assert isinstance(w.right.right, A.IntervalLit)
+    assert w.right.right.unit == "day" and w.right.right.value == 90
+
+
+def test_parse_q3_comma_joins_and_limit():
+    q = parse(TPCH_Q3)
+    assert q.limit == 10
+    assert isinstance(q.relation, A.Join) and q.relation.kind == "cross"
+    assert not q.order_by[0].ascending
+    assert q.order_by[1].ascending
+
+
+def test_explicit_join_on():
+    q = parse("SELECT a FROM t1 JOIN t2 ON t1.x = t2.y "
+              "LEFT JOIN t3 ON t2.z = t3.z")
+    r = q.relation
+    assert isinstance(r, A.Join) and r.kind == "left"
+    assert isinstance(r.left, A.Join) and r.left.kind == "inner"
+    assert r.left.condition is not None
+
+
+def test_precedence_and_or_not():
+    q = parse("SELECT 1 FROM t WHERE a = 1 OR b = 2 AND NOT c = 3")
+    w = q.where
+    assert isinstance(w, A.BinaryOp) and w.op == "or"
+    rhs = w.right
+    assert isinstance(rhs, A.BinaryOp) and rhs.op == "and"
+    assert isinstance(rhs.right, A.UnaryOp) and rhs.right.op == "not"
+
+
+def test_arith_precedence():
+    q = parse("SELECT 1 + 2 * 3 - 4 FROM t")
+    e = q.select[0].expr
+    # ((1 + (2*3)) - 4)
+    assert isinstance(e, A.BinaryOp) and e.op == "-"
+    assert isinstance(e.left, A.BinaryOp) and e.left.op == "+"
+    assert isinstance(e.left.right, A.BinaryOp) and e.left.right.op == "*"
+
+
+def test_case_cast_extract_functions():
+    q = parse("""
+      SELECT CASE WHEN x > 0 THEN 'pos' ELSE 'neg' END,
+             CAST(x AS decimal(10,2)),
+             EXTRACT(YEAR FROM d),
+             count(DISTINCT y),
+             count(*),
+             substring(s, 1, 3)
+      FROM t""")
+    case, cast, ext, cntd, cnt, sub = [i.expr for i in q.select]
+    assert isinstance(case, A.CaseExpr) and case.default is not None
+    assert isinstance(cast, A.CastExpr) and cast.type_name == "decimal(10,2)"
+    assert isinstance(ext, A.ExtractExpr) and ext.part == "year"
+    assert isinstance(cntd, A.FunctionCall) and cntd.distinct
+    assert isinstance(cnt, A.FunctionCall) and cnt.is_star
+    assert isinstance(sub, A.FunctionCall) and len(sub.args) == 3
+
+
+def test_predicates():
+    q = parse("SELECT 1 FROM t WHERE a BETWEEN 1 AND 10 "
+              "AND b NOT IN (1, 2) AND c LIKE '%x%' AND d IS NOT NULL")
+    conj = []
+    def flatten(e):
+        if isinstance(e, A.BinaryOp) and e.op == "and":
+            flatten(e.left); flatten(e.right)
+        else:
+            conj.append(e)
+    flatten(q.where)
+    assert isinstance(conj[0], A.BetweenPredicate)
+    assert isinstance(conj[1], A.InPredicate) and conj[1].negated
+    assert isinstance(conj[2], A.LikePredicate)
+    assert isinstance(conj[3], A.IsNullPredicate) and conj[3].negated
+
+
+def test_subqueries():
+    q = parse("SELECT x FROM (SELECT a AS x FROM t) s "
+              "WHERE x IN (SELECT y FROM u) AND EXISTS (SELECT 1 FROM v)")
+    assert isinstance(q.relation, A.SubqueryRef) and q.relation.alias == "s"
+    # scalar subquery
+    q2 = parse("SELECT (SELECT max(a) FROM t) FROM u")
+    assert isinstance(q2.select[0].expr, A.ScalarSubquery)
+
+
+def test_string_escape_and_quoted_ident():
+    q = parse("SELECT 'it''s', \"Weird Col\" FROM t")
+    assert q.select[0].expr.value == "it's"
+    assert q.select[1].expr.parts == ("Weird Col",)
+
+
+def test_errors_have_position():
+    with pytest.raises(SqlSyntaxError, match="line 1"):
+        parse("SELECT FROM t")
+    with pytest.raises(SqlSyntaxError):
+        parse("SELECT a FROM t WHERE")
+    with pytest.raises(SqlSyntaxError, match="trailing"):
+        parse("SELECT a FROM t garbage garbage")
+
+
+def test_explain_and_show():
+    e = parse("EXPLAIN ANALYZE SELECT 1 FROM t")
+    assert isinstance(e, A.Explain) and e.analyze
+    s = parse("SHOW TABLES FROM tpch.tiny")
+    assert s.catalog == "tpch" and s.schema == "tiny"

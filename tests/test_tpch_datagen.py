@@ -1,0 +1,69 @@
+"""TPC-H generator sanity + oracle harness smoke tests.
+
+Reference: plugin/trino-tpch tests assert deterministic generation;
+H2QueryRunner loads the same data into the oracle (SURVEY.md §4.4)."""
+
+import numpy as np
+
+from oracle import load_oracle, oracle_query, translate
+from trino_tpu.connectors.tpch.connector import TpchConnector
+
+
+def get_tiny():
+    conn = TpchConnector()
+    return {t: conn.get_table("tiny", t)
+            for t in ["region", "nation", "customer", "orders", "lineitem"]}
+
+
+def test_row_counts_and_determinism():
+    c1, c2 = TpchConnector(), TpchConnector()
+    t1 = c1.get_table("tiny", "lineitem")
+    t2 = c2.get_table("tiny", "lineitem")
+    assert t1.num_rows == t2.num_rows
+    np.testing.assert_array_equal(t1.columns[0], t2.columns[0])
+    orders = c1.get_table("tiny", "orders")
+    assert orders.num_rows == 15_000
+    assert c1.get_table("tiny", "customer").num_rows == 1_500
+    # lineitem ~4x orders on average
+    assert 3.5 * orders.num_rows < t1.num_rows < 4.5 * orders.num_rows
+
+
+def test_referential_integrity():
+    t = get_tiny()
+    custkeys = set(t["customer"].columns[0].tolist())
+    assert set(t["orders"].columns[1].tolist()) <= custkeys
+    orderkeys = set(t["orders"].columns[0].tolist())
+    assert set(t["lineitem"].columns[0].tolist()) <= orderkeys
+    # dbgen invariant: no customer with custkey % 3 == 0 places orders
+    assert all(k % 3 != 0 for k in set(t["orders"].columns[1].tolist()))
+
+
+def test_dates_consistent():
+    li = get_tiny()["lineitem"]
+    s = li.schema
+    ship = li.columns[s.index_of("l_shipdate")]
+    receipt = li.columns[s.index_of("l_receiptdate")]
+    assert (receipt > ship).all()
+
+
+def test_translate_dialect():
+    assert translate("DATE '1994-01-01'") == "'1994-01-01'"
+    assert translate(
+        "DATE '1995-01-01' + INTERVAL '3' MONTH") == "'1995-04-01'"
+    assert translate(
+        "DATE '1994-01-01' + INTERVAL '1' YEAR") == "'1995-01-01'"
+    out = translate("EXTRACT(YEAR FROM o_orderdate)")
+    assert "strftime" in out
+
+
+def test_oracle_q6_runs():
+    t = get_tiny()
+    conn = load_oracle([t["lineitem"]])
+    rows = oracle_query(conn, """
+        SELECT sum(l_extendedprice * l_discount)
+        FROM lineitem
+        WHERE l_shipdate >= DATE '1994-01-01'
+          AND l_shipdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+          AND l_discount BETWEEN 0.05 AND 0.07
+          AND l_quantity < 24""")
+    assert rows[0][0] is not None and rows[0][0] > 0

@@ -1,0 +1,177 @@
+"""End-to-end TPC-H query tests against the sqlite oracle.
+
+Reference pattern: AbstractTestQueries + H2QueryRunner — every query runs on
+both the engine and an independent SQL engine loaded with identical data,
+and results must match (SURVEY.md §4.3-4.4). Decimal columns compare with
+abs_tol 0.01 (engine decimals are exact scaled-int64; the oracle sums
+REALs, and Trino-semantics avg(decimal) rounds at the argument scale).
+"""
+
+import numpy as np
+import pytest
+
+from oracle import assert_rows_match, load_oracle, oracle_query
+from trino_tpu.connectors.tpch.connector import TpchConnector
+from trino_tpu.exec.session import Session
+
+TPCH_TABLES = ["region", "nation", "supplier", "customer", "part",
+               "partsupp", "orders", "lineitem"]
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session(default_schema="tiny")
+
+
+@pytest.fixture(scope="module")
+def oracle(session):
+    conn = session.catalog.connector("tpch")
+    return load_oracle([conn.get_table("tiny", t) for t in TPCH_TABLES])
+
+
+def check(session, oracle, sql, ordered=True, abs_tol=0.01):
+    got = session.execute(sql).rows
+    want = oracle_query(oracle, sql)
+    assert_rows_match(got, want, rel_tol=1e-9, abs_tol=abs_tol,
+                      ordered=ordered)
+    return got
+
+
+Q1 = """
+SELECT l_returnflag, l_linestatus,
+       sum(l_quantity) AS sum_qty,
+       sum(l_extendedprice) AS sum_base_price,
+       sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+       avg(l_quantity) AS avg_qty,
+       avg(l_extendedprice) AS avg_price,
+       avg(l_discount) AS avg_disc,
+       count(*) AS count_order
+FROM lineitem
+WHERE l_shipdate <= DATE '1998-12-01' - INTERVAL '90' DAY
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus
+"""
+
+Q3 = """
+SELECT l_orderkey,
+       sum(l_extendedprice * (1 - l_discount)) AS revenue,
+       o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING'
+  AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey
+  AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate, l_orderkey
+LIMIT 10
+"""
+
+Q5 = """
+SELECT n_name,
+       sum(l_extendedprice * (1 - l_discount)) AS revenue
+FROM customer, orders, lineitem, supplier, nation, region
+WHERE c_custkey = o_custkey
+  AND l_orderkey = o_orderkey
+  AND l_suppkey = s_suppkey
+  AND c_nationkey = s_nationkey
+  AND s_nationkey = n_nationkey
+  AND n_regionkey = r_regionkey
+  AND r_name = 'ASIA'
+  AND o_orderdate >= DATE '1994-01-01'
+  AND o_orderdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+GROUP BY n_name
+ORDER BY revenue DESC, n_name
+"""
+
+Q6 = """
+SELECT sum(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= DATE '1994-01-01'
+  AND l_shipdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+  AND l_discount BETWEEN 0.05 AND 0.07
+  AND l_quantity < 24
+"""
+
+
+def test_q1(session, oracle):
+    rows = check(session, oracle, Q1)
+    assert len(rows) == 4
+
+
+def test_q6(session, oracle):
+    rows = check(session, oracle, Q6)
+    assert len(rows) == 1 and rows[0][0] > 0
+
+
+def test_q3(session, oracle):
+    rows = check(session, oracle, Q3)
+    assert len(rows) == 10
+
+
+def test_q5(session, oracle):
+    rows = check(session, oracle, Q5)
+    # at tiny scale not every ASIA nation has 1994 revenue; the oracle
+    # match above is the real assertion
+    assert 1 <= len(rows) <= 5
+    revs = [r[1] for r in rows]
+    assert revs == sorted(revs, reverse=True)
+
+
+def test_simple_select_filter(session, oracle):
+    check(session, oracle,
+          "SELECT n_name, n_regionkey FROM nation "
+          "WHERE n_regionkey = 3 ORDER BY n_name")
+
+
+def test_projection_arith(session, oracle):
+    check(session, oracle,
+          "SELECT o_orderkey, o_totalprice * 2 FROM orders "
+          "ORDER BY o_orderkey LIMIT 20")
+
+
+def test_inner_join_explicit(session, oracle):
+    check(session, oracle,
+          "SELECT n_name, r_name FROM nation JOIN region "
+          "ON n_regionkey = r_regionkey ORDER BY n_name")
+
+
+def test_global_agg(session, oracle):
+    check(session, oracle,
+          "SELECT count(*), sum(o_totalprice), min(o_orderdate), "
+          "max(o_orderdate) FROM orders")
+
+
+def test_group_by_bigint_sort_strategy(session, oracle):
+    check(session, oracle,
+          "SELECT o_custkey, count(*), sum(o_totalprice) FROM orders "
+          "GROUP BY o_custkey ORDER BY o_custkey LIMIT 50")
+
+
+def test_distinct(session, oracle):
+    check(session, oracle,
+          "SELECT DISTINCT o_orderpriority FROM orders "
+          "ORDER BY o_orderpriority")
+
+
+def test_like_predicate(session, oracle):
+    check(session, oracle,
+          "SELECT count(*) FROM orders WHERE o_comment LIKE '%special%'")
+
+
+def test_in_list(session, oracle):
+    check(session, oracle,
+          "SELECT count(*) FROM lineitem "
+          "WHERE l_shipmode IN ('AIR', 'MAIL')")
+
+
+def test_explain_renders(session):
+    r = session.execute("EXPLAIN " + Q3)
+    text = "\n".join(row[0] for row in r.rows)
+    assert "Join" in text and "TableScan" in text and "TopN" in text
+
+
+def test_show_tables(session):
+    r = session.execute("SHOW TABLES FROM tpch.tiny")
+    assert ("lineitem",) in r.rows

@@ -172,6 +172,8 @@ def batch_to_numpy(batch: Batch) -> tuple:
 def decode_column(field: Field, data: np.ndarray, valid: np.ndarray) -> list:
     """Render a host column to Python values (strings via dictionary,
     decimals via scale). Used at the client/protocol boundary only."""
+    import datetime
+    epoch = datetime.date(1970, 1, 1)
     out = []
     kind = field.dtype.kind
     for x, v in zip(data, valid):
@@ -180,11 +182,16 @@ def decode_column(field: Field, data: np.ndarray, valid: np.ndarray) -> list:
         elif kind is TypeKind.VARCHAR:
             out.append(field.dictionary[int(x)])
         elif kind is TypeKind.DECIMAL:
-            out.append(int(x) / (10 ** field.dtype.scale))
+            # exact: unscaled int64 may exceed 2^53, so float division
+            # would corrupt low digits
+            from decimal import Decimal
+            out.append(Decimal(int(x)).scaleb(-field.dtype.scale))
         elif kind is TypeKind.DOUBLE:
             out.append(float(x))
         elif kind is TypeKind.BOOLEAN:
             out.append(bool(x))
+        elif kind is TypeKind.DATE:
+            out.append((epoch + datetime.timedelta(days=int(x))).isoformat())
         else:
             out.append(int(x))
     return out

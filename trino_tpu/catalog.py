@@ -1,0 +1,39 @@
+"""Catalog registry — maps catalog names to connectors.
+
+Reference: Trino's CatalogManager / connector loading
+(metadata/CatalogManager.java, server/PluginManager.java). Connectors
+implement a minimal duck-typed contract for now (schema_names/table_names/
+get_table returning host TableData); the split-based scan SPI for
+distributed execution layers on top in planner/physical.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .connectors.tpch.connector import TpchConnector
+
+
+class Catalog:
+    def __init__(self):
+        self._connectors: Dict[str, object] = {}
+
+    def register(self, name: str, connector) -> None:
+        self._connectors[name] = connector
+
+    def connector(self, name: str):
+        if name not in self._connectors:
+            raise KeyError(f"catalog {name!r} not found "
+                           f"(have {sorted(self._connectors)})")
+        return self._connectors[name]
+
+    def get_table(self, catalog: str, schema: str, table: str):
+        return self.connector(catalog).get_table(schema, table)
+
+
+def default_catalog() -> Catalog:
+    cat = Catalog()
+    cat.register("tpch", TpchConnector())
+    from .connectors.memory import MemoryConnector
+    cat.register("memory", MemoryConnector())
+    return cat

@@ -1,0 +1,31 @@
+"""In-memory connector (reference: plugin/trino-memory, MemoryMetadata/
+MemoryPagesStore) — tables created programmatically or via INSERT, held as
+host numpy columns."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from .tpch.datagen import TableData
+
+
+class MemoryConnector:
+    name = "memory"
+
+    def __init__(self):
+        self._tables: Dict[Tuple[str, str], TableData] = {}
+
+    def schema_names(self):
+        return sorted({s for (s, _) in self._tables})
+
+    def table_names(self, schema: str):
+        return sorted(t for (s, t) in self._tables if s == schema)
+
+    def create_table(self, schema: str, name: str, data: TableData) -> None:
+        self._tables[(schema, name)] = data
+
+    def get_table(self, schema: str, table: str) -> TableData:
+        key = (schema, table)
+        if key not in self._tables:
+            raise KeyError(f"memory table {schema}.{table} not found")
+        return self._tables[key]

@@ -1,0 +1,204 @@
+"""Single-node plan executor.
+
+Reference: the worker-side execution stack — LocalExecutionPlanner turns a
+fragment into operator pipelines (sql/planner/LocalExecutionPlanner.java:549)
+and Driver pushes pages between operators (operator/Driver.java:372). Here a
+plan node maps to a jitted kernel call; XLA fuses within each call, and
+adjacent Filter/Project nodes are evaluated inside one jit (the fusion
+PageProcessor codegen gives Trino). The distributed variant lives in
+parallel/ (stages over a mesh); this executor is also the per-shard body.
+
+Adaptive fallbacks (SURVEY.md §7 hard part 1):
+- sort-aggregation output capacity doubles and re-runs when the group table
+  fills (the analog of GroupByHash rehash);
+- joins with duplicate build keys fall back to a host expansion join until
+  the device expansion kernel lands.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import ir
+from ..batch import Batch, Column, batch_from_numpy, batch_to_numpy
+from ..catalog import Catalog
+from ..ops.aggregate import (AggSpec, direct_group_aggregate,
+                             global_aggregate, sort_group_aggregate)
+from ..ops.join import host_expansion_join, join_unique_build
+from ..ops.project import apply_filter, filter_project, project
+from ..ops.sort import limit_batch, sort_batch
+from ..planner import logical as L
+
+
+@dataclass
+class ExecStats:
+    """Per-query execution counters (OperatorStats pyramid, minimal)."""
+    scans: int = 0
+    rows_scanned: int = 0
+    join_fallbacks: int = 0
+    agg_capacity_retries: int = 0
+
+
+class Executor:
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+        self._scan_cache: Dict[Tuple[str, str, str, tuple], Batch] = {}
+        self.stats = ExecStats()
+
+    # ------------------------------------------------------------------
+
+    def execute(self, root: L.OutputNode) -> Batch:
+        assert isinstance(root, L.OutputNode)
+        return self.run(root.child)
+
+    def run(self, node: L.PlanNode) -> Batch:
+        if isinstance(node, L.ScanNode):
+            return self.run_scan(node)
+        if isinstance(node, L.FilterNode):
+            # fuse Filter over Project/Scan chains into one jit call
+            if isinstance(node.child, L.ProjectNode):
+                child = self.run(node.child.child)
+                return filter_project_fused(child, node.child.exprs,
+                                            node.predicate)
+            return apply_filter(self.run(node.child), node.predicate)
+        if isinstance(node, L.ProjectNode):
+            if isinstance(node.child, L.FilterNode):
+                child = self.run(node.child.child)
+                return filter_project(child, node.child.predicate,
+                                      node.exprs)
+            return filter_project(self.run(node.child), None, node.exprs)
+        if isinstance(node, L.AggregateNode):
+            return self.run_aggregate(node)
+        if isinstance(node, L.JoinNode):
+            return self.run_join(node)
+        if isinstance(node, L.SortNode):
+            keys = tuple((k.index, k.ascending, k.nulls_first)
+                         for k in node.keys)
+            return sort_batch(self.run(node.child), keys, node.limit)
+        if isinstance(node, L.LimitNode):
+            return limit_batch(self.run(node.child),
+                               jnp.asarray(node.count, dtype=jnp.int64))
+        if isinstance(node, L.OutputNode):
+            return self.run(node.child)
+        raise NotImplementedError(type(node).__name__)
+
+    # ------------------------------------------------------------------
+
+    def run_scan(self, node: L.ScanNode) -> Batch:
+        key = (node.catalog, node.schema_name, node.table,
+               node.column_indices)
+        if key not in self._scan_cache:
+            data = self.catalog.get_table(node.catalog, node.schema_name,
+                                          node.table)
+            arrays = [data.columns[i] for i in node.column_indices]
+            valids = None
+            if data.valids is not None:
+                valids = [data.valids[i] for i in node.column_indices]
+            self._scan_cache[key] = batch_from_numpy(arrays, valids=valids)
+            self.stats.scans += 1
+            self.stats.rows_scanned += data.num_rows
+        return self._scan_cache[key]
+
+    def run_aggregate(self, node: L.AggregateNode) -> Batch:
+        child = self.run(node.child)
+        aggs = tuple(AggSpec(
+            a.func,
+            a.arg.index if a.arg is not None else None)
+            for a in node.aggs)
+        if node.strategy == "global":
+            return global_aggregate(child, aggs)
+        if node.strategy == "direct":
+            return direct_group_aggregate(child, node.group_keys,
+                                          node.key_domains, aggs)
+        capacity = node.out_capacity
+        while True:
+            out = sort_group_aggregate(child, node.group_keys, aggs,
+                                       capacity)
+            n_groups = int(out.live.sum())
+            if n_groups < capacity or capacity >= child.capacity:
+                return out
+            capacity *= 4    # table filled: grow and retry (rehash analog)
+            self.stats.agg_capacity_retries += 1
+
+    def run_join(self, node: L.JoinNode) -> Batch:
+        probe = self.run(node.left)
+        build = self.run(node.right)
+        self.validate_key_ranges(build, node.right_keys)
+        out, dup = join_unique_build(probe, build, node.left_keys,
+                                     node.right_keys, node.kind)
+        if int(dup) == 0:
+            return out
+        # duplicate build keys: host expansion fallback
+        self.stats.join_fallbacks += 1
+        return self.host_join(probe, build, node)
+
+    def validate_key_ranges(self, batch: Batch, keys: tuple) -> None:
+        if len(keys) <= 1:
+            return
+        for ki in keys[1:]:
+            hi = int(jnp.max(jnp.where(batch.live,
+                                       batch.columns[ki].data, 0)))
+            lo = int(jnp.min(jnp.where(batch.live,
+                                       batch.columns[ki].data, 0)))
+            if lo < 0 or hi >= (1 << 31):
+                raise RuntimeError(
+                    "multi-column join key outside packable range")
+
+    def host_join(self, probe: Batch, build: Batch,
+                  node: L.JoinNode) -> Batch:
+        pa, pv = _to_host_padded(probe)
+        ba, bv = _to_host_padded(build)
+        p_live = np.asarray(probe.live)
+        b_live = np.asarray(build.live)
+        pk = _pack_host(pa, pv, node.left_keys)
+        bk = _pack_host(ba, bv, node.right_keys)
+        pa2 = [pk[0]] + pa
+        pv2 = [pk[1]] + pv
+        ba2 = [bk[0]] + ba
+        bv2 = [bk[1]] + bv
+        arrays, valids = host_expansion_join(
+            pa2, pv2, p_live, ba2, bv2, b_live, 0, 0, node.kind)
+        # drop packed key columns
+        if node.kind in ("semi", "anti"):
+            arrays, valids = arrays[1:], valids[1:]
+        else:
+            n_probe = len(pa)
+            arrays = arrays[1:n_probe + 1] + arrays[n_probe + 2:]
+            valids = valids[1:n_probe + 1] + valids[n_probe + 2:]
+        return batch_from_numpy(arrays, valids=valids)
+
+    def result_to_host(self, root: L.OutputNode, batch: Batch):
+        """Compact + return (names, columns, valids) on host."""
+        arrays, valids = batch_to_numpy(batch)
+        return list(root.names), arrays, valids
+
+
+def _to_host_padded(batch: Batch):
+    arrays = [np.asarray(c.data) for c in batch.columns]
+    valids = [np.asarray(c.valid) for c in batch.columns]
+    return arrays, valids
+
+
+def _pack_host(arrays, valids, keys: tuple):
+    key = arrays[keys[0]].astype(np.int64)
+    valid = valids[keys[0]].copy()
+    for ki in keys[1:]:
+        key = key * (1 << 32) + arrays[ki].astype(np.int64)
+        valid = valid & valids[ki]
+    return key, valid
+
+
+import functools
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def filter_project_fused(batch: Batch, exprs, predicate) -> Batch:
+    """Project-then-filter in one jit (Filter over Project)."""
+    projected = project(batch, exprs)
+    return apply_filter(projected, predicate)

@@ -1,0 +1,100 @@
+"""Session: the user-facing entry — SQL text in, rows out.
+
+Reference: the coordinator path DispatchManager.createQuery ->
+SqlQueryExecution (dispatcher/DispatchManager.java:175,
+execution/SqlQueryExecution.java:392) collapsed to its single-node essence:
+parse -> plan -> execute -> decode. The distributed scheduler wraps this in
+parallel/; the HTTP protocol front end in client/ builds on Session too.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from ..batch import decode_column, Field
+from ..catalog import Catalog, default_catalog
+from ..planner.logical import OutputNode, explain_text
+from ..planner.planner import Planner
+from ..sql import ast_nodes as A
+from ..sql.parser import parse
+from ..types import TypeKind
+from .executor import Executor
+
+
+@dataclass
+class QueryResult:
+    column_names: List[str]
+    rows: List[tuple]
+    elapsed_s: float = 0.0
+    stats: Optional[object] = None
+
+    def __iter__(self):
+        return iter(self.rows)
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class Session:
+    def __init__(self, catalog: Optional[Catalog] = None,
+                 default_cat: str = "tpch", default_schema: str = "tiny"):
+        self.catalog = catalog or default_catalog()
+        self.default_cat = default_cat
+        self.default_schema = default_schema
+        self.executor = Executor(self.catalog)
+
+    def planner(self) -> Planner:
+        return Planner(self.catalog, self.default_cat, self.default_schema)
+
+    def plan(self, sql: str):
+        stmt = parse(sql)
+        if isinstance(stmt, A.Explain):
+            return stmt, None
+        assert isinstance(stmt, (A.Query, A.ShowTables))
+        if isinstance(stmt, A.ShowTables):
+            return stmt, None
+        rel = self.planner().plan_query(stmt)
+        return stmt, rel
+
+    def execute(self, sql: str) -> QueryResult:
+        t0 = time.monotonic()
+        stmt = parse(sql)
+
+        if isinstance(stmt, A.ShowTables):
+            cat = stmt.catalog or self.default_cat
+            sch = stmt.schema or self.default_schema
+            names = self.catalog.connector(cat).table_names(sch)
+            return QueryResult(["table"], [(n,) for n in names],
+                               time.monotonic() - t0)
+
+        if isinstance(stmt, A.Explain):
+            rel = self.planner().plan_query(stmt.query)
+            text = explain_text(rel.node)
+            return QueryResult(["query plan"],
+                               [(line,) for line in text.split("\n")],
+                               time.monotonic() - t0)
+
+        rel = self.planner().plan_query(stmt)
+        root = rel.node
+        assert isinstance(root, OutputNode)
+        batch = self.executor.execute(root)
+        names, arrays, valids = self.executor.result_to_host(root, batch)
+        rows = self.decode_rows(rel, arrays, valids)
+        return QueryResult(names, rows, time.monotonic() - t0,
+                           self.executor.stats)
+
+    def decode_rows(self, rel, arrays, valids) -> List[tuple]:
+        cols = []
+        for sc, arr, val in zip(rel.scope.columns, arrays, valids):
+            fld = sc.field if sc.field is not None else Field(
+                sc.name, sc.dtype)
+            if sc.dtype.kind is TypeKind.VARCHAR and \
+                    (fld.dictionary is None):
+                raise RuntimeError(
+                    f"varchar output {sc.name} lost its dictionary")
+            cols.append(decode_column(fld, arr, val))
+        return list(zip(*cols)) if cols else []

@@ -124,6 +124,16 @@ class DictPredicate(Expr):
 
 
 @dataclass(frozen=True)
+class DecimalAvg(Expr):
+    """Exact decimal AVG finalizer: round-half-away-from-zero of
+    sum/count at the argument's scale (Trino avg(decimal) semantics,
+    computed with integer ops on device)."""
+    sum: Expr
+    count: Expr
+    dtype: DataType
+
+
+@dataclass(frozen=True)
 class ExtractField(Expr):
     """EXTRACT(YEAR/MONTH/DAY FROM date_expr) — computes civil fields from
     epoch days on device."""
@@ -180,6 +190,8 @@ def walk(expr: Expr):
     elif isinstance(expr, Case):
         children = tuple(c for w in expr.whens for c in w) + \
             ((expr.default,) if expr.default is not None else ())
+    elif isinstance(expr, DecimalAvg):
+        children = (expr.sum, expr.count)
     for c in children:
         yield from walk(c)
 

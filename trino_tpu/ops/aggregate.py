@@ -1,0 +1,255 @@
+"""Group-by aggregation kernels.
+
+Reference: Trino's HashAggregationOperator (operator/HashAggregationOperator.java:45)
+with GroupByHash picking a strategy by key shape (GroupByHash.java:82-93 —
+BigintGroupByHash vs FlatGroupByHash SWAR table), and compiled accumulators
+(operator/aggregation/AccumulatorCompiler.java:88).
+
+TPUs have no efficient pointer-chasing hash table, so the strategies are
+re-designed (SURVEY.md §7):
+
+- **direct**: when every group key is dictionary/boolean/small-domain, the
+  group id is a mixed-radix combination of codes and accumulators are a
+  dense [domain]-sized table updated with scatter-add — one XLA scatter per
+  aggregate, no hashing at all. (The analog of BigintGroupByHash's dense
+  small-range mode.)
+- **sort**: general keys: lexicographic multi-column `lax.sort` (dead rows
+  sorted last), segment boundaries by adjacent-difference, segment ids by
+  cumsum, then scatter-add into a bounded output table. Exact (no hash
+  collisions), static shapes throughout.
+
+Both paths produce *partial aggregate states* (sum/count/min/max); AVG is
+decomposed by the planner into (sum, count) and finalized host-side, exactly
+like Trino's PARTIAL -> FINAL split (HashAggregationOperator PARTIAL/FINAL
+steps). Partial states from different shards merge with `psum`/second-pass
+aggregation because every state is itself sum/min/max-mergeable.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..batch import Batch, Column
+
+# Aggregate functions and their merge ops. 'count' counts valid args;
+# 'count_star' counts live rows.
+AGG_FUNCS = ("sum", "count", "count_star", "min", "max")
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    func: str                 # one of AGG_FUNCS
+    arg_index: Optional[int]  # column in the input batch (None for count_star)
+
+    def __post_init__(self):
+        assert self.func in AGG_FUNCS, self.func
+        assert (self.arg_index is None) == (self.func == "count_star")
+
+
+def _identity(func: str, dtype) -> object:
+    if func == "sum" or func.startswith("count"):
+        return 0
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.inf if func == "min" else -jnp.inf
+    info = jnp.iinfo(dtype)
+    return info.max if func == "min" else info.min
+
+
+def _accumulate(spec: AggSpec, batch: Batch, gid: jax.Array,
+                contributes: jax.Array, out_capacity: int):
+    """Scatter one aggregate into a [out_capacity] table. Returns
+    (state, state_valid_count) where state_valid_count counts contributing
+    rows (used for NULL-ness of min/max/sum: empty group -> NULL)."""
+    if spec.func == "count_star":
+        mask = contributes
+        vals = mask.astype(jnp.int64)
+        init = jnp.zeros(out_capacity, dtype=jnp.int64)
+        state = init.at[gid].add(vals, mode="drop")
+        return state, state
+
+    col = batch.columns[spec.arg_index]
+    mask = contributes & col.valid
+    safe_gid = jnp.where(mask, gid, out_capacity)  # dropped when masked
+    cnt = jnp.zeros(out_capacity, dtype=jnp.int64
+                    ).at[safe_gid].add(1, mode="drop")
+    if spec.func == "count":
+        return cnt, cnt
+    data = col.data
+    if spec.func == "sum":
+        acc_dtype = jnp.int64 if jnp.issubdtype(data.dtype, jnp.integer) \
+            else data.dtype
+        init = jnp.zeros(out_capacity, dtype=acc_dtype)
+        state = init.at[safe_gid].add(data.astype(acc_dtype), mode="drop")
+        return state, cnt
+    ident = _identity(spec.func, data.dtype)
+    init = jnp.full(out_capacity, ident, dtype=data.dtype)
+    if spec.func == "min":
+        state = init.at[safe_gid].min(data, mode="drop")
+    else:
+        state = init.at[safe_gid].max(data, mode="drop")
+    return state, cnt
+
+
+# --------------------------------------------------------------------------
+# direct (dense small-domain) strategy
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def direct_group_aggregate(batch: Batch, key_indices: tuple,
+                           domains: tuple, aggs: tuple) -> Batch:
+    """Group by small-domain integer/dictionary keys.
+
+    domains[i] = exclusive upper bound of key column i's values (dictionary
+    size). Output has exactly prod(domains) rows; group g's keys decode as
+    mixed-radix digits of g. Groups with no rows are not live.
+    """
+    out_capacity = 1
+    for d in domains:
+        out_capacity *= d
+    gid = jnp.zeros(batch.capacity, dtype=jnp.int32)
+    key_valid = jnp.ones(batch.capacity, dtype=jnp.bool_)
+    for ki, d in zip(key_indices, domains):
+        col = batch.columns[ki]
+        gid = gid * d + jnp.clip(col.data.astype(jnp.int32), 0, d - 1)
+        key_valid = key_valid & col.valid
+    contributes = batch.live & key_valid
+    safe_gid = jnp.where(contributes, gid, out_capacity)
+
+    group_count = jnp.zeros(out_capacity, dtype=jnp.int64
+                            ).at[safe_gid].add(1, mode="drop")
+    group_live = group_count > 0
+
+    # decode keys from group index (mixed radix, most-significant first)
+    out_cols = []
+    g = jnp.arange(out_capacity, dtype=jnp.int32)
+    radix = out_capacity
+    for ki, d in zip(key_indices, domains):
+        radix //= d
+        digit = (g // radix) % d
+        out_cols.append(Column(
+            data=digit.astype(batch.columns[ki].data.dtype),
+            valid=group_live))
+    for spec in aggs:
+        state, cnt = _accumulate(spec, batch, safe_gid, contributes,
+                                 out_capacity)
+        if spec.func.startswith("count"):
+            valid = group_live
+        else:
+            valid = group_live & (cnt > 0)
+        out_cols.append(Column(data=state, valid=valid))
+    return Batch(columns=tuple(out_cols), live=group_live)
+
+
+# --------------------------------------------------------------------------
+# sort-based general strategy
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def sort_group_aggregate(batch: Batch, key_indices: tuple, aggs: tuple,
+                         out_capacity: int) -> Batch:
+    """Group by arbitrary key columns via lexicographic sort.
+
+    Exact (sorts real key values, not hashes). Output capacity is a static
+    bound; if the true group count exceeds it, excess groups are dropped —
+    callers size it from stats (DeterminePartitionCount-style) or use
+    revised bounds on overflow (executor re-plans, SURVEY.md §7 hard part 1).
+    NULL keys group together (SQL GROUP BY treats NULLs as equal).
+    """
+    n = batch.capacity
+    # sort keys: dead-rows-last flag, then (valid, data) per key column so
+    # NULLs form their own group, then original index as payload
+    operands = [(~batch.live).astype(jnp.int8)]
+    for ki in key_indices:
+        col = batch.columns[ki]
+        operands.append((~col.valid).astype(jnp.int8))
+        operands.append(col.data)
+    num_keys = len(operands)
+    operands.append(jnp.arange(n, dtype=jnp.int32))   # payload: row index
+    sorted_ops = jax.lax.sort(tuple(operands), num_keys=num_keys)
+    perm = sorted_ops[-1]
+    live_s = batch.live[perm]
+
+    diff = jnp.zeros(n, dtype=jnp.bool_)
+    for op in sorted_ops[:-1][1:]:  # skip dead-flag; keys only
+        diff = diff | (op != jnp.roll(op, 1))
+    first = jnp.arange(n) == 0
+    boundary = live_s & (first | diff)
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1      # 0-based group id
+    num_groups = boundary.sum()
+
+    # map group id back to each *original* row for scatter accumulation
+    gid_by_row = jnp.zeros(n, dtype=jnp.int32
+                           ).at[perm].set(seg.astype(jnp.int32))
+    contributes = batch.live
+    safe_gid = jnp.where(contributes, gid_by_row, out_capacity)
+
+    # representative source row for each group's key values
+    rep = jnp.full(out_capacity, 0, dtype=jnp.int32)
+    scatter_idx = jnp.where(boundary, seg, out_capacity)
+    rep = rep.at[scatter_idx].set(perm, mode="drop")
+    group_ids = jnp.arange(out_capacity)
+    group_live = group_ids < num_groups
+
+    out_cols = []
+    for ki in key_indices:
+        col = batch.columns[ki]
+        out_cols.append(Column(data=col.data[rep],
+                               valid=col.valid[rep] & group_live))
+    for spec in aggs:
+        state, cnt = _accumulate(spec, batch, safe_gid, contributes,
+                                 out_capacity)
+        if spec.func.startswith("count"):
+            valid = group_live
+        else:
+            valid = group_live & (cnt > 0)
+        out_cols.append(Column(data=state, valid=valid))
+    return Batch(columns=tuple(out_cols), live=group_live)
+
+
+# --------------------------------------------------------------------------
+# global (ungrouped) aggregation — Trino's AggregationOperator
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def global_aggregate(batch: Batch, aggs: tuple) -> Batch:
+    """No GROUP BY: one output row, always live (SQL: aggregates over an
+    empty input produce one row of NULLs / zero counts)."""
+    out_cols = []
+    one = jnp.ones(1, dtype=jnp.bool_)
+    gid = jnp.zeros(batch.capacity, dtype=jnp.int32)
+    for spec in aggs:
+        state, cnt = _accumulate(spec, batch, gid, batch.live, 1)
+        if spec.func.startswith("count"):
+            valid = one
+        else:
+            valid = cnt > 0
+        out_cols.append(Column(data=state, valid=valid))
+    return Batch(columns=tuple(out_cols), live=one)
+
+
+# --------------------------------------------------------------------------
+# host-side finalizers (AVG quotient etc.) — run on compacted outputs
+# --------------------------------------------------------------------------
+
+def avg_decimal_finalize(sums, counts, xp=np):
+    """Exact decimal AVG: round-half-away-from-zero of sum/count at the
+    input scale (Trino avg(decimal) keeps the argument scale).
+
+    Works with either numpy (host finalization) or jax.numpy (device, used
+    by the DecimalAvg IR node in ops/project.py) — single implementation so
+    the subtle signed-remainder rounding cannot drift between paths."""
+    counts = xp.where(counts == 0, 1, counts)
+    q = sums // counts
+    rem = sums - q * counts
+    # adjust toward zero first (floor for negatives), then round
+    neg = sums < 0
+    q = xp.where(neg & (rem != 0), q + 1, q)
+    rem = xp.where(neg, sums - q * counts, rem)
+    up = (2 * xp.abs(rem) >= counts).astype(xp.int64)
+    return xp.where(neg, q - up, q + up)

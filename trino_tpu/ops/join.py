@@ -1,0 +1,150 @@
+"""Join kernels.
+
+Reference: Trino's lookup join — HashBuilderOperator fills a PagesIndex and
+builds a JoinHash; LookupJoinOperator probes it per page
+(operator/join/unspilled/HashBuilderOperator.java:48,
+unspilled/LookupJoinOperator.java:41, PageJoiner.java:138).
+
+TPUs lack efficient pointer-chasing, so the build structure is a *sorted key
+array* and the probe is a vectorized binary search (`searchsorted`, which
+XLA lowers to a fully parallel per-lane search) — exact, static-shape, no
+hash collisions (SURVEY.md §7 "GroupBy/Join on TPU").
+
+Unique-build joins (key is a primary key: every TPC-H dimension join) have
+fan-out <= 1, so output capacity == probe capacity and everything stays on
+device. Duplicate-build joins report a duplicate count; the executor falls
+back to a host expansion join (the "conservative upper bounds with overflow
+spill to a host path" mitigation from SURVEY.md §7 hard part 1) until the
+device multi-match expansion lands.
+
+Multi-column equi-keys are packed into one int64 by the planner (key
+columns are bounded by table cardinalities, known from connector stats).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..batch import Batch, Column
+
+_SENTINEL = jnp.iinfo(jnp.int64).max
+
+
+def _combined_key(batch: Batch, key_indices: tuple) -> Tuple[jax.Array,
+                                                             jax.Array]:
+    """(key, key_valid) as int64. Multi-column keys pack 32 bits per
+    trailing column (key columns are table keys bounded well below 2^31;
+    the executor validates ranges host-side before taking this path)."""
+    col = batch.columns[key_indices[0]]
+    key = col.data.astype(jnp.int64)
+    valid = col.valid
+    for ki in key_indices[1:]:
+        c = batch.columns[ki]
+        key = key * (1 << 32) + c.data.astype(jnp.int64)
+        valid = valid & c.valid
+    return key, valid
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def join_unique_build(probe: Batch, build: Batch, probe_keys: tuple,
+                      build_keys: tuple, kind: str):
+    """Equi-join where the build side is unique on its key.
+
+    kind: 'inner' | 'left' | 'semi' | 'anti'.
+    Returns (out_batch, dup_count) where dup_count>0 means the uniqueness
+    assumption failed and the caller must re-run on the fallback path.
+    - inner/left: output = probe columns ++ build columns (gathered)
+    - semi/anti: output = probe columns, live-mask filtered
+    """
+    pk, pk_valid = _combined_key(probe, probe_keys)
+    bk, bk_valid = _combined_key(build, build_keys)
+
+    # dead or NULL-keyed build rows sort to +inf and never match
+    bk_eff = jnp.where(build.live & bk_valid, bk, _SENTINEL)
+    n_build = build.capacity
+    sorted_keys, order = jax.lax.sort((bk_eff, jnp.arange(
+        n_build, dtype=jnp.int32)), num_keys=1)
+
+    dup = jnp.sum((sorted_keys[1:] == sorted_keys[:-1]) &
+                  (sorted_keys[1:] != _SENTINEL))
+
+    pos = jnp.searchsorted(sorted_keys, pk)
+    pos_c = jnp.clip(pos, 0, n_build - 1)
+    matched = (sorted_keys[pos_c] == pk) & pk_valid & (pk != _SENTINEL)
+    src = order[pos_c]
+
+    if kind == "semi":
+        return probe.with_live(probe.live & matched), dup
+    if kind == "anti":
+        # NULL probe keys never match and never fail to match: SQL NOT IN
+        # semantics are handled by the planner (this is the semi-join
+        # complement used for correlated-exists rewrites)
+        return probe.with_live(probe.live & ~matched & pk_valid), dup
+
+    build_cols = []
+    for col in build.columns:
+        data = col.data[src]
+        valid = col.valid[src] & matched
+        build_cols.append(Column(data=data, valid=valid))
+    if kind == "inner":
+        live = probe.live & matched
+    else:  # left
+        live = probe.live
+    return Batch(columns=probe.columns + tuple(build_cols), live=live), dup
+
+
+def host_expansion_join(probe_arrays, probe_valids, probe_live,
+                        build_arrays, build_valids, build_live,
+                        probe_key_idx: int, build_key_idx: int,
+                        kind: str):
+    """Host numpy fallback for duplicate build keys (1:N fan-out).
+
+    The spill-to-host path: correct for any multiplicity; used until the
+    device two-pass expansion kernel lands. Returns (arrays, valids) for
+    probe ++ build columns, live rows only.
+    """
+    p_live = probe_live
+    b_live = build_live
+    pk = probe_arrays[probe_key_idx]
+    pk_ok = p_live & probe_valids[probe_key_idx]
+    bk = build_arrays[build_key_idx]
+    bk_ok = b_live & build_valids[build_key_idx]
+
+    b_idx = np.nonzero(bk_ok)[0]
+    order = b_idx[np.argsort(bk[b_idx], kind="stable")]
+    bk_sorted = bk[order]
+    lo = np.searchsorted(bk_sorted, pk, side="left")
+    hi = np.searchsorted(bk_sorted, pk, side="right")
+    counts = np.where(pk_ok, hi - lo, 0)
+
+    if kind == "semi":
+        keep = p_live & (counts > 0)
+        return ([a[keep] for a in probe_arrays],
+                [v[keep] for v in probe_valids])
+    if kind == "anti":
+        keep = p_live & (counts == 0) & probe_valids[probe_key_idx]
+        return ([a[keep] for a in probe_arrays],
+                [v[keep] for v in probe_valids])
+
+    if kind == "left":
+        out_counts = np.maximum(counts, p_live.astype(np.int64))
+    else:
+        out_counts = counts
+    probe_rows = np.repeat(np.arange(len(pk)), out_counts)
+    offsets = np.concatenate([[0], np.cumsum(out_counts)[:-1]])
+    within = np.arange(len(probe_rows)) - offsets[probe_rows]
+    matched = within < counts[probe_rows]
+    build_rows = np.where(
+        matched, order[np.clip(lo[probe_rows] + within, 0,
+                               max(len(order) - 1, 0))], 0)
+    arrays = [a[probe_rows] for a in probe_arrays]
+    valids = [v[probe_rows] for v in probe_valids]
+    for a, v in zip(build_arrays, build_valids):
+        arrays.append(np.where(matched, a[build_rows], 0))
+        valids.append(np.where(matched, v[build_rows], False))
+    return arrays, valids

@@ -267,6 +267,13 @@ def eval_expr(expr: ir.Expr, batch: Batch):
         codes = jnp.clip(d.astype(jnp.int32), 0, len(expr.lut) - 1)
         return lut[codes], v
 
+    if isinstance(expr, ir.DecimalAvg):
+        from .aggregate import avg_decimal_finalize
+        sd, sv = eval_expr(expr.sum, batch)
+        cd, cv = eval_expr(expr.count, batch)
+        res = avg_decimal_finalize(sd, cd, xp=jnp)
+        return res, sv & cv & (cd != 0)
+
     if isinstance(expr, ir.ExtractField):
         d, v = eval_expr(expr.arg, batch)
         year, month, day = civil_from_days(d)

@@ -1,0 +1,65 @@
+"""Sort / TopN / Limit kernels.
+
+Reference: OrderByOperator over a PagesIndex with compiled comparators
+(operator/OrderByOperator.java, sql/gen/OrderingCompiler.java:71) and
+TopNOperator (operator/topn/). Here: one multi-operand `lax.sort` whose key
+encoding bakes in direction and null placement, then a full-batch gather —
+XLA's sort is a parallel bitonic-style network that suits the TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..batch import Batch, Column
+
+
+def _sort_key_encoding(col: Column, ascending: bool, nulls_first: bool):
+    """Encode (valid, data) into operands whose ascending lexicographic
+    order realizes the requested direction + null placement."""
+    if nulls_first:
+        null_rank = jnp.where(col.valid, 1, 0)
+    else:
+        null_rank = jnp.where(col.valid, 0, 1)
+    data = col.data
+    if not ascending:
+        if jnp.issubdtype(data.dtype, jnp.bool_):
+            data = ~data
+        elif jnp.issubdtype(data.dtype, jnp.floating):
+            data = -data
+        else:
+            data = jnp.invert(data)   # order-reversing, overflow-safe
+    return null_rank.astype(jnp.int8), data
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def sort_batch(batch: Batch, keys: tuple, limit) -> Batch:
+    """keys: tuple of (col_index, ascending, nulls_first). Dead rows sort
+    last; an optional limit marks only the first `limit` rows live (TopN)."""
+    n = batch.capacity
+    operands = [(~batch.live).astype(jnp.int8)]
+    for (idx, asc, nf) in keys:
+        nr, data = _sort_key_encoding(batch.columns[idx], asc, nf)
+        operands.append(nr)
+        operands.append(data)
+    num_keys = len(operands)
+    operands.append(jnp.arange(n, dtype=jnp.int32))
+    sorted_ops = jax.lax.sort(tuple(operands), num_keys=num_keys)
+    perm = sorted_ops[-1]
+
+    cols = tuple(Column(data=c.data[perm], valid=c.valid[perm])
+                 for c in batch.columns)
+    live = batch.live[perm]
+    if limit is not None:
+        live = live & (jnp.arange(n) < limit)
+    return Batch(columns=cols, live=live)
+
+
+@jax.jit
+def limit_batch(batch: Batch, count: jax.Array) -> Batch:
+    """Keep the first `count` live rows (in current order)."""
+    rank = jnp.cumsum(batch.live.astype(jnp.int64)) - 1
+    return batch.with_live(batch.live & (rank < count))

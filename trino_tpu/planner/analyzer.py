@@ -1,0 +1,444 @@
+"""Analyzer: scopes, name resolution, and AST -> typed IR lowering.
+
+Reference: Trino splits this across Analyzer/ExpressionAnalyzer
+(sql/analyzer/Analyzer.java:47) producing an Analysis consumed by
+LogicalPlanner. We fuse analysis into planning (planner.py) and keep here
+the scope machinery and expression lowering, including the
+dictionary-predicate lowering that replaces Trino's LikeMatcher and slice
+comparisons for VARCHAR (strings never reach the device; SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from .. import ir
+from ..batch import Field
+from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, DataType, TypeKind,
+                     decimal)
+from ..sql import ast_nodes as A
+
+EPOCH = datetime.date(1970, 1, 1)
+
+
+class AnalysisError(Exception):
+    pass
+
+
+@dataclass
+class ScopeColumn:
+    qualifier: Optional[str]      # table alias (lower-case)
+    name: str                     # column name (lower-case)
+    dtype: DataType
+    index: int                    # position in the relation's output
+    field: Optional[Field] = None  # carries dictionary for VARCHAR
+
+
+class Scope:
+    def __init__(self, columns: List[ScopeColumn]):
+        self.columns = columns
+
+    def resolve(self, parts: Tuple[str, ...]) -> ScopeColumn:
+        parts = tuple(p.lower() for p in parts)
+        if len(parts) == 1:
+            matches = [c for c in self.columns if c.name == parts[0]]
+        elif len(parts) == 2:
+            matches = [c for c in self.columns
+                       if c.qualifier == parts[0] and c.name == parts[1]]
+        else:
+            raise AnalysisError(f"unsupported name {'.'.join(parts)}")
+        if not matches:
+            raise AnalysisError(f"column '{'.'.join(parts)}' not found")
+        if len(matches) > 1:
+            raise AnalysisError(f"column '{'.'.join(parts)}' is ambiguous")
+        return matches[0]
+
+    def try_resolve(self, parts) -> Optional[ScopeColumn]:
+        try:
+            return self.resolve(parts)
+        except AnalysisError:
+            return None
+
+
+AGG_NAMES = {"sum", "avg", "count", "min", "max"}
+
+
+def contains_aggregate(node: A.Node) -> bool:
+    if isinstance(node, A.FunctionCall) and node.name in AGG_NAMES:
+        return True
+    for child in ast_children(node):
+        if contains_aggregate(child):
+            return True
+    return False
+
+
+def ast_children(node: A.Node):
+    if isinstance(node, A.BinaryOp):
+        return (node.left, node.right)
+    if isinstance(node, A.UnaryOp):
+        return (node.arg,)
+    if isinstance(node, (A.IsNullPredicate,)):
+        return (node.arg,)
+    if isinstance(node, A.BetweenPredicate):
+        return (node.arg, node.low, node.high)
+    if isinstance(node, A.InPredicate):
+        return (node.arg,) + node.values
+    if isinstance(node, A.LikePredicate):
+        return (node.arg, node.pattern)
+    if isinstance(node, A.FunctionCall):
+        return node.args
+    if isinstance(node, A.CastExpr):
+        return (node.arg,)
+    if isinstance(node, A.ExtractExpr):
+        return (node.arg,)
+    if isinstance(node, A.CaseExpr):
+        out = [] if node.operand is None else [node.operand]
+        for c, v in node.whens:
+            out += [c, v]
+        if node.default is not None:
+            out.append(node.default)
+        return tuple(out)
+    return ()
+
+
+# --------------------------------------------------------------------------
+# literal typing & constant folding
+# --------------------------------------------------------------------------
+
+def number_literal(text: str) -> ir.Literal:
+    if "." not in text:
+        return ir.Literal(int(text), BIGINT)
+    intpart, frac = text.split(".")
+    scale = len(frac)
+    digits = (intpart + frac).lstrip("0") or "0"
+    value = int(intpart + frac) if intpart + frac else 0
+    return ir.Literal(value, decimal(max(len(digits), 1), scale))
+
+
+def date_literal(iso: str) -> ir.Literal:
+    d = datetime.date.fromisoformat(iso)
+    return ir.Literal((d - EPOCH).days, DATE)
+
+
+def add_months(d: datetime.date, n: int) -> datetime.date:
+    y, m0 = divmod(d.year * 12 + d.month - 1 + n, 12)
+    last = [31, 29 if y % 4 == 0 and (y % 100 != 0 or y % 400 == 0) else 28,
+            31, 30, 31, 30, 31, 31, 30, 31, 30, 31][m0]
+    return datetime.date(y, m0 + 1, min(d.day, last))
+
+
+def fold_date_interval(base_days: int, interval: A.IntervalLit,
+                       subtract: bool) -> int:
+    n = -interval.value if (interval.negative != subtract) else interval.value
+    base = EPOCH + datetime.timedelta(days=base_days)
+    if interval.unit == "day":
+        return base_days + n
+    months = n * (12 if interval.unit == "year" else 1)
+    return (add_months(base, months) - EPOCH).days
+
+
+# --------------------------------------------------------------------------
+# LIKE -> regex over dictionary pool
+# --------------------------------------------------------------------------
+
+def like_to_regex(pattern: str, escape: Optional[str]) -> re.Pattern:
+    out = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if escape and ch == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return re.compile("".join(out), re.DOTALL)
+
+
+# --------------------------------------------------------------------------
+# expression lowering
+# --------------------------------------------------------------------------
+
+class ExpressionLowerer:
+    """Lowers an AST expression (no aggregates) to typed IR over a scope."""
+
+    def __init__(self, scope: Scope):
+        self.scope = scope
+
+    def lower(self, node: A.Node) -> ir.Expr:
+        if isinstance(node, A.Identifier):
+            col = self.scope.resolve(node.parts)
+            return ir.ColumnRef(col.index, col.dtype, col.name)
+        if isinstance(node, A.NumberLit):
+            return number_literal(node.text)
+        if isinstance(node, A.StringLit):
+            # bare string literal: only meaningful against dictionary
+            # columns; handled contextually below. Standalone -> error when
+            # it reaches device lowering.
+            return _StringConst(node.value)
+        if isinstance(node, A.BoolLit):
+            return ir.Literal(node.value, BOOLEAN)
+        if isinstance(node, A.NullLit):
+            return ir.Literal(None, BIGINT)
+        if isinstance(node, A.DateLit):
+            return date_literal(node.value)
+        if isinstance(node, A.IntervalLit):
+            raise AnalysisError(
+                "INTERVAL literal only supported in date +/- INTERVAL")
+
+        if isinstance(node, A.BinaryOp):
+            return self.lower_binary(node)
+        if isinstance(node, A.UnaryOp):
+            if node.op == "not":
+                return ir.Not(self.to_bool(self.lower(node.arg)))
+            arg = self.lower(node.arg)
+            if node.op == "-":
+                if isinstance(arg, ir.Literal):
+                    return ir.Literal(-arg.value if arg.value is not None
+                                      else None, arg.dtype)
+                return ir.Negate(arg, arg.dtype)
+            return arg
+
+        if isinstance(node, A.IsNullPredicate):
+            return ir.IsNull(self.lower(node.arg), negated=node.negated)
+
+        if isinstance(node, A.BetweenPredicate):
+            arg = self.lower(node.arg)
+            low = self.lower(node.low)
+            high = self.lower(node.high)
+            if arg.dtype.kind is TypeKind.VARCHAR and (
+                    isinstance(low, _StringConst) or
+                    isinstance(high, _StringConst)):
+                pred = self.dict_range(arg, low, high)
+            else:
+                low = self.coerce_const(low, arg)
+                high = self.coerce_const(high, arg)
+                pred = ir.Between(arg, low, high)
+            return ir.Not(pred) if node.negated else pred
+
+        if isinstance(node, A.InPredicate):
+            arg = self.lower(node.arg)
+            vals = [self.lower(v) for v in node.values]
+            if arg.dtype.kind is TypeKind.VARCHAR:
+                strings = {v.value for v in vals
+                           if isinstance(v, _StringConst)}
+                if len(strings) != len(vals):
+                    raise AnalysisError("IN on varchar requires string "
+                                        "literals")
+                pred = self.dict_lut(arg, lambda s: s in strings)
+            else:
+                lits = []
+                for v in vals:
+                    v = self.coerce_const(v, arg)
+                    if not isinstance(v, ir.Literal):
+                        raise AnalysisError("IN requires literal values")
+                    lits.append(v)
+                pred = ir.InList(arg, tuple(lits))
+            return ir.Not(pred) if node.negated else pred
+
+        if isinstance(node, A.LikePredicate):
+            arg = self.lower(node.arg)
+            if arg.dtype.kind is not TypeKind.VARCHAR:
+                raise AnalysisError("LIKE requires a varchar argument")
+            if not isinstance(node.pattern, A.StringLit):
+                raise AnalysisError("LIKE pattern must be a literal")
+            escape = None
+            if node.escape is not None:
+                if not isinstance(node.escape, A.StringLit):
+                    raise AnalysisError("ESCAPE must be a literal")
+                escape = node.escape.value
+            rx = like_to_regex(node.pattern.value, escape)
+            pred = self.dict_lut(arg, lambda s: rx.fullmatch(s) is not None)
+            return ir.Not(pred) if node.negated else pred
+
+        if isinstance(node, A.CaseExpr):
+            return self.lower_case(node)
+
+        if isinstance(node, A.CastExpr):
+            arg = self.lower(node.arg)
+            target = parse_type(node.type_name)
+            if isinstance(arg, _StringConst):
+                return self.cast_string_const(arg, target)
+            return ir.Cast(arg, target)
+
+        if isinstance(node, A.ExtractExpr):
+            arg = self.lower(node.arg)
+            if arg.dtype.kind is not TypeKind.DATE:
+                raise AnalysisError("EXTRACT requires a date argument")
+            return ir.ExtractField(node.part, arg)
+
+        if isinstance(node, A.FunctionCall):
+            if node.name in AGG_NAMES:
+                raise AnalysisError(
+                    f"aggregate {node.name}() not allowed here")
+            raise AnalysisError(f"unsupported function {node.name}()")
+
+        raise AnalysisError(f"unsupported expression {type(node).__name__}")
+
+    # ---- helpers ----------------------------------------------------------
+
+    def to_bool(self, e: ir.Expr) -> ir.Expr:
+        if e.dtype.kind is not TypeKind.BOOLEAN:
+            raise AnalysisError("expected boolean expression")
+        return e
+
+    def lower_binary(self, node: A.BinaryOp) -> ir.Expr:
+        op = node.op
+        if op in ("and", "or"):
+            return ir.Logical(op, (self.to_bool(self.lower(node.left)),
+                                   self.to_bool(self.lower(node.right))))
+        if op in ("=", "<>", "<", "<=", ">", ">="):
+            left = self.lower(node.left)
+            right = self.lower(node.right)
+            if isinstance(left, _StringConst) and \
+                    right.dtype.kind is TypeKind.VARCHAR:
+                return self.dict_compare(right, flip(op), left.value)
+            if isinstance(right, _StringConst) and \
+                    left.dtype.kind is TypeKind.VARCHAR:
+                return self.dict_compare(left, op, right.value)
+            if isinstance(left, _StringConst) or \
+                    isinstance(right, _StringConst):
+                raise AnalysisError("string comparison requires a varchar "
+                                    "column side")
+            return ir.Compare(op, left, right)
+        if op in ("+", "-"):
+            # date +/- interval folds at plan time for literal dates,
+            # lowers to day arithmetic for day intervals on columns
+            if isinstance(node.right, A.IntervalLit):
+                left = self.lower(node.left)
+                iv = node.right
+                if isinstance(left, ir.Literal) and \
+                        left.dtype.kind is TypeKind.DATE:
+                    return ir.Literal(
+                        fold_date_interval(left.value, iv, op == "-"),
+                        DATE)
+                if left.dtype.kind is TypeKind.DATE and iv.unit == "day":
+                    n = -iv.value if (iv.negative != (op == "-")) \
+                        else iv.value
+                    return ir.arith("+", left, ir.Literal(n, BIGINT))
+                raise AnalysisError(
+                    "month/year intervals only fold against date literals")
+        if op in ("+", "-", "*", "/", "%"):
+            left = self.lower(node.left)
+            right = self.lower(node.right)
+            if op == "%":
+                raise AnalysisError("modulo not yet supported")
+            return ir.arith(op, left, right)
+        raise AnalysisError(f"unsupported operator {op!r}")
+
+    def lower_case(self, node: A.CaseExpr) -> ir.Expr:
+        whens = []
+        for cond_ast, val_ast in node.whens:
+            if node.operand is not None:
+                cond_ast = A.BinaryOp("=", node.operand, cond_ast)
+            whens.append((self.to_bool(self.lower(cond_ast)),
+                          self.lower(val_ast)))
+        default = None if node.default is None else self.lower(node.default)
+        # result type: common super type of branch values
+        vals = [v for _, v in whens] + ([default] if default else [])
+        out_t = vals[0].dtype
+        for v in vals[1:]:
+            from ..types import common_super_type
+            out_t = common_super_type(out_t, v.dtype)
+        whens = tuple((c, self.coerce_to(v, out_t)) for c, v in whens)
+        default = self.coerce_to(default, out_t) if default else None
+        return ir.Case(whens, default, out_t)
+
+    def coerce_to(self, e: ir.Expr, t: DataType) -> ir.Expr:
+        if e.dtype == t:
+            return e
+        return ir.Cast(e, t)
+
+    def coerce_const(self, e: ir.Expr, like: ir.Expr) -> ir.Expr:
+        """Coerce literal to the column's type (e.g. decimal rescale)."""
+        if isinstance(e, _StringConst):
+            raise AnalysisError("cannot compare string to non-varchar")
+        return e
+
+    def cast_string_const(self, s: "_StringConst", t: DataType) -> ir.Expr:
+        if t.kind is TypeKind.DATE:
+            return date_literal(s.value)
+        if t.kind is TypeKind.DECIMAL:
+            return ir.Literal(
+                int(round(float(s.value) * 10 ** t.scale)), t)
+        if t.kind in (TypeKind.BIGINT, TypeKind.INTEGER):
+            return ir.Literal(int(s.value), t)
+        if t.kind is TypeKind.DOUBLE:
+            return ir.Literal(float(s.value), t)
+        raise AnalysisError(f"cannot cast string literal to {t}")
+
+    # ---- dictionary predicates --------------------------------------------
+
+    def pool_of(self, col: ir.Expr) -> tuple:
+        if not isinstance(col, ir.ColumnRef):
+            raise AnalysisError("varchar predicate requires a plain column")
+        sc = next(c for c in self.scope.columns if c.index == col.index
+                  and c.dtype.kind is TypeKind.VARCHAR)
+        if sc.field is None or sc.field.dictionary is None:
+            raise AnalysisError(f"column {sc.name} has no dictionary")
+        return sc.field.dictionary
+
+    def dict_lut(self, col: ir.Expr, pred) -> ir.Expr:
+        pool = self.pool_of(col)
+        return ir.DictPredicate(col, tuple(bool(pred(s)) for s in pool))
+
+    def dict_compare(self, col: ir.Expr, op: str, s: str) -> ir.Expr:
+        ops = {"=": lambda x: x == s, "<>": lambda x: x != s,
+               "<": lambda x: x < s, "<=": lambda x: x <= s,
+               ">": lambda x: x > s, ">=": lambda x: x >= s}
+        return self.dict_lut(col, ops[op])
+
+    def dict_range(self, col: ir.Expr, low, high) -> ir.Expr:
+        lo = low.value if isinstance(low, _StringConst) else None
+        hi = high.value if isinstance(high, _StringConst) else None
+        if lo is None or hi is None:
+            raise AnalysisError("varchar BETWEEN requires string literals")
+        return self.dict_lut(col, lambda x: lo <= x <= hi)
+
+
+@dataclass(frozen=True)
+class _StringConst(ir.Expr):
+    """Pre-lowering marker for string literals; must be consumed by a
+    dictionary predicate before reaching the device."""
+    value: str
+
+    @property
+    def dtype(self):
+        raise AnalysisError(
+            f"string literal {self.value!r} used outside a varchar "
+            f"comparison context")
+
+
+def flip(op: str) -> str:
+    return {"=": "=", "<>": "<>", "<": ">", "<=": ">=",
+            ">": "<", ">=": "<="}[op]
+
+
+def parse_type(name: str) -> DataType:
+    name = name.lower()
+    if name in ("bigint",):
+        return BIGINT
+    if name in ("integer", "int", "smallint", "tinyint"):
+        from ..types import INTEGER
+        return INTEGER
+    if name == "double":
+        return DOUBLE
+    if name == "boolean":
+        return BOOLEAN
+    if name == "date":
+        return DATE
+    m = re.fullmatch(r"decimal\((\d+),(\d+)\)", name)
+    if m:
+        return decimal(int(m.group(1)), int(m.group(2)))
+    if name == "varchar":
+        from ..types import VARCHAR
+        return VARCHAR
+    raise AnalysisError(f"unknown type {name}")

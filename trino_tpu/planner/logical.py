@@ -1,0 +1,155 @@
+"""Logical plan nodes.
+
+Reference: Trino's 66 PlanNode kinds (core/trino-main/.../sql/planner/plan/).
+We model the executed subset; each node's `output` is an ordered list of
+(name, DataType) pairs, and expressions reference child output columns by
+position (like Trino's Symbol-resolved plans, but positional — a deliberate
+simplification that suits array programs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from .. import ir
+from ..batch import Schema
+from ..types import DataType
+
+
+@dataclass(frozen=True)
+class PlanNode:
+    pass
+
+
+@dataclass(frozen=True)
+class ScanNode(PlanNode):
+    """TableScanNode (sql/planner/plan/TableScanNode.java) — reads a
+    connector table; column pruning happens via `column_indices`."""
+    catalog: str
+    schema_name: str
+    table: str
+    table_schema: Schema              # full connector schema
+    column_indices: Tuple[int, ...]   # which connector columns we read
+    output: Tuple                     # ((name, DataType), ...)
+
+
+@dataclass(frozen=True)
+class FilterNode(PlanNode):
+    child: PlanNode
+    predicate: ir.Expr
+    output: Tuple
+
+
+@dataclass(frozen=True)
+class ProjectNode(PlanNode):
+    child: PlanNode
+    exprs: Tuple                      # tuple[ir.Expr, ...]
+    output: Tuple
+
+
+@dataclass(frozen=True)
+class AggSpecNode:
+    func: str                         # sum|count|count_star|min|max|avg
+    arg: Optional[ir.Expr]            # over child output
+    out_name: str
+    out_dtype: DataType
+    distinct: bool = False
+
+
+@dataclass(frozen=True)
+class AggregateNode(PlanNode):
+    """AggregationNode; group_keys are child output column indices.
+    `strategy` chosen by the optimizer: 'direct' (dense dict-code domain),
+    'sort' (general), or 'global' (no keys)."""
+    child: PlanNode
+    group_keys: Tuple[int, ...]
+    aggs: Tuple                       # tuple[AggSpecNode, ...]
+    strategy: str
+    key_domains: Tuple[int, ...]      # for 'direct'
+    out_capacity: int                 # for 'sort'
+    output: Tuple
+
+
+@dataclass(frozen=True)
+class JoinNode(PlanNode):
+    """JoinNode (sql/planner/plan/JoinNode.java). Equi-join; left side is
+    the probe, right side the build (LookupJoinOperator convention:
+    HashBuilderOperator consumes the build side)."""
+    kind: str                         # inner|left|semi|anti
+    left: PlanNode                    # probe
+    right: PlanNode                   # build
+    left_keys: Tuple[int, ...]
+    right_keys: Tuple[int, ...]
+    residual: Optional[ir.Expr]       # over concatenated output
+    build_unique: bool                # planner's guarantee/assumption
+    output: Tuple
+
+
+@dataclass(frozen=True)
+class SortKey:
+    index: int
+    ascending: bool
+    nulls_first: bool
+
+
+@dataclass(frozen=True)
+class SortNode(PlanNode):
+    child: PlanNode
+    keys: Tuple                       # tuple[SortKey, ...]
+    limit: Optional[int]              # TopN fusion (TopNOperator)
+    output: Tuple
+
+
+@dataclass(frozen=True)
+class LimitNode(PlanNode):
+    child: PlanNode
+    count: int
+    output: Tuple
+
+
+@dataclass(frozen=True)
+class OutputNode(PlanNode):
+    """Root: names the result columns (sql/planner/plan/OutputNode.java)."""
+    child: PlanNode
+    names: Tuple[str, ...]
+    output: Tuple
+
+
+def children(node: PlanNode):
+    if isinstance(node, (FilterNode, ProjectNode, AggregateNode, SortNode,
+                         LimitNode, OutputNode)):
+        return (node.child,)
+    if isinstance(node, JoinNode):
+        return (node.left, node.right)
+    return ()
+
+
+def explain_text(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN rendering (textual plan like Trino's PlanPrinter)."""
+    pad = "  " * indent
+    if isinstance(node, ScanNode):
+        cols = ", ".join(n for n, _ in node.output)
+        line = (f"{pad}TableScan[{node.catalog}.{node.schema_name}."
+                f"{node.table}] -> [{cols}]")
+    elif isinstance(node, FilterNode):
+        line = f"{pad}Filter[{node.predicate}]"
+    elif isinstance(node, ProjectNode):
+        line = f"{pad}Project[{', '.join(n for n, _ in node.output)}]"
+    elif isinstance(node, AggregateNode):
+        aggs = ", ".join(f"{a.func}({a.out_name})" for a in node.aggs)
+        line = (f"{pad}Aggregate[{node.strategy}, keys="
+                f"{list(node.group_keys)}, {aggs}]")
+    elif isinstance(node, JoinNode):
+        line = (f"{pad}Join[{node.kind}, probe={list(node.left_keys)}, "
+                f"build={list(node.right_keys)}]")
+    elif isinstance(node, SortNode):
+        line = f"{pad}{'TopN' if node.limit else 'Sort'}[{len(node.keys)} keys]"
+    elif isinstance(node, LimitNode):
+        line = f"{pad}Limit[{node.count}]"
+    elif isinstance(node, OutputNode):
+        line = f"{pad}Output[{', '.join(node.names)}]"
+    else:
+        line = f"{pad}{type(node).__name__}"
+    return "\n".join([line] + [explain_text(c, indent + 1)
+                               for c in children(node)])

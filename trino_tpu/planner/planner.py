@@ -1,0 +1,623 @@
+"""Logical planner: analyzed AST -> logical plan.
+
+Reference: LogicalPlanner/QueryPlanner/RelationPlanner
+(sql/planner/LogicalPlanner.java:231) plus the subset of optimizer behavior
+that is load-bearing for TPC-H:
+
+- predicate pushdown: WHERE conjuncts applied at the earliest relation where
+  all referenced columns exist (PredicatePushDown.java's effect)
+- join graph: comma/cross joins + equi-conjuncts assembled into a left-deep
+  join tree in FROM order; probe/build orientation chosen so the build side
+  is unique on its keys when provable from primary keys
+  (DetermineJoinDistributionType.java:51's role, driven by PK metadata
+  instead of stats for now)
+- aggregate extraction: distinct aggregate calls become AggregateNode slots;
+  AVG decomposes into SUM+COUNT with an exact finalizer projection
+  (HashAggregationOperator PARTIAL/FINAL + AccumulatorCompiler's job)
+- aggregation strategy choice: dense 'direct' when all keys are
+  dictionary-coded with a small domain product, else 'sort'
+  (GroupByHash.createGroupByHash's Bigint-vs-Flat decision, re-targeted)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from .. import ir
+from ..batch import Schema
+from ..catalog import Catalog
+from ..sql import ast_nodes as A
+from ..types import BIGINT, DOUBLE, DataType, TypeKind
+from . import logical as L
+from .analyzer import (AGG_NAMES, AnalysisError, ExpressionLowerer, Scope,
+                       ScopeColumn, ast_children, contains_aggregate,
+                       parse_type)
+
+MAX_DIRECT_GROUPS = 4096         # dense-domain aggregation cutoff
+DEFAULT_SORT_GROUPS = 1 << 16    # sort-agg output capacity default
+
+
+@dataclass
+class PlannedRelation:
+    node: L.PlanNode
+    scope: Scope
+
+
+class Planner:
+    def __init__(self, catalog: Catalog, default_catalog: str = "tpch",
+                 default_schema: str = "tiny"):
+        self.catalog = catalog
+        self.default_catalog = default_catalog
+        self.default_schema = default_schema
+
+    # ------------------------------------------------------------------
+    # relations
+    # ------------------------------------------------------------------
+
+    def plan_table(self, ref: A.TableRef) -> PlannedRelation:
+        parts = [p.lower() for p in ref.name]
+        if len(parts) == 3:
+            cat, sch, tbl = parts
+        elif len(parts) == 2:
+            cat, (sch, tbl) = self.default_catalog, parts
+        else:
+            cat, sch, tbl = self.default_catalog, self.default_schema, \
+                parts[0]
+        data = self.catalog.get_table(cat, sch, tbl)
+        schema: Schema = data.schema
+        qualifier = (ref.alias or tbl).lower()
+        output = tuple((f.name, f.dtype) for f in schema)
+        node = L.ScanNode(cat, sch, tbl, schema,
+                          tuple(range(len(schema.fields))), output)
+        cols = [ScopeColumn(qualifier, f.name.lower(), f.dtype, i, f)
+                for i, f in enumerate(schema.fields)]
+        return PlannedRelation(node, Scope(cols))
+
+    def plan_relation_tree(self, rel: A.Node) -> Tuple[List[PlannedRelation],
+                                                       List[A.Node]]:
+        """Flatten the FROM tree into base relations + ON conjuncts."""
+        relations: List[PlannedRelation] = []
+        conjuncts: List[A.Node] = []
+
+        def walk(node: A.Node):
+            if isinstance(node, A.TableRef):
+                relations.append(self.plan_table(node))
+            elif isinstance(node, A.SubqueryRef):
+                sub = self.plan_query(node.query)
+                alias = node.alias.lower()
+                cols = [ScopeColumn(alias, name.lower(), dtype, i, fld)
+                        for i, ((name, dtype), fld) in enumerate(
+                            zip(sub.node.output, sub_fields(sub)))]
+                relations.append(PlannedRelation(sub.node.child
+                                                 if isinstance(sub.node,
+                                                               L.OutputNode)
+                                                 else sub.node,
+                                                 Scope(cols)))
+            elif isinstance(node, A.Join):
+                if node.kind not in ("inner", "cross", "left"):
+                    raise AnalysisError(
+                        f"{node.kind} join not yet supported")
+                if node.kind == "left":
+                    # left joins keep tree structure: handled pairwise
+                    left = self.combine_relations(*self.subtree(node.left))
+                    right = self.combine_relations(*self.subtree(node.right))
+                    relations.append(self.plan_left_join(left, right,
+                                                         node.condition))
+                    return
+                walk(node.left)
+                walk(node.right)
+                if node.condition is not None:
+                    split_conjuncts(node.condition, conjuncts)
+            else:
+                raise AnalysisError(
+                    f"unsupported relation {type(node).__name__}")
+
+        walk(rel)
+        return relations, conjuncts
+
+    def subtree(self, node: A.Node):
+        rels, conj = self.plan_relation_tree(node)
+        return rels, conj
+
+    def combine_relations(self, relations, conjuncts) -> PlannedRelation:
+        if len(relations) == 1 and not conjuncts:
+            return relations[0]
+        return self.build_join_tree(relations, list(conjuncts))
+
+    # ------------------------------------------------------------------
+    # join tree assembly
+    # ------------------------------------------------------------------
+
+    def build_join_tree(self, relations: List[PlannedRelation],
+                        conjuncts: List[A.Node]) -> PlannedRelation:
+        """Left-deep join in FROM order; equi-conjuncts become join keys,
+        single-relation conjuncts push down, leftovers become filters."""
+        acc = relations[0]
+        acc = self.apply_local_filters(acc, conjuncts)
+        for nxt in relations[1:]:
+            nxt = self.apply_local_filters(nxt, conjuncts)
+            acc = self.join_pair(acc, nxt, conjuncts, kind="inner")
+            acc = self.apply_local_filters(acc, conjuncts)
+        return acc
+
+    def apply_local_filters(self, rel: PlannedRelation,
+                            conjuncts: List[A.Node]) -> PlannedRelation:
+        """Push down any pending conjunct fully resolvable in this scope."""
+        applied = []
+        preds = []
+        for c in conjuncts:
+            lowerer = ExpressionLowerer(rel.scope)
+            try:
+                preds.append(lowerer.to_bool(lowerer.lower(c)))
+                applied.append(c)
+            except AnalysisError:
+                continue
+        for c in applied:
+            conjuncts.remove(c)
+        if not preds:
+            return rel
+        pred = preds[0] if len(preds) == 1 else ir.Logical(
+            "and", tuple(preds))
+        node = L.FilterNode(rel.node, pred, rel.node.output)
+        return PlannedRelation(node, rel.scope)
+
+    def join_pair(self, left: PlannedRelation, right: PlannedRelation,
+                  conjuncts: List[A.Node], kind: str) -> PlannedRelation:
+        """Extract equi-conjuncts linking left & right; orient probe/build."""
+        left_keys: List[int] = []
+        right_keys: List[int] = []
+        used: List[A.Node] = []
+        for c in conjuncts:
+            eq = as_equi(c)
+            if eq is None:
+                continue
+            a, b = eq
+            la = left.scope.try_resolve(a)
+            rb = right.scope.try_resolve(b)
+            if la is not None and rb is not None:
+                left_keys.append(la.index)
+                right_keys.append(rb.index)
+                used.append(c)
+                continue
+            lb = left.scope.try_resolve(b)
+            ra = right.scope.try_resolve(a)
+            if lb is not None and ra is not None:
+                left_keys.append(lb.index)
+                right_keys.append(ra.index)
+                used.append(c)
+        for c in used:
+            conjuncts.remove(c)
+        if not left_keys:
+            raise AnalysisError(
+                "cross join without equi-condition not yet supported")
+
+        # orientation: build side must be unique on its keys if provable
+        right_unique = self.is_unique(right, right_keys)
+        left_unique = self.is_unique(left, left_keys)
+        if right_unique or not left_unique:
+            probe, build = left, right
+            probe_keys, build_keys = left_keys, right_keys
+            build_unique = right_unique
+        else:
+            probe, build = right, left
+            probe_keys, build_keys = right_keys, left_keys
+            build_unique = left_unique
+
+        output = tuple(probe.node.output) + tuple(build.node.output)
+        node = L.JoinNode(kind, probe.node, build.node,
+                          tuple(probe_keys), tuple(build_keys), None,
+                          build_unique, output)
+        n_left = len(probe.node.output)
+        cols = list(probe.scope.columns) + [
+            ScopeColumn(c.qualifier, c.name, c.dtype, c.index + n_left,
+                        c.field) for c in build.scope.columns]
+        return PlannedRelation(node, Scope(cols))
+
+    def plan_left_join(self, left: PlannedRelation, right: PlannedRelation,
+                       condition: Optional[A.Node]) -> PlannedRelation:
+        conjuncts: List[A.Node] = []
+        if condition is not None:
+            split_conjuncts(condition, conjuncts)
+        rel = self.join_pair(left, right, conjuncts, kind="left")
+        if conjuncts:
+            raise AnalysisError("non-equi LEFT JOIN condition unsupported")
+        return rel
+
+    def is_unique(self, rel: PlannedRelation, key_indices: List[int]) -> bool:
+        """True if the relation is provably unique on these columns
+        (primary-key containment through scans and filters)."""
+        node = rel.node
+        while isinstance(node, (L.FilterNode, L.ProjectNode)):
+            if isinstance(node, L.ProjectNode):
+                return False  # conservatively
+            node = node.child
+        if not isinstance(node, L.ScanNode):
+            return False
+        data = self.catalog.get_table(node.catalog, node.schema_name,
+                                      node.table)
+        if not data.primary_key:
+            return False
+        key_names = {rel.node.output[i][0].lower() for i in key_indices}
+        return set(k.lower() for k in data.primary_key) <= key_names
+
+    # ------------------------------------------------------------------
+    # query planning
+    # ------------------------------------------------------------------
+
+    def plan_query(self, q: A.Query) -> PlannedRelation:
+        if q.relation is None:
+            raise AnalysisError("SELECT without FROM not yet supported")
+        relations, on_conjuncts = self.plan_relation_tree(q.relation)
+
+        conjuncts: List[A.Node] = list(on_conjuncts)
+        if q.where is not None:
+            split_conjuncts(q.where, conjuncts)
+
+        if len(relations) == 1:
+            rel = self.apply_local_filters(relations[0], conjuncts)
+        else:
+            rel = self.build_join_tree(relations, conjuncts)
+        if conjuncts:
+            raise AnalysisError(
+                f"unplaced predicate(s): {conjuncts}")
+
+        has_agg = any(contains_aggregate(i.expr) for i in q.select
+                      if i.expr is not None) or q.group_by or \
+            (q.having is not None)
+
+        if has_agg:
+            rel, select_scope_exprs, names = self.plan_aggregation(q, rel)
+        else:
+            rel, select_scope_exprs, names = self.plan_plain_select(q, rel)
+
+        # DISTINCT via group-by-all-columns (Trino rewrites the same way)
+        if q.distinct:
+            node = rel.node
+            ncols = len(node.output)
+            rel = PlannedRelation(
+                L.AggregateNode(node, tuple(range(ncols)), (), "sort", (),
+                                DEFAULT_SORT_GROUPS, node.output),
+                rel.scope)
+
+        # ORDER BY over the select output scope (+ alias resolution)
+        if q.order_by:
+            keys = []
+            for item in q.order_by:
+                idx = self.resolve_order_expr(item.expr, q, rel, names)
+                nulls_first = item.nulls_first
+                if nulls_first is None:
+                    nulls_first = not item.ascending   # Trino default
+                keys.append(L.SortKey(idx, item.ascending, nulls_first))
+            rel = PlannedRelation(
+                L.SortNode(rel.node, tuple(keys), q.limit, rel.node.output),
+                rel.scope)
+        elif q.limit is not None:
+            rel = PlannedRelation(
+                L.LimitNode(rel.node, q.limit, rel.node.output), rel.scope)
+
+        out = L.OutputNode(rel.node, tuple(names), rel.node.output)
+        return PlannedRelation(out, rel.scope)
+
+    # ---- plain select -----------------------------------------------------
+
+    def expand_star(self, q: A.Query, scope: Scope):
+        items = []
+        for item in q.select:
+            if item.expr is None:
+                qual = None
+                if item.star_qualifier:
+                    qual = item.star_qualifier[-1].lower()
+                for c in scope.columns:
+                    if qual is None or c.qualifier == qual:
+                        items.append((A.Identifier((c.qualifier, c.name)),
+                                      c.name))
+            else:
+                name = item.alias or default_name(item.expr)
+                items.append((item.expr, name.lower()))
+        return items
+
+    def plan_plain_select(self, q: A.Query, rel: PlannedRelation):
+        items = self.expand_star(q, rel.scope)
+        lowerer = ExpressionLowerer(rel.scope)
+        exprs = []
+        names = []
+        out_cols = []
+        new_scope = []
+        for i, (ast, name) in enumerate(items):
+            e = lowerer.lower(ast)
+            exprs.append(e)
+            names.append(name)
+            out_cols.append((name, e.dtype))
+            fld = self.field_for(e, rel.scope)
+            new_scope.append(ScopeColumn(None, name, e.dtype, i, fld))
+        node = L.ProjectNode(rel.node, tuple(exprs), tuple(out_cols))
+        return PlannedRelation(node, Scope(new_scope)), exprs, names
+
+    def field_for(self, e: ir.Expr, scope: Scope):
+        """Propagate dictionary fields through bare column projections."""
+        if isinstance(e, ir.ColumnRef) and \
+                e.dtype.kind is TypeKind.VARCHAR:
+            for c in scope.columns:
+                if c.index == e.index and c.dtype.kind is TypeKind.VARCHAR:
+                    return c.field
+        return None
+
+    # ---- aggregation ------------------------------------------------------
+
+    def plan_aggregation(self, q: A.Query, rel: PlannedRelation):
+        scope = rel.scope
+        lowerer = ExpressionLowerer(scope)
+
+        group_asts = list(q.group_by)
+        group_irs = [lowerer.lower(resolve_ordinal(g, q)) for g in group_asts]
+
+        # collect distinct aggregate calls across select/having/order
+        agg_calls: List[A.FunctionCall] = []
+
+        def collect(node: A.Node):
+            if isinstance(node, A.FunctionCall) and node.name in AGG_NAMES:
+                if node not in agg_calls:
+                    agg_calls.append(node)
+                return
+            for ch in ast_children(node):
+                collect(ch)
+
+        for item in q.select:
+            if item.expr is not None:
+                collect(item.expr)
+        if q.having is not None:
+            collect(q.having)
+        for o in q.order_by:
+            collect(o.expr)
+
+        # pre-projection: group keys then agg args
+        pre_exprs: List[ir.Expr] = list(group_irs)
+        pre_cols: List[Tuple[str, DataType]] = [
+            (f"gk{i}", e.dtype) for i, e in enumerate(group_irs)]
+        agg_specs: List[L.AggSpecNode] = []
+        # map from agg call -> (post-agg expression builder)
+        call_slots: Dict[A.FunctionCall, Tuple[str, int, int]] = {}
+
+        def add_arg(e: ir.Expr) -> int:
+            pre_exprs.append(e)
+            pre_cols.append((f"a{len(pre_exprs)}", e.dtype))
+            return len(pre_exprs) - 1
+
+        n_keys = len(group_irs)
+        for call in agg_calls:
+            if call.distinct:
+                raise AnalysisError("DISTINCT aggregates not yet supported")
+            if call.is_star or (call.name == "count" and not call.args):
+                agg_specs.append(L.AggSpecNode("count_star", None,
+                                               "count", BIGINT))
+                call_slots[call] = ("plain", len(agg_specs) - 1, -1)
+                continue
+            if len(call.args) != 1:
+                raise AnalysisError(f"{call.name} takes one argument")
+            arg = lowerer.lower(call.args[0])
+            slot = add_arg(arg)
+            t = arg.dtype
+            if call.name == "count":
+                agg_specs.append(L.AggSpecNode("count", ir.ColumnRef(
+                    slot, t), "count", BIGINT))
+                call_slots[call] = ("plain", len(agg_specs) - 1, -1)
+            elif call.name in ("min", "max"):
+                agg_specs.append(L.AggSpecNode(call.name, ir.ColumnRef(
+                    slot, t), call.name, t))
+                call_slots[call] = ("plain", len(agg_specs) - 1, -1)
+            elif call.name == "sum":
+                out_t = sum_type(t)
+                agg_specs.append(L.AggSpecNode("sum", ir.ColumnRef(slot, t),
+                                               "sum", out_t))
+                call_slots[call] = ("plain", len(agg_specs) - 1, -1)
+            elif call.name == "avg":
+                out_t = t if t.kind is TypeKind.DECIMAL else DOUBLE
+                agg_specs.append(L.AggSpecNode("sum", ir.ColumnRef(slot, t),
+                                               "avg_sum", sum_type(t)))
+                agg_specs.append(L.AggSpecNode("count", ir.ColumnRef(
+                    slot, t), "avg_cnt", BIGINT))
+                call_slots[call] = ("avg", len(agg_specs) - 2,
+                                    len(agg_specs) - 1)
+
+        pre_node = L.ProjectNode(rel.node, tuple(pre_exprs),
+                                 tuple(pre_cols))
+
+        # aggregation strategy
+        strategy, domains, capacity = self.agg_strategy(
+            group_irs, scope, pre_node)
+        agg_out = tuple(
+            [(f"gk{i}", e.dtype) for i, e in enumerate(group_irs)] +
+            [(s.out_name, s.out_dtype) for s in agg_specs])
+        agg_node = L.AggregateNode(
+            pre_node, tuple(range(n_keys)), tuple(agg_specs),
+            strategy, domains, capacity, agg_out)
+
+        # post-projection scope: group keys (referencing original key ASTs)
+        # then aggregate slots
+        post_scope_cols = []
+        for i, (g_ast, g_ir) in enumerate(zip(group_asts, group_irs)):
+            fld = self.field_for(g_ir, scope)
+            post_scope_cols.append(ScopeColumn(None, f"gk{i}", g_ir.dtype,
+                                               i, fld))
+        post_scope = Scope(post_scope_cols)
+
+        def rewrite(node: A.Node) -> ir.Expr:
+            """Lower a select/having/order expression over the agg output."""
+            # group-by expression match (syntactic, like Trino)
+            for i, g_ast in enumerate(group_asts):
+                if ast_equal(node, g_ast, q):
+                    c = post_scope.columns[i]
+                    return ir.ColumnRef(c.index, c.dtype, c.name)
+            if isinstance(node, A.FunctionCall) and node.name in AGG_NAMES:
+                kind, s1, s2 = call_slots[node]
+                if kind == "plain":
+                    spec = agg_specs[s1]
+                    return ir.ColumnRef(n_keys + s1, spec.out_dtype)
+                sum_ref = ir.ColumnRef(n_keys + s1, agg_specs[s1].out_dtype)
+                cnt_ref = ir.ColumnRef(n_keys + s2, BIGINT)
+                arg_t = agg_specs[s1].arg.dtype
+                if arg_t.kind is TypeKind.DECIMAL:
+                    return ir.DecimalAvg(sum_ref, cnt_ref, arg_t)
+                return ir.arith("/", ir.Cast(sum_ref, DOUBLE),
+                                ir.Cast(cnt_ref, DOUBLE))
+            if isinstance(node, A.Identifier):
+                # must be a group key (matched above) — else error
+                raise AnalysisError(
+                    f"column {'.'.join(node.parts)} must appear in GROUP BY")
+            if isinstance(node, A.BinaryOp):
+                l, r = rewrite(node.left), rewrite(node.right)
+                if node.op in ("and", "or"):
+                    return ir.Logical(node.op, (l, r))
+                if node.op in ("=", "<>", "<", "<=", ">", ">="):
+                    return ir.Compare(node.op, l, r)
+                return ir.arith(node.op, l, r)
+            if isinstance(node, A.UnaryOp):
+                if node.op == "not":
+                    return ir.Not(rewrite(node.arg))
+                return ir.Negate(rewrite(node.arg),
+                                 rewrite(node.arg).dtype)
+            if isinstance(node, (A.NumberLit, A.StringLit, A.BoolLit,
+                                 A.NullLit, A.DateLit)):
+                return ExpressionLowerer(post_scope).lower(node)
+            if isinstance(node, A.CastExpr):
+                return ir.Cast(rewrite(node.arg),
+                               parse_type(node.type_name))
+            raise AnalysisError(
+                f"unsupported post-aggregation expression "
+                f"{type(node).__name__}")
+
+        items = []
+        for item in q.select:
+            if item.expr is None:
+                raise AnalysisError("* not allowed with GROUP BY")
+            name = (item.alias or default_name(item.expr)).lower()
+            items.append((item.expr, name))
+
+        post_exprs = []
+        names = []
+        out_cols = []
+        final_scope = []
+        for i, (ast, name) in enumerate(items):
+            e = rewrite(ast)
+            post_exprs.append(e)
+            names.append(name)
+            out_cols.append((name, e.dtype))
+            fld = None
+            if isinstance(e, ir.ColumnRef) and e.index < n_keys:
+                fld = post_scope.columns[e.index].field
+            final_scope.append(ScopeColumn(None, name, e.dtype, i, fld))
+
+        current: L.PlanNode = agg_node
+        if q.having is not None:
+            pred = rewrite(q.having)
+            current = L.FilterNode(current, pred, current.output)
+        post_node = L.ProjectNode(current, tuple(post_exprs),
+                                  tuple(out_cols))
+        return (PlannedRelation(post_node, Scope(final_scope)),
+                post_exprs, names)
+
+    def agg_strategy(self, group_irs, scope: Scope, pre_node):
+        if not group_irs:
+            return "global", (), 0
+        domains = []
+        for e in group_irs:
+            d = self.domain_of(e, scope)
+            if d is None:
+                domains = None
+                break
+            domains.append(d)
+        if domains is not None:
+            prod = math.prod(domains)
+            if prod <= MAX_DIRECT_GROUPS:
+                return "direct", tuple(domains), prod
+        return "sort", (), DEFAULT_SORT_GROUPS
+
+    def domain_of(self, e: ir.Expr, scope: Scope) -> Optional[int]:
+        if isinstance(e, ir.ColumnRef):
+            if e.dtype.kind is TypeKind.VARCHAR:
+                for c in scope.columns:
+                    if c.index == e.index and c.field is not None and \
+                            c.field.dictionary is not None:
+                        return len(c.field.dictionary)
+            if e.dtype.kind is TypeKind.BOOLEAN:
+                return 2
+        return None
+
+    def resolve_order_expr(self, ast: A.Node, q: A.Query,
+                           rel: PlannedRelation, names: List[str]) -> int:
+        # ordinal
+        if isinstance(ast, A.NumberLit) and "." not in ast.text:
+            i = int(ast.text) - 1
+            if not (0 <= i < len(names)):
+                raise AnalysisError(f"ORDER BY position {i+1} out of range")
+            return i
+        # alias or column name in output
+        if isinstance(ast, A.Identifier) and len(ast.parts) == 1:
+            nm = ast.parts[0].lower()
+            if nm in names:
+                return names.index(nm)
+        # expression identical to some select item
+        for i, item in enumerate(q.select):
+            if item.expr is not None and ast_equal(ast, item.expr, q):
+                return i
+        raise AnalysisError(
+            "ORDER BY expressions must reference select outputs for now")
+
+
+# --------------------------------------------------------------------------
+# small helpers
+# --------------------------------------------------------------------------
+
+def split_conjuncts(node: A.Node, out: List[A.Node]) -> None:
+    if isinstance(node, A.BinaryOp) and node.op == "and":
+        split_conjuncts(node.left, out)
+        split_conjuncts(node.right, out)
+    else:
+        out.append(node)
+
+
+def as_equi(node: A.Node):
+    if isinstance(node, A.BinaryOp) and node.op == "=" and \
+            isinstance(node.left, A.Identifier) and \
+            isinstance(node.right, A.Identifier):
+        return node.left.parts, node.right.parts
+    return None
+
+
+def ast_equal(a: A.Node, b: A.Node, q: A.Query) -> bool:
+    """Syntactic equality; also matches a bare identifier against a select
+    alias (SQL: GROUP BY can reference aliases in some dialects — Trino
+    allows ordinals and output names; we match structurally)."""
+    return a == b
+
+
+def resolve_ordinal(g: A.Node, q: A.Query) -> A.Node:
+    if isinstance(g, A.NumberLit) and "." not in g.text:
+        i = int(g.text) - 1
+        if 0 <= i < len(q.select) and q.select[i].expr is not None:
+            return q.select[i].expr
+    return g
+
+
+def default_name(expr: A.Node) -> str:
+    if isinstance(expr, A.Identifier):
+        return expr.parts[-1]
+    if isinstance(expr, A.FunctionCall):
+        return expr.name
+    return "_col"
+
+
+def sum_type(t: DataType) -> DataType:
+    if t.kind is TypeKind.DECIMAL:
+        from ..types import decimal as mk
+        return mk(18, t.scale)     # widest short decimal (int64 accumulator)
+    if t.kind is TypeKind.DOUBLE:
+        return DOUBLE
+    return BIGINT
+
+
+def sub_fields(sub: "PlannedRelation"):
+    """Fields (with dictionaries) for a subquery's output columns."""
+    return [c.field for c in sub.scope.columns]

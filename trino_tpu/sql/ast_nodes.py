@@ -1,0 +1,203 @@
+"""Untyped SQL AST.
+
+Reference: core/trino-parser's 296 immutable tree classes
+(core/trino-parser/.../tree/). We model the subset the engine executes;
+the analyzer (planner/analyzer.py) resolves names and types.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+
+class Node:
+    pass
+
+
+# ---- expressions ----------------------------------------------------------
+
+@dataclass(frozen=True)
+class Identifier(Node):
+    parts: Tuple[str, ...]          # qualified name, original case
+
+
+@dataclass(frozen=True)
+class NumberLit(Node):
+    text: str                       # literal text; analyzer types it
+
+
+@dataclass(frozen=True)
+class StringLit(Node):
+    value: str
+
+
+@dataclass(frozen=True)
+class BoolLit(Node):
+    value: bool
+
+
+@dataclass(frozen=True)
+class NullLit(Node):
+    pass
+
+
+@dataclass(frozen=True)
+class DateLit(Node):
+    value: str                      # ISO yyyy-mm-dd
+
+
+@dataclass(frozen=True)
+class IntervalLit(Node):
+    value: int
+    unit: str                       # 'year' | 'month' | 'day'
+    negative: bool = False
+
+
+@dataclass(frozen=True)
+class BinaryOp(Node):
+    op: str                         # arithmetic/comparison/'and'/'or'
+    left: Node
+    right: Node
+
+
+@dataclass(frozen=True)
+class UnaryOp(Node):
+    op: str                         # '-' | '+' | 'not'
+    arg: Node
+
+
+@dataclass(frozen=True)
+class IsNullPredicate(Node):
+    arg: Node
+    negated: bool
+
+
+@dataclass(frozen=True)
+class BetweenPredicate(Node):
+    arg: Node
+    low: Node
+    high: Node
+    negated: bool
+
+
+@dataclass(frozen=True)
+class InPredicate(Node):
+    arg: Node
+    values: Tuple[Node, ...]        # literal list; subquery variant separate
+    negated: bool
+
+
+@dataclass(frozen=True)
+class InSubquery(Node):
+    arg: Node
+    query: "Query"
+    negated: bool
+
+
+@dataclass(frozen=True)
+class ExistsPredicate(Node):
+    query: "Query"
+    negated: bool
+
+
+@dataclass(frozen=True)
+class ScalarSubquery(Node):
+    query: "Query"
+
+
+@dataclass(frozen=True)
+class LikePredicate(Node):
+    arg: Node
+    pattern: Node
+    escape: Optional[Node]
+    negated: bool
+
+
+@dataclass(frozen=True)
+class FunctionCall(Node):
+    name: str                       # lower-case
+    args: Tuple[Node, ...]
+    distinct: bool = False
+    is_star: bool = False           # count(*)
+
+
+@dataclass(frozen=True)
+class CastExpr(Node):
+    arg: Node
+    type_name: str                  # e.g. 'bigint', 'decimal(12,2)', 'date'
+
+
+@dataclass(frozen=True)
+class ExtractExpr(Node):
+    part: str                       # 'year' | 'month' | 'day'
+    arg: Node
+
+
+@dataclass(frozen=True)
+class CaseExpr(Node):
+    operand: Optional[Node]         # simple CASE when not None
+    whens: Tuple[Tuple[Node, Node], ...]
+    default: Optional[Node]
+
+
+# ---- relations ------------------------------------------------------------
+
+@dataclass(frozen=True)
+class TableRef(Node):
+    name: Tuple[str, ...]           # possibly qualified
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class SubqueryRef(Node):
+    query: "Query"
+    alias: str
+
+
+@dataclass(frozen=True)
+class Join(Node):
+    kind: str                       # 'inner'|'left'|'right'|'full'|'cross'
+    left: Node
+    right: Node
+    condition: Optional[Node]       # ON expr (None for cross / comma)
+
+
+# ---- query structure ------------------------------------------------------
+
+@dataclass(frozen=True)
+class SelectItem(Node):
+    expr: Optional[Node]            # None for '*'
+    alias: Optional[str] = None
+    star_qualifier: Optional[Tuple[str, ...]] = None  # for t.*
+
+
+@dataclass(frozen=True)
+class OrderItem(Node):
+    expr: Node
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@dataclass(frozen=True)
+class Query(Node):
+    select: Tuple[SelectItem, ...]
+    distinct: bool
+    relation: Optional[Node]        # table tree (None: SELECT without FROM)
+    where: Optional[Node]
+    group_by: Tuple[Node, ...]
+    having: Optional[Node]
+    order_by: Tuple[OrderItem, ...]
+    limit: Optional[int]
+
+
+@dataclass(frozen=True)
+class Explain(Node):
+    query: Query
+    analyze: bool = False
+
+
+@dataclass(frozen=True)
+class ShowTables(Node):
+    catalog: Optional[str] = None
+    schema: Optional[str] = None
