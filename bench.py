@@ -1,0 +1,188 @@
+"""Driver benchmark: prints ONE JSON line.
+
+Workload: TPC-H q1 at SF1 (~6M lineitem rows) — the reference's benchto
+TPC-H methodology (testing/trino-benchto-benchmarks/.../tpch.yaml:1-40:
+prewarm runs then measured runs, concurrency 1) applied to the engine's
+flagship aggregation pipeline on the real TPU chip.
+
+Baseline: the same computation, single-node CPU, vectorized numpy — the
+stand-in for the reference's single-node Java operator pipeline
+(BenchmarkHashAndStreamingAggregationOperators.java:75-99 measures the same
+shape). vs_baseline = cpu_time / tpu_time (higher is better; >1 = faster
+than CPU).
+
+The TPU timing measures the steady-state jitted pipeline on device-resident
+columns (scan cache warm, like the reference benchmarks which read from
+in-memory pages), excluding one-time XLA compilation — consistent with
+JMH average-time methodology.
+"""
+
+import json
+import statistics
+import time
+
+import numpy as np
+
+PREWARM = 2
+RUNS = 6
+SCALE = 1.0
+
+
+def numpy_q1(cols, cutoff):
+    """Single-node CPU baseline: vectorized numpy q1 (filter + group by
+    returnflag x linestatus + 6 aggregates + 3 avgs)."""
+    rf, ls, qty, price, disc, tax, ship = cols
+    m = ship <= cutoff
+    gid = rf[m] * 2 + ls[m]
+    qty_m, price_m, disc_m, tax_m = qty[m], price[m], disc[m], tax[m]
+    disc_price = price_m * (100 - disc_m)
+    charge = disc_price * (100 + tax_m)
+    n_groups = 6
+    out = {}
+    out["sum_qty"] = np.bincount(gid, weights=qty_m, minlength=n_groups)
+    out["sum_base"] = np.bincount(gid, weights=price_m, minlength=n_groups)
+    out["sum_disc_price"] = np.bincount(gid, weights=disc_price,
+                                        minlength=n_groups)
+    out["sum_charge"] = np.bincount(gid, weights=charge, minlength=n_groups)
+    out["sum_disc"] = np.bincount(gid, weights=disc_m, minlength=n_groups)
+    out["count"] = np.bincount(gid, minlength=n_groups)
+    c = np.maximum(out["count"], 1)
+    out["avg_qty"] = out["sum_qty"] / c
+    out["avg_price"] = out["sum_base"] / c
+    out["avg_disc"] = out["sum_disc"] / c
+    return out
+
+
+def main():
+    import jax
+
+    from trino_tpu import ir
+    from trino_tpu.batch import batch_from_numpy
+    from trino_tpu.connectors.tpch.connector import TpchConnector
+    from trino_tpu.ops.aggregate import AggSpec, direct_group_aggregate
+    from trino_tpu.ops.project import apply_filter, project
+    from trino_tpu.types import BIGINT, DATE, VARCHAR, decimal
+
+    conn = TpchConnector()
+    li = conn.get_table(f"sf{SCALE:g}" if SCALE != 1 else "sf1", "lineitem")
+    s = li.schema
+    names = ["l_returnflag", "l_linestatus", "l_quantity",
+             "l_extendedprice", "l_discount", "l_tax", "l_shipdate"]
+    host_cols = [li.columns[s.index_of(n)] for n in names]
+    cutoff = 10561  # DATE '1998-12-01' - 90 days
+
+    # ---- CPU baseline -----------------------------------------------------
+    cpu_times = []
+    for i in range(PREWARM + RUNS):
+        t0 = time.perf_counter()
+        ref = numpy_q1(host_cols, cutoff)
+        dt = time.perf_counter() - t0
+        if i >= PREWARM:
+            cpu_times.append(dt)
+    cpu_t = statistics.median(cpu_times)
+
+    # ---- TPU pipeline -----------------------------------------------------
+    batch = batch_from_numpy(host_cols, pad_multiple=8192)
+    d122 = decimal(12, 2)
+    rf = ir.ColumnRef(0, VARCHAR, "l_returnflag")
+    ls = ir.ColumnRef(1, VARCHAR, "l_linestatus")
+    qty = ir.ColumnRef(2, d122, "l_quantity")
+    price = ir.ColumnRef(3, d122, "l_extendedprice")
+    disc = ir.ColumnRef(4, d122, "l_discount")
+    tax = ir.ColumnRef(5, d122, "l_tax")
+    ship = ir.ColumnRef(6, DATE, "l_shipdate")
+    one = ir.Literal(100, d122)
+    flt = ir.Compare("<=", ship, ir.Literal(cutoff, DATE))
+    disc_price = ir.arith("*", price, ir.arith("-", one, disc))
+    charge = ir.arith("*", disc_price, ir.arith("+", one, tax))
+    pre = (rf, ls, qty, price, disc_price, charge, disc)
+    aggs = (AggSpec("sum", 2), AggSpec("sum", 3), AggSpec("sum", 4),
+            AggSpec("sum", 5), AggSpec("sum", 6),
+            AggSpec("count_star", None))
+
+    @jax.jit
+    def q1_step(b):
+        filtered = apply_filter(b, flt)
+        projected = project(filtered, pre)
+        return direct_group_aggregate(projected, (0, 1), (3, 2), aggs)
+
+    # Through the axon tunnel block_until_ready returns before remote
+    # execution finishes and any host fetch pays ~60ms network RTT, so we
+    # time N pipeline iterations inside ONE jitted fori_loop (per-iteration
+    # data perturbation defeats CSE/hoisting), fetch a single scalar, and
+    # difference two loop lengths so RTT + dispatch cancel exactly.
+    from jax import lax
+
+    from trino_tpu.batch import Batch, Column
+
+    import jax.numpy as jnp
+
+    @jax.jit
+    def q1_iterated(b, n_iter):
+        def body(i, acc):
+            # perturb the shipdate column: the filter feeds every
+            # aggregate, so no part of the pipeline is loop-invariant and
+            # XLA cannot hoist work out of the timing loop
+            cols = list(b.columns)
+            ship_c = cols[6]
+            cols[6] = Column(
+                data=ship_c.data + (i % 2).astype(ship_c.data.dtype),
+                valid=ship_c.valid)
+            bb = Batch(columns=tuple(cols), live=b.live)
+            out = q1_step(bb)
+            # consume EVERY aggregate output — anything unconsumed is
+            # dead-code-eliminated together with its inputs, silently
+            # shrinking the measured pipeline
+            total = acc
+            for c in out.columns[2:]:
+                total = total + c.data.sum()
+            return total
+        return lax.fori_loop(0, n_iter, body,
+                             jnp.asarray(0, dtype=jnp.int64))
+
+    # dynamic trip count: one compile, two loop lengths; the long loop is
+    # sized so per-iteration time dominates RTT noise (~ms) by >100x
+    N_SHORT, N_LONG = 8, 264
+    np.asarray(q1_iterated(batch, N_SHORT))   # warm compile
+
+    def timed(n):
+        ts = []
+        for _ in range(RUNS):
+            t0 = time.perf_counter()
+            np.asarray(q1_iterated(batch, n))  # forces remote round trip
+            ts.append(time.perf_counter() - t0)
+        return statistics.median(ts)
+
+    t_short = timed(N_SHORT)
+    t_long = timed(N_LONG)
+    tpu_t = max((t_long - t_short) / (N_LONG - N_SHORT), 1e-9)
+
+    out = q1_step(batch)
+
+    # ---- correctness gate (verifier-style: identical results) -------------
+    got_counts = np.asarray(out.columns[7].data)
+    got_sum_qty = np.asarray(out.columns[2].data)
+    # engine group id = rf*2+ls, same mixed radix as baseline
+    assert int(got_counts.sum()) == int(ref["count"].sum()), "count mismatch"
+    np.testing.assert_allclose(
+        np.sort(got_sum_qty[got_counts > 0]),
+        np.sort(ref["sum_qty"][ref["count"] > 0]), rtol=0, atol=0)
+
+    n_rows = li.num_rows
+    print(json.dumps({
+        "metric": "tpch_sf1_q1_agg_pipeline_wall_ms",
+        "value": round(tpu_t * 1000, 3),
+        "unit": "ms",
+        "vs_baseline": round(cpu_t / tpu_t, 3),
+        "detail": {
+            "rows": n_rows,
+            "tpu_rows_per_sec": round(n_rows / tpu_t),
+            "cpu_baseline_ms": round(cpu_t * 1000, 3),
+            "prewarm": PREWARM, "runs": RUNS,
+            "device": str(jax.devices()[0]),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

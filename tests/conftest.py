@@ -11,10 +11,18 @@ Environment must be set before jax is imported anywhere.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the environment pre-sets JAX_PLATFORMS=axon (the real TPU tunnel) and
+# `import pytest` already imported jax via a plugin entrypoint, so env vars
+# alone are too late — use the runtime config API (backends are still
+# uninitialized at conftest time, so this takes effect)
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,54 @@
+"""Device mesh runtime.
+
+Reference: Trino's distribution machinery — NodePartitioningManager maps
+partitions to worker nodes (sql/planner/NodePartitioningManager.java:60) and
+stages run as tasks per node (SURVEY.md §2.8). Here the "worker fleet" is a
+`jax.sharding.Mesh`; a stage is one jitted SPMD program laid over it with
+`shard_map`, and inter-"task" data movement is an XLA collective over ICI
+instead of HTTP page shuttling.
+
+Axis naming: a single "workers" axis for row-sharded (DP-style) execution.
+Multi-axis meshes (host x chip) layer on when multi-host lands.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..batch import Batch, Column
+
+AXIS = "workers"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = AXIS) -> Mesh:
+    devs = jax.devices()
+    n = n_devices if n_devices is not None else len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def shard_rows(batch: Batch, mesh: Mesh, axis: str = AXIS) -> Batch:
+    """Place a host-built batch row-sharded across the mesh (the split
+    assignment step: SourcePartitionedScheduler.assignSplits:378 analog).
+    Capacity must divide evenly — batch_from_numpy pads to 1024-multiples,
+    so pad_multiple must be a multiple of mesh size * 8."""
+    spec = NamedSharding(mesh, P(axis))
+
+    def put(x):
+        return jax.device_put(x, spec)
+
+    return jax.tree_util.tree_map(put, batch)
+
+
+def replicate(batch: Batch, mesh: Mesh) -> Batch:
+    """Broadcast a (small) batch to every device — the
+    FIXED_BROADCAST_DISTRIBUTION / BroadcastOutputBuffer path
+    (execution/buffer/BroadcastOutputBuffer.java:56)."""
+    spec = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, spec), batch)

@@ -35,7 +35,8 @@ from .analyzer import (AGG_NAMES, AnalysisError, ExpressionLowerer, Scope,
                        ScopeColumn, ast_children, contains_aggregate,
                        parse_type)
 
-MAX_DIRECT_GROUPS = 4096         # dense-domain aggregation cutoff
+from ..ops.aggregate import MAX_DIRECT_GROUPS  # dense-domain cutoff (64)
+
 DEFAULT_SORT_GROUPS = 1 << 16    # sort-agg output capacity default
 
 
