@@ -175,3 +175,37 @@ def test_explain_renders(session):
 def test_show_tables(session):
     r = session.execute("SHOW TABLES FROM tpch.tiny")
     assert ("lineitem",) in r.rows
+
+
+def test_one_to_many_join_device_expansion(session, oracle):
+    # probe=orders (unique), build=lineitem (N per orderkey): forces the
+    # planner to probe lineitem/build orders OR expansion; either way the
+    # row count must match
+    check(session, oracle,
+          "SELECT count(*), sum(l_extendedprice) FROM orders, lineitem "
+          "WHERE o_orderkey = l_orderkey AND o_orderdate >= DATE '1998-01-01'")
+
+
+def test_q19_style_or_across_tables(session, oracle):
+    check(session, oracle, """
+        SELECT sum(l_extendedprice * (1 - l_discount))
+        FROM lineitem, part
+        WHERE p_partkey = l_partkey
+          AND ((p_brand = 'Brand#11' AND l_quantity <= 11)
+            OR (p_brand = 'Brand#22' AND l_quantity > 5))""")
+
+
+def test_left_join(session, oracle):
+    check(session, oracle,
+          "SELECT count(*), count(o_orderkey) FROM customer "
+          "LEFT JOIN orders ON c_custkey = o_custkey")
+
+
+def test_pruned_plan_still_correct(session, oracle):
+    # one narrow column out of the 16-column lineitem
+    check(session, oracle,
+          "SELECT max(l_shipdate) FROM lineitem")
+    r = session.execute("EXPLAIN SELECT max(l_shipdate) FROM lineitem")
+    text = "\n".join(row[0] for row in r.rows)
+    assert "l_shipdate" in text
+    assert "l_comment" not in text  # pruned from the scan

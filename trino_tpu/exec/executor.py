@@ -29,7 +29,8 @@ from ..batch import Batch, Column, batch_from_numpy, batch_to_numpy
 from ..catalog import Catalog
 from ..ops.aggregate import (AggSpec, direct_group_aggregate,
                              global_aggregate, sort_group_aggregate)
-from ..ops.join import host_expansion_join, join_unique_build
+from ..batch import pad_capacity
+from ..ops.join import join_expand, join_unique_build
 from ..ops.project import apply_filter, filter_project, project
 from ..ops.sort import limit_batch, sort_batch
 from ..planner import logical as L
@@ -41,6 +42,7 @@ class ExecStats:
     scans: int = 0
     rows_scanned: int = 0
     join_fallbacks: int = 0
+    join_expansion_retries: int = 0
     agg_capacity_retries: int = 0
 
 
@@ -129,13 +131,28 @@ class Executor:
         probe = self.run(node.left)
         build = self.run(node.right)
         self.validate_key_ranges(build, node.right_keys)
-        out, dup = join_unique_build(probe, build, node.left_keys,
-                                     node.right_keys, node.kind)
-        if int(dup) == 0:
+        if node.kind in ("semi", "anti"):
+            # membership tests are fan-out-free: build duplicates are
+            # irrelevant, the unique-build probe answers "any match?"
+            out, _dup = join_unique_build(probe, build, node.left_keys,
+                                          node.right_keys, node.kind)
             return out
-        # duplicate build keys: host expansion fallback
-        self.stats.join_fallbacks += 1
-        return self.host_join(probe, build, node)
+        if node.build_unique:
+            out, dup = join_unique_build(probe, build, node.left_keys,
+                                         node.right_keys, node.kind)
+            if int(dup) == 0:
+                return out
+            # planner's uniqueness proof was wrong — degrade gracefully
+            self.stats.join_fallbacks += 1
+        cap = probe.capacity
+        while True:
+            out, total = join_expand(probe, build, node.left_keys,
+                                     node.right_keys, node.kind, cap)
+            total = int(total)
+            if total <= cap:
+                return out
+            cap = pad_capacity(total)     # exact requirement, one retry
+            self.stats.join_expansion_retries += 1
 
     def validate_key_ranges(self, batch: Batch, keys: tuple) -> None:
         if len(keys) <= 1:
@@ -149,48 +166,10 @@ class Executor:
                 raise RuntimeError(
                     "multi-column join key outside packable range")
 
-    def host_join(self, probe: Batch, build: Batch,
-                  node: L.JoinNode) -> Batch:
-        pa, pv = _to_host_padded(probe)
-        ba, bv = _to_host_padded(build)
-        p_live = np.asarray(probe.live)
-        b_live = np.asarray(build.live)
-        pk = _pack_host(pa, pv, node.left_keys)
-        bk = _pack_host(ba, bv, node.right_keys)
-        pa2 = [pk[0]] + pa
-        pv2 = [pk[1]] + pv
-        ba2 = [bk[0]] + ba
-        bv2 = [bk[1]] + bv
-        arrays, valids = host_expansion_join(
-            pa2, pv2, p_live, ba2, bv2, b_live, 0, 0, node.kind)
-        # drop packed key columns
-        if node.kind in ("semi", "anti"):
-            arrays, valids = arrays[1:], valids[1:]
-        else:
-            n_probe = len(pa)
-            arrays = arrays[1:n_probe + 1] + arrays[n_probe + 2:]
-            valids = valids[1:n_probe + 1] + valids[n_probe + 2:]
-        return batch_from_numpy(arrays, valids=valids)
-
     def result_to_host(self, root: L.OutputNode, batch: Batch):
         """Compact + return (names, columns, valids) on host."""
         arrays, valids = batch_to_numpy(batch)
         return list(root.names), arrays, valids
-
-
-def _to_host_padded(batch: Batch):
-    arrays = [np.asarray(c.data) for c in batch.columns]
-    valids = [np.asarray(c.valid) for c in batch.columns]
-    return arrays, valids
-
-
-def _pack_host(arrays, valids, keys: tuple):
-    key = arrays[keys[0]].astype(np.int64)
-    valid = valids[keys[0]].copy()
-    for ki in keys[1:]:
-        key = key * (1 << 32) + arrays[ki].astype(np.int64)
-        valid = valid & valids[ki]
-    return key, valid
 
 
 import functools

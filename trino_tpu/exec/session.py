@@ -18,6 +18,7 @@ import numpy as np
 from ..batch import decode_column, Field
 from ..catalog import Catalog, default_catalog
 from ..planner.logical import OutputNode, explain_text
+from ..planner.optimizer import prune_plan
 from ..planner.planner import Planner
 from ..sql import ast_nodes as A
 from ..sql.parser import parse
@@ -73,7 +74,7 @@ class Session:
 
         if isinstance(stmt, A.Explain):
             rel = self.planner().plan_query(stmt.query)
-            text = explain_text(rel.node)
+            text = explain_text(prune_plan(rel.node))
             return QueryResult(["query plan"],
                                [(line,) for line in text.split("\n")],
                                time.monotonic() - t0)
@@ -81,6 +82,7 @@ class Session:
         rel = self.planner().plan_query(stmt)
         root = rel.node
         assert isinstance(root, OutputNode)
+        root = prune_plan(root)
         batch = self.executor.execute(root)
         names, arrays, valids = self.executor.result_to_host(root, batch)
         rows = self.decode_rows(rel, arrays, valids)

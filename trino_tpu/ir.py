@@ -198,3 +198,49 @@ def walk(expr: Expr):
 
 def referenced_columns(expr: Expr) -> set:
     return {n.index for n in walk(expr) if isinstance(n, ColumnRef)}
+
+
+def remap_columns(expr: Expr, mapping) -> Expr:
+    """Rebuild an expression with ColumnRef indices translated through
+    `mapping` (used by the column-pruning optimizer pass)."""
+    if isinstance(expr, ColumnRef):
+        return ColumnRef(mapping[expr.index], expr.dtype, expr.name)
+    if isinstance(expr, Literal):
+        return expr
+    if isinstance(expr, Arith):
+        return Arith(expr.op, remap_columns(expr.left, mapping),
+                     remap_columns(expr.right, mapping), expr.dtype)
+    if isinstance(expr, Negate):
+        return Negate(remap_columns(expr.arg, mapping), expr.dtype)
+    if isinstance(expr, Compare):
+        return Compare(expr.op, remap_columns(expr.left, mapping),
+                       remap_columns(expr.right, mapping))
+    if isinstance(expr, Logical):
+        return Logical(expr.op, tuple(remap_columns(a, mapping)
+                                      for a in expr.args))
+    if isinstance(expr, Not):
+        return Not(remap_columns(expr.arg, mapping))
+    if isinstance(expr, IsNull):
+        return IsNull(remap_columns(expr.arg, mapping), expr.negated)
+    if isinstance(expr, InList):
+        return InList(remap_columns(expr.arg, mapping), expr.values)
+    if isinstance(expr, Between):
+        return Between(remap_columns(expr.arg, mapping),
+                       remap_columns(expr.low, mapping),
+                       remap_columns(expr.high, mapping))
+    if isinstance(expr, Case):
+        return Case(tuple((remap_columns(c, mapping),
+                           remap_columns(v, mapping))
+                          for c, v in expr.whens),
+                    None if expr.default is None
+                    else remap_columns(expr.default, mapping), expr.dtype)
+    if isinstance(expr, Cast):
+        return Cast(remap_columns(expr.arg, mapping), expr.dtype)
+    if isinstance(expr, DictPredicate):
+        return DictPredicate(remap_columns(expr.arg, mapping), expr.lut)
+    if isinstance(expr, DecimalAvg):
+        return DecimalAvg(remap_columns(expr.sum, mapping),
+                          remap_columns(expr.count, mapping), expr.dtype)
+    if isinstance(expr, ExtractField):
+        return ExtractField(expr.part, remap_columns(expr.arg, mapping))
+    raise NotImplementedError(type(expr).__name__)

@@ -12,10 +12,9 @@ hash collisions (SURVEY.md §7 "GroupBy/Join on TPU").
 
 Unique-build joins (key is a primary key: every TPC-H dimension join) have
 fan-out <= 1, so output capacity == probe capacity and everything stays on
-device. Duplicate-build joins report a duplicate count; the executor falls
-back to a host expansion join (the "conservative upper bounds with overflow
-spill to a host path" mitigation from SURVEY.md §7 hard part 1) until the
-device multi-match expansion lands.
+device. Duplicate-build joins run the two-pass device expansion
+(join_expand) under a static output bound with grow-and-retry on overflow
+(the "conservative upper bounds" mitigation from SURVEY.md §7 hard part 1).
 
 Multi-column equi-keys are packed into one int64 by the planner (key
 columns are bounded by table cardinalities, known from connector stats).
@@ -28,7 +27,6 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..batch import Batch, Column
 
@@ -98,53 +96,59 @@ def join_unique_build(probe: Batch, build: Batch, probe_keys: tuple,
     return Batch(columns=probe.columns + tuple(build_cols), live=live), dup
 
 
-def host_expansion_join(probe_arrays, probe_valids, probe_live,
-                        build_arrays, build_valids, build_live,
-                        probe_key_idx: int, build_key_idx: int,
-                        kind: str):
-    """Host numpy fallback for duplicate build keys (1:N fan-out).
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def join_expand(probe: Batch, build: Batch, probe_keys: tuple,
+                build_keys: tuple, kind: str, out_capacity: int):
+    """Equi-join with arbitrary build-side multiplicity (1:N fan-out),
+    fully on device and scatter-free.
 
-    The spill-to-host path: correct for any multiplicity; used until the
-    device two-pass expansion kernel lands. Returns (arrays, valids) for
-    probe ++ build columns, live rows only.
+    Two-pass expansion (the TPU answer to LookupJoinOperator's variable
+    JoinProbe fan-out, operator/join/unspilled/PageJoiner.java:138):
+    1. per-probe-row match counts via sorted build + two searchsorteds;
+    2. output row j maps back to its probe row by binary search on the
+       cumulative count array, and to its build row by offset within the
+       match run — both gathers.
+
+    Returns (out_batch, total_rows); total_rows > out_capacity means the
+    static bound overflowed and the caller must grow and retry (executor
+    does, like the sort-agg capacity retry).
+    kind: 'inner' | 'left'.
     """
-    p_live = probe_live
-    b_live = build_live
-    pk = probe_arrays[probe_key_idx]
-    pk_ok = p_live & probe_valids[probe_key_idx]
-    bk = build_arrays[build_key_idx]
-    bk_ok = b_live & build_valids[build_key_idx]
+    pk, pk_valid = _combined_key(probe, probe_keys)
+    bk, bk_valid = _combined_key(build, build_keys)
+    n_build = build.capacity
+    n_probe = probe.capacity
 
-    b_idx = np.nonzero(bk_ok)[0]
-    order = b_idx[np.argsort(bk[b_idx], kind="stable")]
-    bk_sorted = bk[order]
-    lo = np.searchsorted(bk_sorted, pk, side="left")
-    hi = np.searchsorted(bk_sorted, pk, side="right")
-    counts = np.where(pk_ok, hi - lo, 0)
+    bk_eff = jnp.where(build.live & bk_valid, bk, _SENTINEL)
+    sorted_keys, order = jax.lax.sort(
+        (bk_eff, jnp.arange(n_build, dtype=jnp.int32)), num_keys=1)
 
-    if kind == "semi":
-        keep = p_live & (counts > 0)
-        return ([a[keep] for a in probe_arrays],
-                [v[keep] for v in probe_valids])
-    if kind == "anti":
-        keep = p_live & (counts == 0) & probe_valids[probe_key_idx]
-        return ([a[keep] for a in probe_arrays],
-                [v[keep] for v in probe_valids])
-
+    lo = jnp.searchsorted(sorted_keys, pk, side="left")
+    hi = jnp.searchsorted(sorted_keys, pk, side="right")
+    pk_ok = probe.live & pk_valid & (pk != _SENTINEL)
+    counts = jnp.where(pk_ok, hi - lo, 0)
     if kind == "left":
-        out_counts = np.maximum(counts, p_live.astype(np.int64))
+        out_counts = jnp.maximum(counts, probe.live.astype(counts.dtype))
     else:
         out_counts = counts
-    probe_rows = np.repeat(np.arange(len(pk)), out_counts)
-    offsets = np.concatenate([[0], np.cumsum(out_counts)[:-1]])
-    within = np.arange(len(probe_rows)) - offsets[probe_rows]
-    matched = within < counts[probe_rows]
-    build_rows = np.where(
-        matched, order[np.clip(lo[probe_rows] + within, 0,
-                               max(len(order) - 1, 0))], 0)
-    arrays = [a[probe_rows] for a in probe_arrays]
-    valids = [v[probe_rows] for v in probe_valids]
-    for a, v in zip(build_arrays, build_valids):
-        arrays.append(np.where(matched, a[build_rows], 0))
-        valids.append(np.where(matched, v[build_rows], False))
-    return arrays, valids
+    cum = jnp.cumsum(out_counts)
+    total = cum[n_probe - 1]
+
+    j = jnp.arange(out_capacity, dtype=cum.dtype)
+    probe_row = jnp.searchsorted(cum, j, side="right")
+    probe_row_c = jnp.clip(probe_row, 0, n_probe - 1)
+    before = jnp.where(probe_row_c > 0,
+                       cum[jnp.clip(probe_row_c - 1, 0, n_probe - 1)], 0)
+    within = j - before
+    out_live = j < total
+    matched = out_live & (within < counts[probe_row_c])
+    build_row = order[jnp.clip(lo[probe_row_c] + within, 0, n_build - 1)]
+
+    out_cols = []
+    for col in probe.columns:
+        out_cols.append(Column(data=col.data[probe_row_c],
+                               valid=col.valid[probe_row_c] & out_live))
+    for col in build.columns:
+        out_cols.append(Column(data=col.data[build_row],
+                               valid=col.valid[build_row] & matched))
+    return Batch(columns=tuple(out_cols), live=out_live), total

@@ -194,10 +194,11 @@ class Planner:
             raise AnalysisError(
                 "cross join without equi-condition not yet supported")
 
-        # orientation: build side must be unique on its keys if provable
+        # orientation: build side should be unique on its keys if provable;
+        # LEFT joins pin the preserved side as probe (no freedom)
         right_unique = self.is_unique(right, right_keys)
         left_unique = self.is_unique(left, left_keys)
-        if right_unique or not left_unique:
+        if kind == "left" or right_unique or not left_unique:
             probe, build = left, right
             probe_keys, build_keys = left_keys, right_keys
             build_unique = right_unique
@@ -227,21 +228,44 @@ class Planner:
         return rel
 
     def is_unique(self, rel: PlannedRelation, key_indices: List[int]) -> bool:
-        """True if the relation is provably unique on these columns
-        (primary-key containment through scans and filters)."""
-        node = rel.node
-        while isinstance(node, (L.FilterNode, L.ProjectNode)):
-            if isinstance(node, L.ProjectNode):
-                return False  # conservatively
-            node = node.child
-        if not isinstance(node, L.ScanNode):
+        return self.node_unique_on(rel.node, frozenset(key_indices))
+
+    def node_unique_on(self, node: L.PlanNode, keys: frozenset) -> bool:
+        """True if `node`'s output is provably unique on the given column
+        positions. The planner's stand-in for Trino's stats-derived
+        distinct-count reasoning (DetermineJoinDistributionType.java:51):
+        primary keys at scans, propagated through filters, unique-build
+        joins (probe multiplicity preserved) and aggregations (output is
+        unique on its group keys)."""
+        if isinstance(node, (L.FilterNode, L.SortNode, L.LimitNode)):
+            return self.node_unique_on(node.child, keys)
+        if isinstance(node, L.ProjectNode):
+            mapped = set()
+            for i in keys:
+                e = node.exprs[i]
+                if not isinstance(e, ir.ColumnRef):
+                    return False
+                mapped.add(e.index)
+            return self.node_unique_on(node.child, frozenset(mapped))
+        if isinstance(node, L.ScanNode):
+            data = self.catalog.get_table(node.catalog, node.schema_name,
+                                          node.table)
+            if not data.primary_key:
+                return False
+            key_names = {node.output[i][0].lower() for i in keys}
+            return set(k.lower() for k in data.primary_key) <= key_names
+        if isinstance(node, L.JoinNode):
+            if node.kind in ("inner", "left") and node.build_unique:
+                n_probe = len(node.left.output)
+                if all(i < n_probe for i in keys):
+                    return self.node_unique_on(node.left, keys)
+            if node.kind in ("semi", "anti"):
+                return self.node_unique_on(node.left, keys)
             return False
-        data = self.catalog.get_table(node.catalog, node.schema_name,
-                                      node.table)
-        if not data.primary_key:
-            return False
-        key_names = {rel.node.output[i][0].lower() for i in key_indices}
-        return set(k.lower() for k in data.primary_key) <= key_names
+        if isinstance(node, L.AggregateNode):
+            n_group = len(node.group_keys)
+            return set(range(n_group)) <= keys
+        return False
 
     # ------------------------------------------------------------------
     # query planning
@@ -260,6 +284,9 @@ class Planner:
             rel = self.apply_local_filters(relations[0], conjuncts)
         else:
             rel = self.build_join_tree(relations, conjuncts)
+        # residual multi-relation predicates (e.g. q19's OR-of-blocks)
+        # become filters over the joined scope
+        rel = self.apply_local_filters(rel, conjuncts)
         if conjuncts:
             raise AnalysisError(
                 f"unplaced predicate(s): {conjuncts}")
