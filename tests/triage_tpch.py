@@ -1,0 +1,54 @@
+"""Dev harness: run all 22 TPC-H queries, report pass/fail per query.
+
+Not collected by pytest (no test_ prefix); run directly:
+    python tests/triage_tpch.py [qnum...]
+"""
+
+import sys
+import traceback
+
+sys.path.insert(0, "tests")
+import conftest  # noqa: F401  (forces CPU 8-device mesh)
+
+from tpch_full import QUERIES
+from oracle import assert_rows_match, load_oracle, oracle_query
+from trino_tpu.exec.session import Session
+
+
+def main():
+    wanted = [int(a) for a in sys.argv[1:]] or sorted(QUERIES)
+    session = Session(default_schema="tiny")
+    conn = session.catalog.connector("tpch")
+    tables = ["region", "nation", "supplier", "customer", "part",
+              "partsupp", "orders", "lineitem"]
+    oracle = load_oracle([conn.get_table("tiny", t) for t in tables])
+    results = {}
+    for q in wanted:
+        sql = QUERIES[q]
+        try:
+            got = session.execute(sql).rows
+        except Exception as e:
+            results[q] = f"ENGINE-ERROR {type(e).__name__}: {e}"
+            if len(wanted) <= 3:
+                traceback.print_exc()
+            continue
+        try:
+            want = oracle_query(oracle, sql)
+        except Exception as e:
+            results[q] = f"ORACLE-ERROR {type(e).__name__}: {e}"
+            continue
+        try:
+            assert_rows_match(got, want, rel_tol=1e-9, abs_tol=0.02,
+                              ordered=True)
+            results[q] = f"PASS ({len(got)} rows)"
+        except AssertionError as e:
+            results[q] = f"MISMATCH: {str(e)[:200]}"
+    print()
+    for q in sorted(results):
+        print(f"q{q:02d}: {results[q]}")
+    n_pass = sum(1 for v in results.values() if v.startswith("PASS"))
+    print(f"\n{n_pass}/{len(results)} pass")
+
+
+if __name__ == "__main__":
+    main()

@@ -67,6 +67,19 @@ LEXICON = (
     "pinto beans instructions dependencies excuses platelets asymptotes "
     "courts dolphins carefully quickly furiously slyly blithely express "
     "regular final ironic pending unusual even bold silent").split()
+# dbgen's P_NAME color vocabulary (subset): q9 greps '%green%', q20
+# 'forest%' — part names must be built from these words to exercise them
+COLORS = (
+    "almond antique aquamarine azure beige bisque black blanched blue "
+    "blush brown burlywood burnished chartreuse chiffon chocolate coral "
+    "cornflower cornsilk cream cyan dark deep dim dodger drab firebrick "
+    "floral forest frosted gainsboro ghost goldenrod green grey honeydew "
+    "hot indian ivory khaki lace lavender lawn lemon light lime linen "
+    "magenta maroon medium metallic midnight mint misty moccasin navajo "
+    "navy olive orange orchid pale papaya peach peru pink plum powder "
+    "puff purple red rose rosy royal saddle salmon sandy seashell sienna "
+    "sky slate smoke snow spring steel tan thistle tomato turquoise "
+    "violet wheat white yellow").split()
 
 
 @dataclass
@@ -110,13 +123,32 @@ def _codes_for(values: List[str], pool: List[str]) -> np.ndarray:
     return np.array([index[v] for v in values], dtype=np.int32)
 
 
-def _comments(rng: np.random.Generator, n: int, words: int = 4) -> tuple:
-    """Seeded comment strings from the lexicon; returns (codes, pool)."""
-    lex = np.array(LEXICON)
+def _comments(rng: np.random.Generator, n: int, words: int = 4,
+              lexicon=None, inject=None, inject_every: int = 0) -> tuple:
+    """Seeded comment strings from the lexicon; returns (codes, pool).
+
+    inject/inject_every: stamp a two-word marker (e.g. 'Customer',
+    'Complaints') into every k-th string, mirroring dbgen's deliberate
+    pattern injection that q13/q16 predicates grep for."""
+    lex = np.array(lexicon if lexicon is not None else LEXICON)
     picks = rng.integers(0, len(lex), size=(n, words))
     # vectorized join via structured trick is overkill; n is bounded by
     # pool explosion — use a code space of word-index tuples instead
     strings = [" ".join(lex[row]) for row in picks]
+    if inject and inject_every:
+        a, b = inject
+        for i in range(0, n, inject_every):
+            strings[i] = f"{strings[i][:4]}{a} the slyly {b} {strings[i]}"
+    pool = sorted(set(strings))
+    return _codes_for(strings, pool), pool
+
+
+def _phones(nationkey: np.ndarray) -> tuple:
+    """dbgen phone format: '<country>-ddd-ddd-dddd', country = nation+10
+    (q22 takes substring(phone,1,2) as the country code)."""
+    local = 100 + (nationkey * 7919) % 900
+    strings = [f"{10 + int(nk)}-{int(l)}-{int(l)}-{int(l)}0"
+               for nk, l in zip(nationkey, local)]
     pool = sorted(set(strings))
     return _codes_for(strings, pool), pool
 
@@ -167,8 +199,12 @@ def generate(scale: float, seed: int = 19920101) -> Dict[str, TableData]:
     suppkey = np.arange(1, n_supp + 1, dtype=np.int64)
     s_name_codes, s_name_pool = _formula_names("Supplier", suppkey)
     s_addr_codes, s_addr_pool = _comments(rng, n_supp, words=2)
-    s_comment_codes, s_comment_pool = _comments(rng, n_supp)
-    s_phone_codes, s_phone_pool = _comments(rng, n_supp, words=1)
+    s_nation = rng.integers(0, 25, n_supp).astype(np.int64)
+    # dbgen plants 'Customer ... Complaints' in a sliver of supplier
+    # comments (q16's NOT IN subquery greps for it)
+    s_comment_codes, s_comment_pool = _comments(
+        rng, n_supp, inject=("Customer", "Complaints"), inject_every=13)
+    s_phone_codes, s_phone_pool = _phones(s_nation)
     tables["supplier"] = TableData(
         "supplier",
         Schema.of(Field("s_suppkey", BIGINT),
@@ -179,7 +215,7 @@ def generate(scale: float, seed: int = 19920101) -> Dict[str, TableData]:
                   Field("s_acctbal", decimal(12, 2)),
                   _dict_field("s_comment", s_comment_pool)),
         [suppkey, s_name_codes, s_addr_codes,
-         rng.integers(0, 25, n_supp).astype(np.int64),
+         s_nation,
          s_phone_codes,
          rng.integers(-99999, 999999, n_supp).astype(np.int64),
          s_comment_codes])
@@ -190,7 +226,8 @@ def generate(scale: float, seed: int = 19920101) -> Dict[str, TableData]:
     c_name_codes, c_name_pool = _formula_names("Customer", custkey)
     c_addr_codes, c_addr_pool = _comments(rng, n_cust, words=2)
     c_comment_codes, c_comment_pool = _comments(rng, n_cust)
-    c_phone_codes, c_phone_pool = _comments(rng, n_cust, words=1)
+    c_nation = rng.integers(0, 25, n_cust).astype(np.int64)
+    c_phone_codes, c_phone_pool = _phones(c_nation)
     seg_pool = sorted(SEGMENTS)
     tables["customer"] = TableData(
         "customer",
@@ -203,7 +240,7 @@ def generate(scale: float, seed: int = 19920101) -> Dict[str, TableData]:
                   _dict_field("c_mktsegment", seg_pool),
                   _dict_field("c_comment", c_comment_pool)),
         [custkey, c_name_codes, c_addr_codes,
-         rng.integers(0, 25, n_cust).astype(np.int64),
+         c_nation,
          c_phone_codes,
          rng.integers(-99999, 999999, n_cust).astype(np.int64),
          rng.integers(0, 5, n_cust).astype(np.int32),
@@ -212,7 +249,8 @@ def generate(scale: float, seed: int = 19920101) -> Dict[str, TableData]:
     # ---- part -------------------------------------------------------------
     n_part = max(1, int(scale * 200_000))
     partkey = np.arange(1, n_part + 1, dtype=np.int64)
-    p_name_codes, p_name_pool = _comments(rng, n_part, words=3)
+    p_name_codes, p_name_pool = _comments(rng, n_part, words=3,
+                                          lexicon=COLORS)
     mfgr_id = rng.integers(1, 6, n_part)
     brand_id = mfgr_id * 10 + rng.integers(1, 6, n_part)
     mfgr_pool = [f"Manufacturer#{i}" for i in range(1, 6)]

@@ -30,7 +30,7 @@ from ..catalog import Catalog
 from ..ops.aggregate import (AggSpec, direct_group_aggregate,
                              global_aggregate, sort_group_aggregate)
 from ..batch import pad_capacity
-from ..ops.join import join_expand, join_unique_build
+from ..ops.join import join_expand, join_mark, join_unique_build
 from ..ops.project import apply_filter, filter_project, project
 from ..ops.sort import limit_batch, sort_batch
 from ..planner import logical as L
@@ -50,6 +50,7 @@ class Executor:
     def __init__(self, catalog: Catalog):
         self.catalog = catalog
         self._scan_cache: Dict[Tuple[str, str, str, tuple], Batch] = {}
+        self._scalar_cache: Dict[object, object] = {}
         self.stats = ExecStats()
 
     # ------------------------------------------------------------------
@@ -63,17 +64,19 @@ class Executor:
             return self.run_scan(node)
         if isinstance(node, L.FilterNode):
             # fuse Filter over Project/Scan chains into one jit call
+            pred = self.fold_scalars(node.predicate)
             if isinstance(node.child, L.ProjectNode):
                 child = self.run(node.child.child)
-                return filter_project_fused(child, node.child.exprs,
-                                            node.predicate)
-            return apply_filter(self.run(node.child), node.predicate)
+                return filter_project_fused(
+                    child, self.fold_scalars_tuple(node.child.exprs), pred)
+            return apply_filter(self.run(node.child), pred)
         if isinstance(node, L.ProjectNode):
+            exprs = self.fold_scalars_tuple(node.exprs)
             if isinstance(node.child, L.FilterNode):
                 child = self.run(node.child.child)
-                return filter_project(child, node.child.predicate,
-                                      node.exprs)
-            return filter_project(self.run(node.child), None, node.exprs)
+                return filter_project(
+                    child, self.fold_scalars(node.child.predicate), exprs)
+            return filter_project(self.run(node.child), None, exprs)
         if isinstance(node, L.AggregateNode):
             return self.run_aggregate(node)
         if isinstance(node, L.JoinNode):
@@ -110,7 +113,8 @@ class Executor:
         child = self.run(node.child)
         aggs = tuple(AggSpec(
             a.func,
-            a.arg.index if a.arg is not None else None)
+            a.arg.index if a.arg is not None else None,
+            a.distinct)
             for a in node.aggs)
         if node.strategy == "global":
             return global_aggregate(child, aggs)
@@ -127,16 +131,51 @@ class Executor:
             capacity *= 4    # table filled: grow and retry (rehash analog)
             self.stats.agg_capacity_retries += 1
 
+    # ---- uncorrelated scalar subqueries (fold to constants) ----------
+
+    def fold_scalars(self, expr):
+        """Replace ScalarSubqueryRef with its computed Literal before
+        tracing (Trino runs uncorrelated subqueries as separate stages;
+        here the subplan executes eagerly and memoized)."""
+        if expr is None:
+            return None
+        has_sub = any(isinstance(e, ir.ScalarSubqueryRef)
+                      for e in ir.walk(expr))
+        if not has_sub:
+            return expr
+
+        def fn(e):
+            if isinstance(e, ir.ScalarSubqueryRef):
+                return ir.Literal(self.scalar_value(e), e.dtype)
+            return None
+        return ir.transform(expr, fn)
+
+    def fold_scalars_tuple(self, exprs):
+        return tuple(self.fold_scalars(e) for e in exprs)
+
+    def scalar_value(self, ref: ir.ScalarSubqueryRef):
+        # keyed by the ref itself (hashes by plan identity) so the cache
+        # keeps the plan object alive — id() reuse cannot alias entries
+        if ref not in self._scalar_cache:
+            batch = self.run(ref.plan)
+            arrays, valids = batch_to_numpy(batch)
+            if len(arrays[0]) > 1:
+                raise RuntimeError(
+                    "scalar subquery returned more than one row")
+            if len(arrays[0]) == 0 or not bool(valids[0][0]):
+                val = None
+            else:
+                v = arrays[0][0]
+                val = v.item() if hasattr(v, "item") else v
+            self._scalar_cache[ref] = val
+        return self._scalar_cache[ref]
+
     def run_join(self, node: L.JoinNode) -> Batch:
         probe = self.run(node.left)
         build = self.run(node.right)
         self.validate_key_ranges(build, node.right_keys)
         if node.kind in ("semi", "anti"):
-            # membership tests are fan-out-free: build duplicates are
-            # irrelevant, the unique-build probe answers "any match?"
-            out, _dup = join_unique_build(probe, build, node.left_keys,
-                                          node.right_keys, node.kind)
-            return out
+            return self.run_membership_join(node, probe, build)
         if node.build_unique:
             out, dup = join_unique_build(probe, build, node.left_keys,
                                          node.right_keys, node.kind)
@@ -153,6 +192,32 @@ class Executor:
                 return out
             cap = pad_capacity(total)     # exact requirement, one retry
             self.stats.join_expansion_retries += 1
+
+    def run_membership_join(self, node: L.JoinNode, probe: Batch,
+                            build: Batch) -> Batch:
+        """semi/anti joins. Build duplicates are irrelevant (membership);
+        residuals go through the mark-join expansion kernel."""
+        if node.null_aware:
+            # NOT IN: any NULL in the subquery output -> no row can pass
+            bk = build.columns[node.right_keys[0]]
+            if bool(jnp.any(build.live & ~bk.valid)):
+                return probe.with_live(jnp.zeros_like(probe.live))
+        if node.residual is None:
+            out, _dup = join_unique_build(probe, build, node.left_keys,
+                                          node.right_keys, node.kind)
+            return out
+        residual = self.fold_scalars(node.residual)
+        cap = probe.capacity
+        while True:
+            mark, total = join_mark(probe, build, node.left_keys,
+                                    node.right_keys, residual, cap)
+            total = int(total)
+            if total <= cap:
+                break
+            cap = pad_capacity(total)
+            self.stats.join_expansion_retries += 1
+        live = probe.live & (mark if node.kind == "semi" else ~mark)
+        return probe.with_live(live)
 
     def validate_key_ranges(self, batch: Batch, keys: tuple) -> None:
         if len(keys) <= 1:

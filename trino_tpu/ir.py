@@ -124,6 +124,36 @@ class DictPredicate(Expr):
 
 
 @dataclass(frozen=True)
+class ScalarSubqueryRef(Expr):
+    """Uncorrelated scalar subquery: holds the planned subplan. The executor
+    runs it once, extracts the single value, and substitutes a Literal
+    before tracing (Trino: uncorrelated subqueries execute as independent
+    stages feeding a semi-join/filter; here they fold to a constant)."""
+    plan: object        # L.OutputNode (opaque to avoid import cycle)
+    dtype: DataType
+
+    def __hash__(self):
+        return id(self.plan)
+
+    def __eq__(self, other):
+        return self is other
+
+
+@dataclass(frozen=True)
+class DerivedDict(Expr):
+    """VARCHAR expression computed by transforming the string pool
+    host-side (e.g. substring over every pool entry) and remapping codes
+    through `lut` into a deduplicated `pool`. Device work is one gather;
+    canonical codes make GROUP BY / joins on the derived value correct
+    even when source strings collide after the transform
+    (SURVEY.md §7 strings policy)."""
+    arg: Expr           # VARCHAR ColumnRef (or nested DerivedDict)
+    lut: tuple          # old code -> new code (int), len == source pool
+    pool: tuple         # deduplicated transformed pool (new code -> str)
+    dtype: DataType     # VARCHAR
+
+
+@dataclass(frozen=True)
 class DecimalAvg(Expr):
     """Exact decimal AVG finalizer: round-half-away-from-zero of
     sum/count at the argument's scale (Trino avg(decimal) semantics,
@@ -175,7 +205,8 @@ def walk(expr: Expr):
     children = ()
     if isinstance(expr, Arith):
         children = (expr.left, expr.right)
-    elif isinstance(expr, (Negate, Not, Cast, ExtractField, DictPredicate)):
+    elif isinstance(expr, (Negate, Not, Cast, ExtractField, DictPredicate,
+                           DerivedDict)):
         children = (expr.arg,)
     elif isinstance(expr, IsNull):
         children = (expr.arg,)
@@ -243,4 +274,37 @@ def remap_columns(expr: Expr, mapping) -> Expr:
                           remap_columns(expr.count, mapping), expr.dtype)
     if isinstance(expr, ExtractField):
         return ExtractField(expr.part, remap_columns(expr.arg, mapping))
+    if isinstance(expr, DerivedDict):
+        return DerivedDict(remap_columns(expr.arg, mapping), expr.lut,
+                           expr.pool, expr.dtype)
+    if isinstance(expr, ScalarSubqueryRef):
+        return expr          # no column refs into the enclosing batch
     raise NotImplementedError(type(expr).__name__)
+
+
+def transform(expr: Expr, fn) -> Expr:
+    """Pre-order structural rewrite: fn(node) -> replacement or None (to
+    recurse into children). Generic over all IR dataclasses."""
+    import dataclasses
+    r = fn(expr)
+    if r is not None:
+        return r
+    if not dataclasses.is_dataclass(expr):
+        return expr
+    changes = {}
+    for f in dataclasses.fields(expr):
+        v = getattr(expr, f.name)
+        nv = _transform_value(v, fn)
+        if nv is not v:
+            changes[f.name] = nv
+    return dataclasses.replace(expr, **changes) if changes else expr
+
+
+def _transform_value(v, fn):
+    if isinstance(v, Expr):
+        return transform(v, fn)
+    if isinstance(v, tuple):
+        items = tuple(_transform_value(x, fn) for x in v)
+        if any(a is not b for a, b in zip(items, v)):
+            return items
+    return v

@@ -50,10 +50,12 @@ MAX_DIRECT_GROUPS = 64
 class AggSpec:
     func: str                 # one of AGG_FUNCS
     arg_index: Optional[int]  # column in the input batch (None for count_star)
+    distinct: bool = False    # sum/count DISTINCT (sort strategy only)
 
     def __post_init__(self):
         assert self.func in AGG_FUNCS, self.func
         assert (self.arg_index is None) == (self.func == "count_star")
+        assert not (self.distinct and self.func not in ("sum", "count"))
 
 
 def _identity(func: str, dtype) -> object:
@@ -172,6 +174,17 @@ def sort_group_aggregate(batch: Batch, key_indices: tuple, aggs: tuple,
         col = batch.columns[ki]
         operands.append((~col.valid).astype(jnp.int8))
         operands.append(col.data)
+    n_group_ops = len(operands)
+    # DISTINCT aggregate columns join the sort key (after the group keys) so
+    # duplicates within a group are adjacent; they do NOT define segment
+    # boundaries. At most one distinct column (planner enforces).
+    distinct_cols = sorted({s.arg_index for s in aggs if s.distinct})
+    distinct_pos = {}
+    for di in distinct_cols:
+        col = batch.columns[di]
+        distinct_pos[di] = len(operands)
+        operands.append((~col.valid).astype(jnp.int8))
+        operands.append(col.data)
     num_keys = len(operands)
     operands.append(jnp.arange(n, dtype=jnp.int32))   # payload: row index
     sorted_ops = jax.lax.sort(tuple(operands), num_keys=num_keys)
@@ -179,7 +192,7 @@ def sort_group_aggregate(batch: Batch, key_indices: tuple, aggs: tuple,
     live_s = batch.live[perm]
 
     diff = jnp.zeros(n, dtype=jnp.bool_)
-    for op in sorted_ops[1:num_keys]:     # key operands only (skip dead flag)
+    for op in sorted_ops[1:n_group_ops]:  # key operands only (skip dead flag)
         diff = diff | (op != jnp.roll(op, 1))
     first = jnp.arange(n) == 0
     boundary = live_s & (first | diff)
@@ -216,6 +229,26 @@ def sort_group_aggregate(batch: Batch, key_indices: tuple, aggs: tuple,
         col = batch.columns[spec.arg_index]
         data_s = col.data[perm]
         valid_s = col.valid[perm] & live_s
+        if spec.distinct:
+            # first occurrence of each distinct valid value within a group:
+            # the distinct column participates in the sort, so duplicates
+            # are adjacent (Trino: MarkDistinct + filtered accumulator)
+            pos = distinct_pos[spec.arg_index]
+            dvinv_s, ddata_s = sorted_ops[pos], sorted_ops[pos + 1]
+            fresh = boundary | (ddata_s != jnp.roll(ddata_s, 1)) | \
+                (dvinv_s != jnp.roll(dvinv_s, 1))
+            marker = valid_s & fresh
+            if spec.func == "count":
+                out_cols.append(Column(data=seg_total(
+                    marker.astype(jnp.int64)), valid=group_live))
+            else:  # sum distinct
+                acc_dtype = jnp.int64 if jnp.issubdtype(
+                    col.data.dtype, jnp.integer) else col.data.dtype
+                vals = jnp.where(marker, data_s.astype(acc_dtype), 0)
+                cnt = seg_total(marker.astype(jnp.int64))
+                out_cols.append(Column(data=seg_total(vals),
+                                       valid=group_live & (cnt > 0)))
+            continue
         cnt = seg_total(valid_s.astype(jnp.int64))
         if spec.func == "count":
             out_cols.append(Column(data=cnt, valid=group_live))

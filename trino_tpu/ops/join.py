@@ -79,10 +79,10 @@ def join_unique_build(probe: Batch, build: Batch, probe_keys: tuple,
     if kind == "semi":
         return probe.with_live(probe.live & matched), dup
     if kind == "anti":
-        # NULL probe keys never match and never fail to match: SQL NOT IN
-        # semantics are handled by the planner (this is the semi-join
-        # complement used for correlated-exists rewrites)
-        return probe.with_live(probe.live & ~matched & pk_valid), dup
+        # EXISTS-complement: a NULL probe key matches nothing, so the row
+        # survives NOT EXISTS. NOT IN's null-awareness is the planner's
+        # job (IS NOT NULL pre-filter + executor build-null check).
+        return probe.with_live(probe.live & ~matched), dup
 
     build_cols = []
     for col in build.columns:
@@ -152,3 +152,68 @@ def join_expand(probe: Batch, build: Batch, probe_keys: tuple,
         out_cols.append(Column(data=col.data[build_row],
                                valid=col.valid[build_row] & matched))
     return Batch(columns=tuple(out_cols), live=out_live), total
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def join_mark(probe: Batch, build: Batch, probe_keys: tuple,
+              build_keys: tuple, residual, out_capacity: int):
+    """Mark join: per probe row, does ANY build row match the equi keys AND
+    the residual predicate? Powers semi/anti joins with non-equi correlated
+    conditions (TPC-H q21's l2.l_suppkey <> l1.l_suppkey), the role of
+    Trino's JoinFilterFunction on semi joins
+    (sql/gen/JoinFilterFunctionCompiler.java).
+
+    Same two-pass expansion as join_expand; the residual is evaluated over
+    the expanded pair batch (probe columns ++ build columns), then reduced
+    back per probe row with a cumulative-count window — scatter-free.
+
+    Returns (mark_bool_per_probe_row, total_pairs). total_pairs >
+    out_capacity means the expansion overflowed; caller grows and retries.
+    """
+    from .project import filter_mask
+
+    pk, pk_valid = _combined_key(probe, probe_keys)
+    bk, bk_valid = _combined_key(build, build_keys)
+    n_build = build.capacity
+    n_probe = probe.capacity
+
+    bk_eff = jnp.where(build.live & bk_valid, bk, _SENTINEL)
+    sorted_keys, order = jax.lax.sort(
+        (bk_eff, jnp.arange(n_build, dtype=jnp.int32)), num_keys=1)
+
+    lo = jnp.searchsorted(sorted_keys, pk, side="left")
+    hi = jnp.searchsorted(sorted_keys, pk, side="right")
+    pk_ok = probe.live & pk_valid & (pk != _SENTINEL)
+    counts = jnp.where(pk_ok, hi - lo, 0)
+    cum = jnp.cumsum(counts)
+    total = cum[n_probe - 1]
+
+    j = jnp.arange(out_capacity, dtype=cum.dtype)
+    probe_row = jnp.searchsorted(cum, j, side="right")
+    probe_row_c = jnp.clip(probe_row, 0, n_probe - 1)
+    before = jnp.where(probe_row_c > 0,
+                       cum[jnp.clip(probe_row_c - 1, 0, n_probe - 1)], 0)
+    within = j - before
+    pair_live = (j < total) & (within < counts[probe_row_c])
+    build_row = order[jnp.clip(lo[probe_row_c] + within, 0, n_build - 1)]
+
+    pair_cols = []
+    for col in probe.columns:
+        pair_cols.append(Column(data=col.data[probe_row_c],
+                                valid=col.valid[probe_row_c] & pair_live))
+    for col in build.columns:
+        pair_cols.append(Column(data=col.data[build_row],
+                                valid=col.valid[build_row] & pair_live))
+    pairs = Batch(columns=tuple(pair_cols), live=pair_live)
+    ok = filter_mask(residual, pairs) & pair_live if residual is not None \
+        else pair_live
+
+    # per-probe-row "any ok": windowed sum over the cumulative ok counts
+    cs = jnp.cumsum(ok.astype(jnp.int64))
+    start = jnp.clip(jnp.minimum(cum - counts, out_capacity - 1), 0, None)
+    end = jnp.clip(cum - 1, 0, out_capacity - 1)
+    upto_end = cs[end]
+    before_start = jnp.where(start > 0, cs[jnp.clip(start - 1, 0,
+                                                    out_capacity - 1)], 0)
+    any_ok = (counts > 0) & ((upto_end - before_start) > 0)
+    return any_ok, total

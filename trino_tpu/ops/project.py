@@ -261,6 +261,12 @@ def eval_expr(expr: ir.Expr, batch: Batch):
             return d.astype(jnp.int32), v
         raise NotImplementedError(f"cast {src} -> {dst}")
 
+    if isinstance(expr, ir.DerivedDict):
+        d, v = eval_expr(expr.arg, batch)
+        lut = jnp.asarray(expr.lut, dtype=jnp.int32)
+        codes = jnp.clip(d.astype(jnp.int32), 0, len(expr.lut) - 1)
+        return lut[codes], v
+
     if isinstance(expr, ir.DictPredicate):
         d, v = eval_expr(expr.arg, batch)
         lut = jnp.asarray(expr.lut, dtype=jnp.bool_)

@@ -168,10 +168,17 @@ def like_to_regex(pattern: str, escape: Optional[str]) -> re.Pattern:
 # --------------------------------------------------------------------------
 
 class ExpressionLowerer:
-    """Lowers an AST expression (no aggregates) to typed IR over a scope."""
+    """Lowers an AST expression (no aggregates) to typed IR over a scope.
 
-    def __init__(self, scope: Scope):
+    `planner` (optional) enables uncorrelated scalar subquery lowering:
+    the subquery is planned independently and embedded as a
+    ScalarSubqueryRef the executor folds to a constant. Correlated
+    subqueries fail to plan here and are handled by the planner's
+    subquery-predicate pass (decorrelation to joins)."""
+
+    def __init__(self, scope: Scope, planner=None):
         self.scope = scope
+        self.planner = planner
 
     def lower(self, node: A.Node) -> ir.Expr:
         if isinstance(node, A.Identifier):
@@ -279,9 +286,40 @@ class ExpressionLowerer:
             if node.name in AGG_NAMES:
                 raise AnalysisError(
                     f"aggregate {node.name}() not allowed here")
+            if node.name in ("substring", "substr"):
+                return self.lower_substring(node)
             raise AnalysisError(f"unsupported function {node.name}()")
 
+        if isinstance(node, A.ScalarSubquery):
+            if self.planner is None:
+                raise AnalysisError(
+                    "scalar subquery not allowed in this context")
+            sub = self.planner.plan_query(node.query)   # raises if correlated
+            if len(sub.scope.columns) != 1:
+                raise AnalysisError("scalar subquery must return one column")
+            return ir.ScalarSubqueryRef(sub.node, sub.scope.columns[0].dtype)
+
         raise AnalysisError(f"unsupported expression {type(node).__name__}")
+
+    def lower_substring(self, node: A.FunctionCall) -> ir.Expr:
+        """substring(varchar_col, start, length): transform the string pool
+        host-side; device codes are unchanged (DerivedDict)."""
+        if len(node.args) != 3:
+            raise AnalysisError("substring(col, start, length) expected")
+        arg = self.lower(node.args[0])
+        if arg.dtype.kind is not TypeKind.VARCHAR:
+            raise AnalysisError("substring requires a varchar argument")
+        try:
+            start = int(node.args[1].text)
+            length = int(node.args[2].text)
+        except (AttributeError, ValueError):
+            raise AnalysisError("substring start/length must be integers")
+        pool = self.pool_of(arg)
+        transformed = [s[start - 1:start - 1 + length] for s in pool]
+        new_pool = tuple(sorted(set(transformed)))
+        index = {s: i for i, s in enumerate(new_pool)}
+        lut = tuple(index[s] for s in transformed)
+        return ir.DerivedDict(arg, lut, new_pool, arg.dtype)
 
     # ---- helpers ----------------------------------------------------------
 
@@ -378,6 +416,8 @@ class ExpressionLowerer:
     # ---- dictionary predicates --------------------------------------------
 
     def pool_of(self, col: ir.Expr) -> tuple:
+        if isinstance(col, ir.DerivedDict):
+            return col.pool
         if not isinstance(col, ir.ColumnRef):
             raise AnalysisError("varchar predicate requires a plain column")
         sc = next(c for c in self.scope.columns if c.index == col.index

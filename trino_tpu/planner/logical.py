@@ -84,6 +84,7 @@ class JoinNode(PlanNode):
     residual: Optional[ir.Expr]       # over concatenated output
     build_unique: bool                # planner's guarantee/assumption
     output: Tuple
+    null_aware: bool = False          # NOT IN semantics (anti only)
 
 
 @dataclass(frozen=True)

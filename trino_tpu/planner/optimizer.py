@@ -88,23 +88,30 @@ def _prune(node: L.PlanNode, needed: frozenset):
 
     if isinstance(node, L.JoinNode):
         n_probe = len(node.left.output)
+        # the residual addresses the probe++build pair layout, even for
+        # semi/anti joins whose own output is probe-only
+        res_refs = set() if node.residual is None else \
+            ir.referenced_columns(node.residual)
         probe_needed = {i for i in needed if i < n_probe} | \
-            set(node.left_keys)
+            set(node.left_keys) | {i for i in res_refs if i < n_probe}
         build_needed = {i - n_probe for i in needed if i >= n_probe} | \
-            set(node.right_keys)
+            set(node.right_keys) | \
+            {i - n_probe for i in res_refs if i >= n_probe}
         left, ml = _prune(node.left, frozenset(probe_needed))
         right, mr = _prune(node.right, frozenset(build_needed))
         n_new_probe = len(left.output)
-        mapping = {}
-        for old in range(len(node.output)):
-            if old < n_probe:
-                if old in ml:
-                    mapping[old] = ml[old]
-            else:
-                if (old - n_probe) in mr:
-                    mapping[old] = n_new_probe + mr[old - n_probe]
+        # pair mapping covers probe++build regardless of join kind (the
+        # residual uses it); the returned mapping is restricted to the
+        # node's own output layout (probe-only for semi/anti)
+        pair_mapping = {}
+        for old, new in ml.items():
+            pair_mapping[old] = new
+        for old, new in mr.items():
+            pair_mapping[n_probe + old] = n_new_probe + new
+        mapping = {old: new for old, new in pair_mapping.items()
+                   if old < len(node.output)}
         residual = None if node.residual is None else \
-            ir.remap_columns(node.residual, mapping)
+            ir.remap_columns(node.residual, pair_mapping)
         return L.JoinNode(
             node.kind, left, right,
             tuple(ml[k] for k in node.left_keys),
@@ -112,7 +119,8 @@ def _prune(node: L.PlanNode, needed: frozenset):
             residual, node.build_unique,
             tuple(left.output) + (tuple(right.output)
                                   if node.kind in ("inner", "left")
-                                  else ())), mapping
+                                  else ()),
+            null_aware=node.null_aware), mapping
 
     if isinstance(node, L.SortNode):
         child_needed = needed | {k.index for k in node.keys}

@@ -33,7 +33,7 @@ from ..types import BIGINT, DOUBLE, DataType, TypeKind
 from . import logical as L
 from .analyzer import (AGG_NAMES, AnalysisError, ExpressionLowerer, Scope,
                        ScopeColumn, ast_children, contains_aggregate,
-                       parse_type)
+                       flip, parse_type)
 
 from ..ops.aggregate import MAX_DIRECT_GROUPS  # dense-domain cutoff (64)
 
@@ -52,6 +52,7 @@ class Planner:
         self.catalog = catalog
         self.default_catalog = default_catalog
         self.default_schema = default_schema
+        self.ctes: Dict[str, A.Query] = {}   # WITH-bound names, lexically scoped
 
     # ------------------------------------------------------------------
     # relations
@@ -59,6 +60,16 @@ class Planner:
 
     def plan_table(self, ref: A.TableRef) -> PlannedRelation:
         parts = [p.lower() for p in ref.name]
+        if len(parts) == 1 and parts[0] in self.ctes:
+            # a CTE body must not see its own binding (non-recursive WITH)
+            saved = self.ctes
+            self.ctes = {k: v for k, v in self.ctes.items()
+                         if k != parts[0]}
+            try:
+                sub = self.plan_query(saved[parts[0]])
+            finally:
+                self.ctes = saved
+            return self.wrap_subplan(sub, (ref.alias or parts[0]).lower())
         if len(parts) == 3:
             cat, sch, tbl = parts
         elif len(parts) == 2:
@@ -76,6 +87,16 @@ class Planner:
                 for i, f in enumerate(schema.fields)]
         return PlannedRelation(node, Scope(cols))
 
+    def wrap_subplan(self, sub: "PlannedRelation",
+                     alias: str) -> PlannedRelation:
+        """Embed a planned subquery/CTE as a relation under `alias`."""
+        node = sub.node.child if isinstance(sub.node, L.OutputNode) \
+            else sub.node
+        cols = [ScopeColumn(alias, name.lower(), dtype, i, fld)
+                for i, ((name, dtype), fld) in enumerate(
+                    zip(node.output, sub_fields(sub)))]
+        return PlannedRelation(node, Scope(cols))
+
     def plan_relation_tree(self, rel: A.Node) -> Tuple[List[PlannedRelation],
                                                        List[A.Node]]:
         """Flatten the FROM tree into base relations + ON conjuncts."""
@@ -87,15 +108,7 @@ class Planner:
                 relations.append(self.plan_table(node))
             elif isinstance(node, A.SubqueryRef):
                 sub = self.plan_query(node.query)
-                alias = node.alias.lower()
-                cols = [ScopeColumn(alias, name.lower(), dtype, i, fld)
-                        for i, ((name, dtype), fld) in enumerate(
-                            zip(sub.node.output, sub_fields(sub)))]
-                relations.append(PlannedRelation(sub.node.child
-                                                 if isinstance(sub.node,
-                                                               L.OutputNode)
-                                                 else sub.node,
-                                                 Scope(cols)))
+                relations.append(self.wrap_subplan(sub, node.alias.lower()))
             elif isinstance(node, A.Join):
                 if node.kind not in ("inner", "cross", "left"):
                     raise AnalysisError(
@@ -133,15 +146,41 @@ class Planner:
 
     def build_join_tree(self, relations: List[PlannedRelation],
                         conjuncts: List[A.Node]) -> PlannedRelation:
-        """Left-deep join in FROM order; equi-conjuncts become join keys,
-        single-relation conjuncts push down, leftovers become filters."""
-        acc = relations[0]
-        acc = self.apply_local_filters(acc, conjuncts)
-        for nxt in relations[1:]:
-            nxt = self.apply_local_filters(nxt, conjuncts)
-            acc = self.join_pair(acc, nxt, conjuncts, kind="inner")
+        """Left-deep join tree; equi-conjuncts become join keys,
+        single-relation conjuncts push down, leftovers become filters.
+
+        Order: start from the first FROM relation, then greedily take the
+        next relation connected to the accumulated tree by an equi edge —
+        the connectivity-driven part of Trino's join-graph reordering
+        (iterative/rule/ReorderJoins.java:97), without the cost search."""
+        pending = list(relations[1:])
+        acc = self.apply_local_filters(relations[0], conjuncts)
+        while pending:
+            chosen = None
+            for nxt in pending:
+                if self.has_equi_edge(acc, nxt, conjuncts):
+                    chosen = nxt
+                    break
+            if chosen is None:
+                raise AnalysisError(
+                    "cross join without equi-condition not yet supported")
+            pending.remove(chosen)
+            chosen = self.apply_local_filters(chosen, conjuncts)
+            acc = self.join_pair(acc, chosen, conjuncts, kind="inner")
             acc = self.apply_local_filters(acc, conjuncts)
         return acc
+
+    def has_equi_edge(self, left: PlannedRelation, right: PlannedRelation,
+                      conjuncts: List[A.Node]) -> bool:
+        for c in conjuncts:
+            eq = as_equi(c)
+            if eq is None:
+                continue
+            a, b = eq
+            if (left.scope.try_resolve(a) and right.scope.try_resolve(b)) or \
+               (left.scope.try_resolve(b) and right.scope.try_resolve(a)):
+                return True
+        return False
 
     def apply_local_filters(self, rel: PlannedRelation,
                             conjuncts: List[A.Node]) -> PlannedRelation:
@@ -149,7 +188,7 @@ class Planner:
         applied = []
         preds = []
         for c in conjuncts:
-            lowerer = ExpressionLowerer(rel.scope)
+            lowerer = ExpressionLowerer(rel.scope, planner=self)
             try:
                 preds.append(lowerer.to_bool(lowerer.lower(c)))
                 applied.append(c)
@@ -222,6 +261,11 @@ class Planner:
         conjuncts: List[A.Node] = []
         if condition is not None:
             split_conjuncts(condition, conjuncts)
+        # ON conjuncts referencing only the build side filter the match
+        # candidates, never the preserved side — push them into the build
+        # input (Trino PredicatePushDown's inner-side pushdown for outer
+        # joins). Preserved-side-only ON conjuncts cannot be pushed.
+        right = self.apply_local_filters(right, conjuncts)
         rel = self.join_pair(left, right, conjuncts, kind="left")
         if conjuncts:
             raise AnalysisError("non-equi LEFT JOIN condition unsupported")
@@ -274,11 +318,23 @@ class Planner:
     def plan_query(self, q: A.Query) -> PlannedRelation:
         if q.relation is None:
             raise AnalysisError("SELECT without FROM not yet supported")
+        saved_ctes = self.ctes
+        if q.ctes:
+            self.ctes = dict(self.ctes)
+            for name, cq in q.ctes:
+                self.ctes[name.lower()] = cq
+        try:
+            return self.plan_query_body(q)
+        finally:
+            self.ctes = saved_ctes
+
+    def plan_query_body(self, q: A.Query) -> PlannedRelation:
         relations, on_conjuncts = self.plan_relation_tree(q.relation)
 
         conjuncts: List[A.Node] = list(on_conjuncts)
         if q.where is not None:
             split_conjuncts(q.where, conjuncts)
+        add_or_common_conjuncts(conjuncts)
 
         if len(relations) == 1:
             rel = self.apply_local_filters(relations[0], conjuncts)
@@ -287,6 +343,19 @@ class Planner:
         # residual multi-relation predicates (e.g. q19's OR-of-blocks)
         # become filters over the joined scope
         rel = self.apply_local_filters(rel, conjuncts)
+        # subquery predicates: decorrelate to semi/anti/aggregate joins
+        # (the role of Trino's TransformCorrelated* / TransformUncorrelated*
+        # iterative rules, sql/planner/iterative/rule/)
+        progress = True
+        while progress and conjuncts:
+            progress = False
+            for c in list(conjuncts):
+                new_rel = self.plan_subquery_conjunct(rel, c)
+                if new_rel is not None:
+                    conjuncts.remove(c)
+                    rel = self.apply_local_filters(new_rel, conjuncts)
+                    progress = True
+                    break
         if conjuncts:
             raise AnalysisError(
                 f"unplaced predicate(s): {conjuncts}")
@@ -365,6 +434,9 @@ class Planner:
 
     def field_for(self, e: ir.Expr, scope: Scope):
         """Propagate dictionary fields through bare column projections."""
+        if isinstance(e, ir.DerivedDict):
+            from ..batch import Field
+            return Field("$derived", e.dtype, dictionary=e.pool)
         if isinstance(e, ir.ColumnRef) and \
                 e.dtype.kind is TypeKind.VARCHAR:
             for c in scope.columns:
@@ -414,9 +486,14 @@ class Planner:
             return len(pre_exprs) - 1
 
         n_keys = len(group_irs)
+        distinct_args: List[int] = []
         for call in agg_calls:
-            if call.distinct:
-                raise AnalysisError("DISTINCT aggregates not yet supported")
+            if call.distinct and call.name == "avg":
+                raise AnalysisError("avg(DISTINCT) not yet supported")
+            if call.distinct and call.name in ("sum", "count"):
+                if not group_irs:
+                    raise AnalysisError(
+                        "global DISTINCT aggregates not yet supported")
             if call.is_star or (call.name == "count" and not call.args):
                 agg_specs.append(L.AggSpecNode("count_star", None,
                                                "count", BIGINT))
@@ -427,9 +504,18 @@ class Planner:
             arg = lowerer.lower(call.args[0])
             slot = add_arg(arg)
             t = arg.dtype
+            # min/max DISTINCT == plain min/max; sum/count DISTINCT need
+            # the sort kernel's duplicate-elimination (one distinct column
+            # per aggregation, enforced below)
+            distinct = call.distinct and call.name in ("sum", "count")
+            if distinct:
+                distinct_args.append(slot)
+                if len(set(distinct_args)) > 1:
+                    raise AnalysisError(
+                        "multiple DISTINCT aggregate arguments unsupported")
             if call.name == "count":
                 agg_specs.append(L.AggSpecNode("count", ir.ColumnRef(
-                    slot, t), "count", BIGINT))
+                    slot, t), "count", BIGINT, distinct))
                 call_slots[call] = ("plain", len(agg_specs) - 1, -1)
             elif call.name in ("min", "max"):
                 agg_specs.append(L.AggSpecNode(call.name, ir.ColumnRef(
@@ -438,7 +524,7 @@ class Planner:
             elif call.name == "sum":
                 out_t = sum_type(t)
                 agg_specs.append(L.AggSpecNode("sum", ir.ColumnRef(slot, t),
-                                               "sum", out_t))
+                                               "sum", out_t, distinct))
                 call_slots[call] = ("plain", len(agg_specs) - 1, -1)
             elif call.name == "avg":
                 out_t = t if t.kind is TypeKind.DECIMAL else DOUBLE
@@ -454,7 +540,7 @@ class Planner:
 
         # aggregation strategy
         strategy, domains, capacity = self.agg_strategy(
-            group_irs, scope, pre_node)
+            group_irs, scope, pre_node, any_distinct=bool(distinct_args))
         agg_out = tuple(
             [(f"gk{i}", e.dtype) for i, e in enumerate(group_irs)] +
             [(s.out_name, s.out_dtype) for s in agg_specs])
@@ -512,6 +598,9 @@ class Planner:
             if isinstance(node, A.CastExpr):
                 return ir.Cast(rewrite(node.arg),
                                parse_type(node.type_name))
+            if isinstance(node, A.ScalarSubquery):
+                return ExpressionLowerer(post_scope, planner=self).lower(
+                    node)
             raise AnalysisError(
                 f"unsupported post-aggregation expression "
                 f"{type(node).__name__}")
@@ -546,9 +635,12 @@ class Planner:
         return (PlannedRelation(post_node, Scope(final_scope)),
                 post_exprs, names)
 
-    def agg_strategy(self, group_irs, scope: Scope, pre_node):
+    def agg_strategy(self, group_irs, scope: Scope, pre_node,
+                     any_distinct: bool = False):
         if not group_irs:
             return "global", (), 0
+        if any_distinct:
+            return "sort", (), DEFAULT_SORT_GROUPS   # needs the sort kernel
         domains = []
         for e in group_irs:
             d = self.domain_of(e, scope)
@@ -563,6 +655,8 @@ class Planner:
         return "sort", (), DEFAULT_SORT_GROUPS
 
     def domain_of(self, e: ir.Expr, scope: Scope) -> Optional[int]:
+        if isinstance(e, ir.DerivedDict):
+            return len(e.pool)
         if isinstance(e, ir.ColumnRef):
             if e.dtype.kind is TypeKind.VARCHAR:
                 for c in scope.columns:
@@ -572,6 +666,196 @@ class Planner:
             if e.dtype.kind is TypeKind.BOOLEAN:
                 return 2
         return None
+
+
+    # ------------------------------------------------------------------
+    # subquery predicates -> joins (decorrelation)
+    # ------------------------------------------------------------------
+
+    def plan_subquery_conjunct(self, rel: PlannedRelation,
+                               c: A.Node) -> Optional[PlannedRelation]:
+        """Try to absorb one unplaced conjunct that contains a subquery.
+        Returns the rewritten relation, or None if this conjunct is not a
+        supported subquery shape."""
+        if isinstance(c, A.ExistsPredicate):
+            return self.plan_exists(rel, c.query, c.negated)
+        if isinstance(c, A.UnaryOp) and c.op == "not" and \
+                isinstance(c.arg, A.ExistsPredicate):
+            return self.plan_exists(rel, c.arg.query, not c.arg.negated)
+        if isinstance(c, A.InSubquery):
+            return self.plan_in_subquery(rel, c)
+        if isinstance(c, A.BinaryOp) and c.op in ("=", "<>", "<", "<=",
+                                                  ">", ">="):
+            if isinstance(c.right, A.ScalarSubquery):
+                return self.plan_correlated_scalar(rel, c.op, c.left,
+                                                   c.right.query)
+            if isinstance(c.left, A.ScalarSubquery):
+                return self.plan_correlated_scalar(rel, flip(c.op), c.right,
+                                                   c.left.query)
+        return None
+
+    def plan_inner_with_correlation(self, outer: PlannedRelation,
+                                    subq: A.Query):
+        """Plan a subquery's FROM/WHERE, separating correlation.
+
+        Returns (inner_rel, corr_pairs, residual_asts):
+        - corr_pairs: [(outer_col_index, inner_col_index)] from equi
+          conjuncts linking the scopes (the future join keys);
+        - residual_asts: leftover conjuncts referencing both scopes
+          (lowered later over the concatenated probe++build scope).
+        Inner-only conjuncts are already pushed into inner_rel."""
+        if subq.group_by or subq.having or subq.ctes:
+            raise AnalysisError(
+                "correlated subquery with GROUP BY/HAVING unsupported")
+        inner_rels, on_conj = self.plan_relation_tree(subq.relation)
+        conjuncts: List[A.Node] = list(on_conj)
+        if subq.where is not None:
+            split_conjuncts(subq.where, conjuncts)
+        add_or_common_conjuncts(conjuncts)
+        inner = self.combine_relations(inner_rels, conjuncts)
+        inner = self.apply_local_filters(inner, conjuncts)
+        corr: List[Tuple[int, ScopeColumn]] = []
+        residual: List[A.Node] = []
+        for c in list(conjuncts):
+            eq = as_equi(c)
+            if eq is not None:
+                a, b = eq
+                oa = outer.scope.try_resolve(a)
+                ib = inner.scope.try_resolve(b)
+                if oa is not None and ib is not None:
+                    corr.append((oa.index, ib))
+                    conjuncts.remove(c)
+                    continue
+                ob = outer.scope.try_resolve(b)
+                ia = inner.scope.try_resolve(a)
+                if ob is not None and ia is not None:
+                    corr.append((ob.index, ia))
+                    conjuncts.remove(c)
+                    continue
+            residual.append(c)
+            conjuncts.remove(c)
+        return inner, corr, residual
+
+    def pair_scope(self, outer: PlannedRelation,
+                   inner: PlannedRelation) -> Scope:
+        """Concatenated probe++build scope for join residual lowering."""
+        n = len(outer.node.output)
+        cols = list(outer.scope.columns) + [
+            ScopeColumn(c.qualifier, c.name, c.dtype, c.index + n, c.field)
+            for c in inner.scope.columns]
+        return Scope(cols)
+
+    def plan_exists(self, outer: PlannedRelation, subq: A.Query,
+                    negated: bool) -> PlannedRelation:
+        """[NOT] EXISTS (correlated) -> semi/anti join
+        (TransformCorrelatedExistsToJoin's role). Non-equi correlated
+        conjuncts become the join residual (mark-join kernel)."""
+        inner, corr, residual_asts = self.plan_inner_with_correlation(
+            outer, subq)
+        if not corr:
+            raise AnalysisError("uncorrelated EXISTS not supported")
+        residual = None
+        if residual_asts:
+            lowerer = ExpressionLowerer(self.pair_scope(outer, inner),
+                                        planner=self)
+            preds = [lowerer.to_bool(lowerer.lower(x))
+                     for x in residual_asts]
+            residual = preds[0] if len(preds) == 1 else ir.Logical(
+                "and", tuple(preds))
+        node = L.JoinNode("anti" if negated else "semi",
+                          outer.node, inner.node,
+                          tuple(o for o, _ in corr),
+                          tuple(c.index for _, c in corr),
+                          residual, False, tuple(outer.node.output))
+        return PlannedRelation(node, outer.scope)
+
+    def plan_in_subquery(self, outer: PlannedRelation,
+                         c: A.InSubquery) -> PlannedRelation:
+        """x [NOT] IN (subquery) -> semi/anti join on x = subquery output.
+        NOT IN is null-aware: NULL x never passes (pre-filter), and any
+        NULL in the subquery output empties the result (executor check) —
+        SQL three-valued NOT IN semantics."""
+        sub = self.plan_query(c.query)
+        if len(sub.scope.columns) != 1:
+            raise AnalysisError("IN subquery must return one column")
+        build_node = sub.node.child if isinstance(sub.node, L.OutputNode) \
+            else sub.node
+
+        lowerer = ExpressionLowerer(outer.scope, planner=self)
+        key = lowerer.lower(c.arg)
+        probe = outer
+        if isinstance(key, ir.DerivedDict):
+            # derived codes are private to this column's pool; matching
+            # them against another relation's codes would be meaningless
+            raise AnalysisError(
+                "IN subquery on a string expression is unsupported")
+        if not isinstance(key, ir.ColumnRef):
+            # extend the probe with a computed key column (hidden)
+            exprs = [ir.ColumnRef(i, t, n) for i, (n, t)
+                     in enumerate(outer.node.output)] + [key]
+            out = tuple(outer.node.output) + ((f"$inkey", key.dtype),)
+            probe = PlannedRelation(
+                L.ProjectNode(outer.node, tuple(exprs), out), outer.scope)
+            key = ir.ColumnRef(len(out) - 1, key.dtype)
+        if c.negated:
+            # NULL probe keys can never satisfy NOT IN
+            probe = PlannedRelation(
+                L.FilterNode(probe.node, ir.IsNull(key, negated=True),
+                             probe.node.output), probe.scope)
+        node = L.JoinNode("anti" if c.negated else "semi",
+                          probe.node, build_node,
+                          (key.index,), (0,), None, False,
+                          tuple(probe.node.output),
+                          null_aware=c.negated)
+        return PlannedRelation(node, outer.scope)
+
+    def plan_correlated_scalar(self, outer: PlannedRelation, op: str,
+                               outer_ast: A.Node,
+                               subq: A.Query) -> PlannedRelation:
+        """expr <op> (SELECT agg(...) FROM ... WHERE corr) ->
+        group the subquery by its correlation keys, join, filter.
+        (TransformCorrelatedScalarSubquery + aggregation decorrelation.)"""
+        if len(subq.select) != 1 or subq.select[0].expr is None:
+            raise AnalysisError("scalar subquery must select one expression")
+        if not contains_aggregate(subq.select[0].expr):
+            raise AnalysisError(
+                "correlated scalar subquery must be an aggregate")
+        inner, corr, residual = self.plan_inner_with_correlation(outer, subq)
+        if residual:
+            raise AnalysisError(
+                f"non-equi correlated scalar subquery: {residual}")
+        if not corr:
+            raise AnalysisError(
+                "uncorrelated scalar subquery reached the correlated path")
+
+        # synthesize: SELECT k1.., <agg expr> GROUP BY k1..
+        group_asts = []
+        for _, icol in corr:
+            parts = (icol.qualifier, icol.name) if icol.qualifier \
+                else (icol.name,)
+            group_asts.append(A.Identifier(parts))
+        select = tuple(A.SelectItem(g, f"$ck{i}")
+                       for i, g in enumerate(group_asts)) + \
+            (A.SelectItem(subq.select[0].expr, "$val"),)
+        synth = A.Query(select=select, distinct=False, relation=None,
+                        where=None, group_by=tuple(group_asts),
+                        having=None, order_by=(), limit=None)
+        agg_rel, _, _ = self.plan_aggregation(synth, inner)
+
+        k = len(corr)
+        out = tuple(outer.node.output) + tuple(agg_rel.node.output)
+        join = L.JoinNode("inner", outer.node, agg_rel.node,
+                          tuple(o for o, _ in corr), tuple(range(k)),
+                          None, True, out)
+        n_outer = len(outer.node.output)
+        val_name, val_t = agg_rel.node.output[k]
+        val_ref = ir.ColumnRef(n_outer + k, val_t, val_name)
+        outer_e = ExpressionLowerer(outer.scope, planner=self).lower(
+            outer_ast)
+        pred = ir.Compare(op, outer_e, val_ref)
+        node = L.FilterNode(join, pred, out)
+        # visible scope stays the outer's; joined agg columns are hidden
+        return PlannedRelation(node, outer.scope)
 
     def resolve_order_expr(self, ast: A.Node, q: A.Query,
                            rel: PlannedRelation, names: List[str]) -> int:
@@ -602,6 +886,38 @@ def split_conjuncts(node: A.Node, out: List[A.Node]) -> None:
     if isinstance(node, A.BinaryOp) and node.op == "and":
         split_conjuncts(node.left, out)
         split_conjuncts(node.right, out)
+    else:
+        out.append(node)
+
+
+def add_or_common_conjuncts(conjuncts: List[A.Node]) -> None:
+    """For each OR conjunct, pull out predicates present in every branch
+    (sound: the OR implies them). TPC-H q19's join key p_partkey=l_partkey
+    lives inside each OR block; Trino's ExtractCommonPredicatesExpression-
+    Rewrite (sql/ir/optimizer/) performs the same extraction. The original
+    OR stays as a residual filter."""
+    extracted: List[A.Node] = []
+    for c in conjuncts:
+        branches: List[A.Node] = []
+        split_disjuncts(c, branches)
+        if len(branches) < 2:
+            continue
+        branch_conjs = []
+        for b in branches:
+            bc: List[A.Node] = []
+            split_conjuncts(b, bc)
+            branch_conjs.append(bc)
+        for cand in branch_conjs[0]:
+            if all(cand in bc for bc in branch_conjs[1:]):
+                if cand not in conjuncts and cand not in extracted:
+                    extracted.append(cand)
+    conjuncts.extend(extracted)
+
+
+def split_disjuncts(node: A.Node, out: List[A.Node]) -> None:
+    if isinstance(node, A.BinaryOp) and node.op == "or":
+        split_disjuncts(node.left, out)
+        split_disjuncts(node.right, out)
     else:
         out.append(node)
 

@@ -189,6 +189,7 @@ class Query(Node):
     having: Optional[Node]
     order_by: Tuple[OrderItem, ...]
     limit: Optional[int]
+    ctes: Tuple = ()                # WITH name AS (query), ...
 
 
 @dataclass(frozen=True)

@@ -19,7 +19,7 @@ RESERVED_STOPPERS = {
     "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "AND", "OR", "NOT", "AS",
     "BY", "ASC", "DESC", "UNION", "EXCEPT", "INTERSECT", "SELECT", "THEN",
     "WHEN", "ELSE", "END", "IS", "IN", "LIKE", "BETWEEN", "NULLS", "FIRST",
-    "LAST", "EXISTS", "CASE", "DISTINCT",
+    "LAST", "EXISTS", "CASE", "DISTINCT", "WITH",
 }
 
 
@@ -100,6 +100,19 @@ class Parser:
         self.fail("unsupported SHOW statement")
 
     def parse_query(self) -> A.Query:
+        ctes = []
+        if self.accept_kw("WITH"):
+            while True:
+                t = self.advance()
+                if t.kind != "name":
+                    self.fail("expected CTE name after WITH")
+                self.expect_kw("AS")
+                self.expect_op("(")
+                cq = self.parse_query()
+                self.expect_op(")")
+                ctes.append((t.raw, cq))
+                if not self.accept_op(","):
+                    break
         self.expect_kw("SELECT")
         distinct = self.accept_kw("DISTINCT")
         self.accept_kw("ALL")
@@ -139,7 +152,7 @@ class Parser:
             limit = int(t.text)
 
         return A.Query(tuple(select), distinct, relation, where, group_by,
-                       having, order_by, limit)
+                       having, order_by, limit, tuple(ctes))
 
     # ---- select items / order items --------------------------------------
 
