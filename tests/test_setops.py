@@ -1,0 +1,154 @@
+"""Set operations, VALUES, and FROM-less SELECT.
+
+Reference behavior: UNION/INTERSECT/EXCEPT semantics per the SQL spec as
+implemented by Trino (sql/planner/plan/UnionNode.java, IntersectNode.java,
+ExceptNode.java; set-op NULLs compare as equal, like GROUP BY keys).
+"""
+
+import pytest
+
+from trino_tpu.exec.session import Session
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session()
+
+
+def rows(session, sql):
+    return session.execute(sql).rows
+
+
+def test_union_all(session):
+    assert rows(session, "SELECT 1 AS x UNION ALL SELECT 2") == [(1,), (2,)]
+
+
+def test_union_distinct(session):
+    got = rows(session, "SELECT 1 AS x UNION SELECT 1 UNION SELECT 2 "
+                        "ORDER BY x")
+    assert got == [(1,), (2,)]
+
+
+def test_union_keeps_duplicates_within_all(session):
+    got = rows(session,
+               "SELECT * FROM (VALUES 1, 2, 2) t(x) UNION ALL "
+               "SELECT * FROM (VALUES 2) u(y) ORDER BY 1")
+    assert got == [(1,), (2,), (2,), (2,)]
+
+
+def test_intersect(session):
+    got = rows(session,
+               "SELECT * FROM (VALUES 1, 2, 2, 3) t(x) INTERSECT "
+               "SELECT * FROM (VALUES 2, 3, 4) u(y) ORDER BY 1")
+    assert got == [(2,), (3,)]
+
+
+def test_intersect_all_bag_semantics(session):
+    got = rows(session,
+               "SELECT * FROM (VALUES 1, 2, 2, 2) t(x) INTERSECT ALL "
+               "SELECT * FROM (VALUES 2, 2, 4) u(y) ORDER BY 1")
+    assert got == [(2,), (2,)]
+
+
+def test_except(session):
+    got = rows(session,
+               "SELECT * FROM (VALUES 1, 2, 3, 2) t(x) EXCEPT "
+               "SELECT 2 ORDER BY 1")
+    assert got == [(1,), (3,)]
+
+
+def test_except_all_bag_semantics(session):
+    got = rows(session,
+               "SELECT * FROM (VALUES 1, 2, 2, 3) t(x) EXCEPT ALL "
+               "SELECT 2 ORDER BY 1")
+    assert got == [(1,), (2,), (3,)]
+
+
+def test_set_op_nulls_compare_equal(session):
+    got = rows(session,
+               "SELECT * FROM (VALUES 1, NULL, NULL) t(x) UNION "
+               "SELECT * FROM (VALUES NULL) u(y)")
+    assert sorted(got, key=lambda r: (r[0] is None, r[0])) == \
+        [(1,), (None,)]
+
+
+def test_union_varchar_dictionary_merge(session):
+    got = rows(session, "SELECT 'a' AS s UNION SELECT 'b' UNION SELECT 'a' "
+                        "ORDER BY s")
+    assert got == [("a",), ("b",)]
+
+
+def test_union_over_table_strings(session):
+    got = rows(session,
+               "SELECT l_returnflag AS f FROM lineitem UNION "
+               "SELECT l_linestatus FROM lineitem ORDER BY f")
+    assert got == [("A",), ("F",), ("N",), ("O",), ("R",)]
+
+
+def test_union_type_coercion(session):
+    got = rows(session,
+               "SELECT 1 AS x UNION ALL SELECT CAST(2.5 AS decimal(3,1)) "
+               "ORDER BY 1")
+    assert [float(x) for (x,) in got] == [1.0, 2.5]
+
+
+def test_set_op_order_and_limit_bind_to_whole(session):
+    got = rows(session, "SELECT 3 AS x UNION ALL SELECT 1 UNION ALL "
+                        "SELECT 2 ORDER BY x DESC LIMIT 2")
+    assert got == [(3,), (2,)]
+
+
+def test_intersect_precedence_over_union(session):
+    # INTERSECT binds tighter: 1 UNION ALL (2 INTERSECT 2)
+    got = rows(session, "SELECT 1 AS x UNION ALL "
+                        "(SELECT 2 INTERSECT SELECT 2) ORDER BY 1")
+    assert got == [(1,), (2,)]
+
+
+def test_values_table(session):
+    got = rows(session,
+               "SELECT y, x FROM (VALUES (1, 'a'), (2, 'b')) AS t(x, y) "
+               "ORDER BY x")
+    assert got == [("a", 1), ("b", 2)]
+
+
+def test_bare_values_statement(session):
+    assert rows(session, "VALUES 1, 2, 3") == [(1,), (2,), (3,)]
+
+
+def test_values_row_nulls(session):
+    got = rows(session,
+               "SELECT * FROM (VALUES (1, NULL), (NULL, 'x')) AS t(a, b)")
+    assert got == [(1, None), (None, "x")]
+
+
+def test_values_aggregate(session):
+    got = rows(session,
+               "SELECT sum(x), count(*) FROM (VALUES 1, 2, 3, NULL) t(x)")
+    assert got == [(6, 4)]
+
+
+def test_select_without_from(session):
+    assert rows(session, "SELECT 1 + 2") == [(3,)]
+    assert rows(session, "SELECT 'hello' AS g, 42 AS n") == [("hello", 42)]
+
+
+def test_cte_from_less(session):
+    got = rows(session, "WITH t AS (SELECT 1 AS x) SELECT x + 1 FROM t")
+    assert got == [(2,)]
+
+
+def test_union_in_subquery(session):
+    got = rows(session,
+               "SELECT count(*) FROM (SELECT 1 AS x UNION ALL SELECT 2 "
+               "UNION ALL SELECT 1) t")
+    assert got == [(3,)]
+
+
+def test_values_join_table(session):
+    got = rows(session,
+               "SELECT count(*) FROM lineitem, (VALUES 'A') t(f) "
+               "WHERE l_returnflag = f")
+    base = rows(session,
+                "SELECT count(*) FROM lineitem WHERE l_returnflag = 'A'")
+    assert got == base

@@ -90,7 +90,95 @@ class Executor:
                                jnp.asarray(node.count, dtype=jnp.int64))
         if isinstance(node, L.OutputNode):
             return self.run(node.child)
+        if isinstance(node, L.ValuesNode):
+            return self.run_values(node)
+        if isinstance(node, L.SetOpNode):
+            return self.run_setop(node)
         raise NotImplementedError(type(node).__name__)
+
+    def run_values(self, node: L.ValuesNode) -> Batch:
+        if node.arrays:
+            return batch_from_numpy(list(node.arrays),
+                                    valids=list(node.valids))
+        # zero-column values (SELECT without FROM): live mask only
+        cap = pad_capacity(node.num_rows)
+        live = np.zeros(cap, dtype=np.bool_)
+        live[:node.num_rows] = True
+        return Batch(columns=(), live=jnp.asarray(live))
+
+    def run_setop(self, node: L.SetOpNode) -> Batch:
+        left = remap_codes(self.run(node.left), node.left_remaps)
+        right = remap_codes(self.run(node.right), node.right_remaps)
+        if node.op == "union_all":
+            return concat_batches(left, right)
+        return self.run_setop_host(node.op, left, right)
+
+    def run_setop_host(self, op: str, left: Batch, right: Batch) -> Batch:
+        """DISTINCT/INTERSECT/EXCEPT variants, host-side. NULLs compare
+        equal (set ops use IS NOT DISTINCT semantics, like GROUP BY)."""
+        from collections import Counter
+        la, lv = batch_to_numpy(left)
+        ra, rv = batch_to_numpy(right)
+
+        def rows_of(arrays, valids):
+            n = len(arrays[0]) if arrays else 0
+            return [tuple(arrays[j][i].item() if valids[j][i] else None
+                          for j in range(len(arrays)))
+                    for i in range(n)]
+
+        lrows, rrows = rows_of(la, lv), rows_of(ra, rv)
+
+        def dedup(rows):
+            seen, out = set(), []
+            for r in rows:
+                if r not in seen:
+                    seen.add(r)
+                    out.append(r)
+            return out
+
+        if op == "union":
+            out = dedup(lrows + rrows)
+        elif op == "intersect":
+            rset = set(rrows)
+            out = [r for r in dedup(lrows) if r in rset]
+        elif op == "intersect_all":
+            rcount = Counter(rrows)
+            used: Counter = Counter()
+            out = []
+            for r in lrows:
+                if used[r] < rcount.get(r, 0):
+                    used[r] += 1
+                    out.append(r)
+        elif op == "except":
+            rset = set(rrows)
+            out = [r for r in dedup(lrows) if r not in rset]
+        elif op == "except_all":
+            rcount = Counter(rrows)
+            used = Counter()
+            out = []
+            for r in lrows:
+                if used[r] < rcount.get(r, 0):
+                    used[r] += 1
+                else:
+                    out.append(r)
+        else:
+            raise NotImplementedError(op)
+
+        ncols = len(la)
+        arrays = []
+        valids = []
+        for j in range(ncols):
+            vals = [r[j] for r in out]
+            valid = np.array([v is not None for v in vals], dtype=np.bool_)
+            data = np.array([v if v is not None else 0 for v in vals],
+                            dtype=la[j].dtype)
+            arrays.append(data)
+            valids.append(valid)
+        if not arrays:
+            live = np.zeros(pad_capacity(len(out)), dtype=np.bool_)
+            live[:len(out)] = True
+            return Batch(columns=(), live=jnp.asarray(live))
+        return batch_from_numpy(arrays, valids=valids)
 
     # ------------------------------------------------------------------
 
@@ -246,3 +334,30 @@ def filter_project_fused(batch: Batch, exprs, predicate) -> Batch:
     """Project-then-filter in one jit (Filter over Project)."""
     projected = project(batch, exprs)
     return apply_filter(projected, predicate)
+
+
+def remap_codes(batch: Batch, remaps) -> Batch:
+    """Translate dictionary codes through per-column LUTs (merged set-op
+    pools). One device gather per remapped column."""
+    if all(r is None for r in remaps):
+        return batch
+    cols = []
+    for col, rm in zip(batch.columns, remaps):
+        if rm is None:
+            cols.append(col)
+        else:
+            lut = jnp.asarray(np.asarray(rm, dtype=np.int32))
+            cols.append(Column(jnp.take(lut, col.data, axis=0), col.valid))
+    return Batch(tuple(cols), batch.live)
+
+
+@jax.jit
+def concat_batches(a: Batch, b: Batch) -> Batch:
+    """UNION ALL: columnwise concatenation on device (UnionNode lowering —
+    Trino's union is a pass-through exchange, ours is one concat per
+    column; capacity is the sum so no rows can drop)."""
+    cols = tuple(
+        Column(jnp.concatenate([ca.data, cb.data]),
+               jnp.concatenate([ca.valid, cb.valid]))
+        for ca, cb in zip(a.columns, b.columns))
+    return Batch(cols, jnp.concatenate([a.live, b.live]))

@@ -55,7 +55,7 @@ class Session:
         stmt = parse(sql)
         if isinstance(stmt, A.Explain):
             return stmt, None
-        assert isinstance(stmt, (A.Query, A.ShowTables))
+        assert isinstance(stmt, (A.Query, A.SetOp, A.Values, A.ShowTables))
         if isinstance(stmt, A.ShowTables):
             return stmt, None
         rel = self.planner().plan_query(stmt)

@@ -95,6 +95,11 @@ def eval_expr(expr: ir.Expr, batch: Batch):
         if expr.value is None:
             z = jnp.zeros(n, dtype=expr.dtype.np_dtype)
             return z, jnp.zeros(n, dtype=jnp.bool_)
+        if expr.dtype.kind is TypeKind.VARCHAR:
+            # string literal: code 0 into its single-entry pool (the
+            # planner attaches the dictionary via field_for)
+            return (jnp.zeros(n, dtype=jnp.int32),
+                    jnp.ones(n, dtype=jnp.bool_))
         v = jnp.full(n, expr.value, dtype=expr.dtype.np_dtype)
         return v, jnp.ones(n, dtype=jnp.bool_)
 

@@ -457,6 +457,16 @@ class _StringConst(ir.Expr):
             f"comparison context")
 
 
+def materialize_string(e: ir.Expr) -> ir.Expr:
+    """A string literal escaping to a value context (SELECT 'a') becomes a
+    VARCHAR Literal with a single-entry pool (code 0); field_for attaches
+    the dictionary."""
+    if isinstance(e, _StringConst):
+        from ..types import VARCHAR
+        return ir.Literal(e.value, VARCHAR)
+    return e
+
+
 def flip(op: str) -> str:
     return {"=": "=", "<>": "<>", "<": ">", "<=": ">=",
             ">": "<", ">=": "<="}[op]

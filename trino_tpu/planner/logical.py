@@ -109,6 +109,37 @@ class LimitNode(PlanNode):
     output: Tuple
 
 
+@dataclass(frozen=True, eq=False)
+class ValuesNode(PlanNode):
+    """Inline table of constants (sql/planner/plan/ValuesNode.java).
+    Cell values are evaluated at plan time; arrays are host numpy columns
+    (VARCHAR already dictionary-encoded, dictionaries in `fields`)."""
+    arrays: Tuple                     # tuple[np.ndarray, ...]
+    valids: Tuple                     # tuple[np.ndarray, ...]
+    num_rows: int
+    fields: Tuple                     # tuple[batch.Field, ...]
+    output: Tuple
+
+
+@dataclass(frozen=True)
+class SetOpNode(PlanNode):
+    """UNION/INTERSECT/EXCEPT (plan/UnionNode.java, IntersectNode.java,
+    ExceptNode.java). Children are type-aligned by the planner; VARCHAR
+    columns share a merged dictionary, with `right_remaps` holding the
+    old-code -> merged-code LUT per column (None = identity).
+
+    'union_all' concatenates on device; the DISTINCT/INTERSECT/EXCEPT
+    variants run host-side (Trino lowers them to aggregation + join —
+    these are cold paths by row volume)."""
+    op: str                           # union|union_all|intersect|
+                                      # intersect_all|except|except_all
+    left: PlanNode
+    right: PlanNode
+    left_remaps: Tuple                # tuple[Optional[tuple[int,...]], ...]
+    right_remaps: Tuple               # tuple[Optional[tuple[int,...]], ...]
+    output: Tuple
+
+
 @dataclass(frozen=True)
 class OutputNode(PlanNode):
     """Root: names the result columns (sql/planner/plan/OutputNode.java)."""
@@ -121,7 +152,7 @@ def children(node: PlanNode):
     if isinstance(node, (FilterNode, ProjectNode, AggregateNode, SortNode,
                          LimitNode, OutputNode)):
         return (node.child,)
-    if isinstance(node, JoinNode):
+    if isinstance(node, (JoinNode, SetOpNode)):
         return (node.left, node.right)
     return ()
 
@@ -148,6 +179,10 @@ def explain_text(node: PlanNode, indent: int = 0) -> str:
         line = f"{pad}{'TopN' if node.limit else 'Sort'}[{len(node.keys)} keys]"
     elif isinstance(node, LimitNode):
         line = f"{pad}Limit[{node.count}]"
+    elif isinstance(node, ValuesNode):
+        line = f"{pad}Values[{node.num_rows} rows]"
+    elif isinstance(node, SetOpNode):
+        line = f"{pad}SetOp[{node.op}]"
     elif isinstance(node, OutputNode):
         line = f"{pad}Output[{', '.join(node.names)}]"
     else:

@@ -133,4 +133,39 @@ def _prune(node: L.PlanNode, needed: frozenset):
         child, m = _prune(node.child, needed)
         return L.LimitNode(child, node.count, child.output), m
 
+    if isinstance(node, L.ValuesNode):
+        keep = sorted(needed)
+        mapping = {old: new for new, old in enumerate(keep)}
+        return L.ValuesNode(
+            tuple(node.arrays[i] for i in keep),
+            tuple(node.valids[i] for i in keep),
+            node.num_rows,
+            tuple(node.fields[i] for i in keep),
+            tuple(node.output[i] for i in keep)), mapping
+
+    if isinstance(node, L.SetOpNode):
+        # distinct/intersect/except semantics are over the whole row:
+        # children must keep every column, in order
+        nall = frozenset(range(len(node.output)))
+        left = _prune_exact(node.left, nall)
+        right = _prune_exact(node.right, nall)
+        return L.SetOpNode(node.op, left, right, node.left_remaps,
+                           node.right_remaps,
+                           node.output), _identity(len(node.output))
+
     raise NotImplementedError(type(node).__name__)
+
+
+def _prune_exact(node: L.PlanNode, needed: frozenset) -> L.PlanNode:
+    """Prune a subtree but guarantee the original column order/layout
+    (re-projecting if the child renumbered anything)."""
+    n = len(node.output)
+    child, mapping = _prune(node, needed)
+    if len(child.output) == n and all(mapping.get(i) == i
+                                      for i in range(n)):
+        return child
+    return L.ProjectNode(
+        child,
+        tuple(ir.ColumnRef(mapping[i], node.output[i][1])
+              for i in range(n)),
+        tuple(node.output))

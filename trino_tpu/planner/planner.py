@@ -25,15 +25,19 @@ import math
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from .. import ir
-from ..batch import Schema
+from ..batch import Field, Schema
 from ..catalog import Catalog
 from ..sql import ast_nodes as A
-from ..types import BIGINT, DOUBLE, DataType, TypeKind
+from ..types import (BIGINT, BOOLEAN, DOUBLE, VARCHAR, DataType, TypeKind,
+                     common_super_type)
 from . import logical as L
 from .analyzer import (AGG_NAMES, AnalysisError, ExpressionLowerer, Scope,
                        ScopeColumn, ast_children, contains_aggregate,
-                       flip, parse_type)
+                       date_literal, flip, materialize_string,
+                       number_literal, parse_type)
 
 from ..ops.aggregate import MAX_DIRECT_GROUPS  # dense-domain cutoff (64)
 
@@ -97,6 +101,207 @@ class Planner:
                     zip(node.output, sub_fields(sub)))]
         return PlannedRelation(node, Scope(cols))
 
+    # ------------------------------------------------------------------
+    # VALUES and set operations
+    # ------------------------------------------------------------------
+
+    def eval_const_ast(self, node: A.Node) -> ir.Literal:
+        """Evaluate a constant VALUES cell at plan time (tree/Values.java
+        rows are bound during analysis in the reference too)."""
+        if isinstance(node, A.NumberLit):
+            return number_literal(node.text)
+        if isinstance(node, A.StringLit):
+            return ir.Literal(node.value, VARCHAR)
+        if isinstance(node, A.BoolLit):
+            return ir.Literal(node.value, BOOLEAN)
+        if isinstance(node, A.NullLit):
+            return ir.Literal(None, None)
+        if isinstance(node, A.DateLit):
+            return date_literal(node.value)
+        if isinstance(node, A.UnaryOp) and node.op == "-":
+            lit = self.eval_const_ast(node.arg)
+            if lit.value is None:
+                return lit
+            return ir.Literal(-lit.value, lit.dtype)
+        if isinstance(node, A.BinaryOp) and node.op in "+-*":
+            l = self.eval_const_ast(node.left)
+            r = self.eval_const_ast(node.right)
+            if l.dtype is not None and r.dtype is not None and \
+                    l.dtype.kind is TypeKind.BIGINT and \
+                    r.dtype.kind is TypeKind.BIGINT:
+                v = {"+": lambda a, b: a + b, "-": lambda a, b: a - b,
+                     "*": lambda a, b: a * b}[node.op](l.value, r.value)
+                return ir.Literal(v, BIGINT)
+        if isinstance(node, A.CastExpr):
+            lit = self.eval_const_ast(node.arg)
+            dst = parse_type(node.type_name)
+            return ir.Literal(_convert_const(lit.value, lit.dtype, dst), dst)
+        raise AnalysisError(
+            f"unsupported constant expression in VALUES: "
+            f"{type(node).__name__}")
+
+    def plan_values_ref(self, ref: A.ValuesRef) -> PlannedRelation:
+        rows = ref.values.rows
+        arity = len(rows[0])
+        for r in rows:
+            if len(r) != arity:
+                raise AnalysisError("VALUES rows have mixed column counts")
+        cells = [[self.eval_const_ast(c) for c in r] for r in rows]
+        names = [n.lower() for n in ref.column_names] \
+            if ref.column_names else [f"_col{j}" for j in range(arity)]
+        if ref.column_names and len(ref.column_names) != arity:
+            raise AnalysisError("VALUES column alias count mismatch")
+
+        arrays, valids, fields, output, cols = [], [], [], [], []
+        alias = ref.alias.lower()
+        for j in range(arity):
+            col_lits = [row[j] for row in cells]
+            dtype = None
+            for lit in col_lits:
+                if lit.dtype is None:
+                    continue
+                dtype = lit.dtype if dtype is None else \
+                    common_super_type(dtype, lit.dtype)
+            if dtype is None:
+                dtype = BIGINT      # all-NULL column
+            valid = np.array([lit.dtype is not None and lit.value is not None
+                              for lit in col_lits], dtype=np.bool_)
+            dictionary = None
+            if dtype.kind is TypeKind.VARCHAR:
+                # pool must be SORTED (code order == string order is the
+                # engine-wide invariant sorts and min/max rely on)
+                strings = [lit.value if lit.value is not None else ""
+                           for lit in col_lits]
+                pool = sorted(set(strings))
+                index = {s: k for k, s in enumerate(pool)}
+                data = np.asarray([index[s] for s in strings],
+                                  dtype=dtype.np_dtype)
+                dictionary = tuple(pool)
+            else:
+                data = np.asarray(
+                    [_convert_const(lit.value, lit.dtype, dtype) or 0
+                     for lit in col_lits], dtype=dtype.np_dtype)
+            fld = Field(names[j], dtype, dictionary)
+            arrays.append(data)
+            valids.append(valid)
+            fields.append(fld)
+            output.append((names[j], dtype))
+            cols.append(ScopeColumn(alias, names[j], dtype, j, fld))
+        node = L.ValuesNode(tuple(arrays), tuple(valids), len(rows),
+                            tuple(fields), tuple(output))
+        return PlannedRelation(node, Scope(cols))
+
+    def plan_values_statement(self, v: A.Values) -> PlannedRelation:
+        rel = self.plan_values_ref(A.ValuesRef(v, "values"))
+        names = tuple(n for n, _ in rel.node.output)
+        out = L.OutputNode(rel.node, names, rel.node.output)
+        return PlannedRelation(out, rel.scope)
+
+    def plan_body(self, node: A.Node) -> PlannedRelation:
+        """Plan a set-op operand to a relation (no OutputNode root)."""
+        if isinstance(node, A.Values):
+            return self.plan_values_ref(A.ValuesRef(node, "values"))
+        sub = self.plan_query(node)
+        return self.wrap_subplan(sub, "$setop")
+
+    def plan_setop(self, q: A.SetOp) -> PlannedRelation:
+        left = self.plan_body(q.left)
+        right = self.plan_body(q.right)
+        if len(left.node.output) != len(right.node.output):
+            raise AnalysisError(
+                f"set operation column count mismatch: "
+                f"{len(left.node.output)} vs {len(right.node.output)}")
+        left, right, out_fields, lremaps, rremaps = \
+            self.align_setop(left, right)
+        names = [c.name for c in left.scope.columns]
+        output = tuple((nm, f.dtype) for nm, f in zip(names, out_fields))
+        op = q.op + ("_all" if q.all_rows else "")
+        node = L.SetOpNode(op, left.node, right.node, tuple(lremaps),
+                           tuple(rremaps), output)
+        cols = [ScopeColumn(None, nm, f.dtype, i, f)
+                for i, (nm, f) in enumerate(zip(names, out_fields))]
+        rel = PlannedRelation(node, Scope(cols))
+
+        if q.order_by:
+            keys = []
+            for item in q.order_by:
+                idx = self.resolve_setop_order(item.expr, names)
+                nulls_first = item.nulls_first
+                if nulls_first is None:
+                    nulls_first = not item.ascending
+                keys.append(L.SortKey(idx, item.ascending, nulls_first))
+            rel = PlannedRelation(
+                L.SortNode(rel.node, tuple(keys), q.limit, rel.node.output),
+                rel.scope)
+        elif q.limit is not None:
+            rel = PlannedRelation(
+                L.LimitNode(rel.node, q.limit, rel.node.output), rel.scope)
+        out = L.OutputNode(rel.node, tuple(names), rel.node.output)
+        return PlannedRelation(out, rel.scope)
+
+    def resolve_setop_order(self, ast: A.Node, names: List[str]) -> int:
+        if isinstance(ast, A.NumberLit) and "." not in ast.text:
+            k = int(ast.text)
+            if not (1 <= k <= len(names)):
+                raise AnalysisError(f"ORDER BY ordinal {k} out of range")
+            return k - 1
+        if isinstance(ast, A.Identifier) and len(ast.parts) == 1:
+            nm = ast.parts[0].lower()
+            if nm in names:
+                return names.index(nm)
+        raise AnalysisError(
+            "ORDER BY over a set operation must reference an output "
+            "column name or ordinal")
+
+    def align_setop(self, left: PlannedRelation, right: PlannedRelation):
+        """Coerce both sides to common column types; merge VARCHAR
+        dictionaries (right codes remap through the merged pool)."""
+        lcols, rcols = left.scope.columns, right.scope.columns
+        lcasts, rcasts, out_fields, lremaps, rremaps = [], [], [], [], []
+        for i, (lc, rc) in enumerate(zip(lcols, rcols)):
+            lt, rt = lc.dtype, rc.dtype
+            if lt.kind is TypeKind.VARCHAR or rt.kind is TypeKind.VARCHAR:
+                if lt.kind is not rt.kind:
+                    raise AnalysisError(
+                        "set operation mixes VARCHAR and non-VARCHAR")
+                ld = lc.field.dictionary if lc.field else ()
+                rd = rc.field.dictionary if rc.field else ()
+                if ld == rd:
+                    lremaps.append(None)
+                    rremaps.append(None)
+                    out_fields.append(Field(lc.name, lt, ld))
+                else:
+                    # merged pool is SORTED: the engine-wide invariant that
+                    # dictionary code order == string order (ORDER BY and
+                    # min/max on varchar sort codes directly) must survive
+                    # the merge, so both sides get a remap LUT
+                    merged = sorted(set(ld) | set(rd))
+                    index = {s: k for k, s in enumerate(merged)}
+                    lr = tuple(index[s] for s in ld)
+                    rr = tuple(index[s] for s in rd)
+                    lremaps.append(
+                        None if lr == tuple(range(len(ld))) else lr)
+                    rremaps.append(
+                        None if rr == tuple(range(len(rd))) else rr)
+                    out_fields.append(Field(lc.name, lt, tuple(merged)))
+                lcasts.append(None)
+                rcasts.append(None)
+                continue
+            try:
+                target = common_super_type(lt, rt)
+            except Exception:
+                raise AnalysisError(
+                    f"set operation type mismatch on column {i}: "
+                    f"{lt} vs {rt}")
+            lcasts.append(None if lt == target else target)
+            rcasts.append(None if rt == target else target)
+            out_fields.append(Field(lc.name, target, None))
+            lremaps.append(None)
+            rremaps.append(None)
+        left = _cast_relation(left, lcasts)
+        right = _cast_relation(right, rcasts)
+        return left, right, out_fields, lremaps, rremaps
+
     def plan_relation_tree(self, rel: A.Node) -> Tuple[List[PlannedRelation],
                                                        List[A.Node]]:
         """Flatten the FROM tree into base relations + ON conjuncts."""
@@ -106,6 +311,8 @@ class Planner:
         def walk(node: A.Node):
             if isinstance(node, A.TableRef):
                 relations.append(self.plan_table(node))
+            elif isinstance(node, A.ValuesRef):
+                relations.append(self.plan_values_ref(node))
             elif isinstance(node, A.SubqueryRef):
                 sub = self.plan_query(node.query)
                 relations.append(self.wrap_subplan(sub, node.alias.lower()))
@@ -315,21 +522,29 @@ class Planner:
     # query planning
     # ------------------------------------------------------------------
 
-    def plan_query(self, q: A.Query) -> PlannedRelation:
-        if q.relation is None:
-            raise AnalysisError("SELECT without FROM not yet supported")
+    def plan_query(self, q) -> PlannedRelation:
+        if isinstance(q, A.Values):
+            return self.plan_values_statement(q)
         saved_ctes = self.ctes
         if q.ctes:
             self.ctes = dict(self.ctes)
             for name, cq in q.ctes:
                 self.ctes[name.lower()] = cq
         try:
+            if isinstance(q, A.SetOp):
+                return self.plan_setop(q)
             return self.plan_query_body(q)
         finally:
             self.ctes = saved_ctes
 
     def plan_query_body(self, q: A.Query) -> PlannedRelation:
-        relations, on_conjuncts = self.plan_relation_tree(q.relation)
+        if q.relation is None:
+            # SELECT without FROM: single-row zero-column input relation
+            # (Trino: Query with an implicit single-row ValuesNode)
+            relations, on_conjuncts = [PlannedRelation(
+                L.ValuesNode((), (), 1, (), ()), Scope([]))], []
+        else:
+            relations, on_conjuncts = self.plan_relation_tree(q.relation)
 
         conjuncts: List[A.Node] = list(on_conjuncts)
         if q.where is not None:
@@ -423,7 +638,7 @@ class Planner:
         out_cols = []
         new_scope = []
         for i, (ast, name) in enumerate(items):
-            e = lowerer.lower(ast)
+            e = materialize_string(lowerer.lower(ast))
             exprs.append(e)
             names.append(name)
             out_cols.append((name, e.dtype))
@@ -435,8 +650,10 @@ class Planner:
     def field_for(self, e: ir.Expr, scope: Scope):
         """Propagate dictionary fields through bare column projections."""
         if isinstance(e, ir.DerivedDict):
-            from ..batch import Field
             return Field("$derived", e.dtype, dictionary=e.pool)
+        if isinstance(e, ir.Literal) and e.dtype is not None and \
+                e.dtype.kind is TypeKind.VARCHAR:
+            return Field("$literal", e.dtype, dictionary=(e.value,))
         if isinstance(e, ir.ColumnRef) and \
                 e.dtype.kind is TypeKind.VARCHAR:
             for c in scope.columns:
@@ -965,3 +1182,72 @@ def sum_type(t: DataType) -> DataType:
 def sub_fields(sub: "PlannedRelation"):
     """Fields (with dictionaries) for a subquery's output columns."""
     return [c.field for c in sub.scope.columns]
+
+
+def _div_half_up(v: int, div: int) -> int:
+    """Integer divide rounding HALF_UP away from zero — identical to the
+    runtime ir.Cast rescale so plan-time folding can't diverge."""
+    q, r = divmod(abs(v), div)
+    if 2 * r >= div:
+        q += 1
+    return q if v >= 0 else -q
+
+
+def _convert_const(value, src: Optional[DataType], dst: DataType):
+    """Convert a plan-time constant between logical types (VALUES cell
+    coercion; Trino's TypeCoercion applied to bound constants). Rounding
+    is HALF_UP away from zero, matching the runtime Cast kernels."""
+    import math
+    if value is None or src is None:
+        return None
+    if src == dst:
+        return value
+    sk, dk = src.kind, dst.kind
+    if dk is TypeKind.DECIMAL:
+        if sk is TypeKind.DECIMAL:
+            diff = dst.scale - src.scale
+            return value * 10 ** diff if diff >= 0 \
+                else _div_half_up(value, 10 ** -diff)
+        if sk in (TypeKind.BIGINT, TypeKind.INTEGER):
+            return value * 10 ** dst.scale
+        if sk is TypeKind.DOUBLE:
+            scaled = abs(value) * 10 ** dst.scale
+            return int(math.floor(scaled + 0.5)) * (1 if value >= 0 else -1)
+    if dk is TypeKind.DOUBLE:
+        if sk is TypeKind.DECIMAL:
+            return value / 10 ** src.scale
+        return float(value)
+    if dk in (TypeKind.BIGINT, TypeKind.INTEGER):
+        if sk is TypeKind.DECIMAL:
+            return _div_half_up(value, 10 ** src.scale)
+        if sk is TypeKind.DOUBLE:
+            return int(math.floor(abs(value) + 0.5)) * \
+                (1 if value >= 0 else -1)
+        return int(value)
+    if dk is TypeKind.VARCHAR and sk is TypeKind.VARCHAR:
+        return value
+    if dk is TypeKind.DATE and sk is TypeKind.DATE:
+        return value
+    raise AnalysisError(f"cannot cast constant from {src} to {dst}")
+
+
+def _cast_relation(rel: PlannedRelation, casts) -> PlannedRelation:
+    """Wrap a set-op side in a cast projection where column types differ
+    from the unified output type (AddExchanges inserts the same coercion
+    projections under UnionNode in the reference)."""
+    if all(c is None for c in casts):
+        return rel
+    exprs, output, cols = [], [], []
+    for i, (c, sc) in enumerate(zip(casts, rel.scope.columns)):
+        ref = ir.ColumnRef(i, sc.dtype, sc.name)
+        if c is None:
+            exprs.append(ref)
+            output.append((sc.name, sc.dtype))
+            cols.append(ScopeColumn(sc.qualifier, sc.name, sc.dtype, i,
+                                    sc.field))
+        else:
+            exprs.append(ir.Cast(ref, c))
+            output.append((sc.name, c))
+            cols.append(ScopeColumn(sc.qualifier, sc.name, c, i, None))
+    node = L.ProjectNode(rel.node, tuple(exprs), tuple(output))
+    return PlannedRelation(node, Scope(cols))

@@ -193,8 +193,36 @@ class Query(Node):
 
 
 @dataclass(frozen=True)
+class SetOp(Node):
+    """UNION / INTERSECT / EXCEPT over two query bodies. ORDER BY / LIMIT
+    attached here bind to the combined result (SqlBase.g4 queryNoWith:
+    queryTerm (ORDER BY ...)? (LIMIT ...)?)."""
+    op: str                         # 'union' | 'intersect' | 'except'
+    all_rows: bool                  # ALL vs DISTINCT
+    left: Node                      # Query | SetOp | Values
+    right: Node
+    order_by: Tuple[OrderItem, ...] = ()
+    limit: Optional[int] = None
+    ctes: Tuple = ()
+
+
+@dataclass(frozen=True)
+class Values(Node):
+    """VALUES (row), (row), ... — an inline table (tree/Values.java)."""
+    rows: Tuple[Tuple[Node, ...], ...]
+
+
+@dataclass(frozen=True)
+class ValuesRef(Node):
+    """(VALUES ...) AS alias (col, ...) in a FROM clause."""
+    values: Values
+    alias: str
+    column_names: Optional[Tuple[str, ...]] = None
+
+
+@dataclass(frozen=True)
 class Explain(Node):
-    query: Query
+    query: Node
     analyze: bool = False
 
 

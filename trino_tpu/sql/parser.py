@@ -99,7 +99,9 @@ class Parser:
             return A.ShowTables(catalog, schema)
         self.fail("unsupported SHOW statement")
 
-    def parse_query(self) -> A.Query:
+    def parse_query(self) -> A.Node:
+        """queryNoWith: WITH? set-op chain (ORDER BY)? (LIMIT)?
+        (SqlBase.g4 query/queryNoWith/queryTerm structure)."""
         ctes = []
         if self.accept_kw("WITH"):
             while True:
@@ -113,6 +115,94 @@ class Parser:
                 ctes.append((t.raw, cq))
                 if not self.accept_op(","):
                     break
+
+        body = self.parse_set_body()
+
+        order_by: Tuple[A.OrderItem, ...] = ()
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            items_o = [self.order_item()]
+            while self.accept_op(","):
+                items_o.append(self.order_item())
+            order_by = tuple(items_o)
+
+        limit = None
+        if self.accept_kw("LIMIT"):
+            t = self.advance()
+            if t.kind != "number":
+                self.fail("LIMIT expects a number")
+            limit = int(t.text)
+
+        import dataclasses
+        if isinstance(body, A.Values):
+            # bare VALUES statement: wrap as SELECT * FROM (VALUES ...)
+            body = A.Query((A.SelectItem(expr=None),), False,
+                           A.ValuesRef(body, "values"), None, (), None,
+                           (), None)
+        if isinstance(body, (A.Query, A.SetOp)):
+            inner_has = body.order_by or body.limit is not None or body.ctes
+            outer_has = order_by or limit is not None or ctes
+            if not outer_has:
+                return body       # parenthesized query keeps its clauses
+            if inner_has:
+                # both levels have clauses: outer wraps the parenthesized
+                # body as a derived table so neither is lost
+                body = A.Query((A.SelectItem(expr=None),), False,
+                               A.SubqueryRef(body, "$sub"), None, (), None,
+                               (), None)
+            return dataclasses.replace(body, order_by=order_by, limit=limit,
+                                       ctes=tuple(ctes))
+        self.fail("malformed query body")
+
+    def parse_set_body(self) -> A.Node:
+        left = self.parse_set_term()
+        while self.at_kw("UNION", "EXCEPT"):
+            op = self.advance().text.lower()
+            all_rows = self.accept_kw("ALL")
+            if not all_rows:
+                self.accept_kw("DISTINCT")
+            left = A.SetOp(op, all_rows, left, self.parse_set_term())
+        return left
+
+    def parse_set_term(self) -> A.Node:
+        left = self.parse_set_primary()
+        while self.at_kw("INTERSECT"):
+            self.advance()
+            all_rows = self.accept_kw("ALL")
+            if not all_rows:
+                self.accept_kw("DISTINCT")
+            left = A.SetOp("intersect", all_rows, left,
+                           self.parse_set_primary())
+        return left
+
+    def parse_set_primary(self) -> A.Node:
+        if self.accept_op("("):
+            q = self.parse_query()
+            self.expect_op(")")
+            return q
+        if self.at_kw("VALUES"):
+            return self.parse_values()
+        return self.parse_select_core()
+
+    def parse_values(self) -> A.Values:
+        self.expect_kw("VALUES")
+        rows = []
+        while True:
+            if self.accept_op("("):
+                row = [self.parse_expr()]
+                while self.accept_op(","):
+                    row.append(self.parse_expr())
+                self.expect_op(")")
+            else:
+                row = [self.parse_expr()]
+            rows.append(tuple(row))
+            if not self.accept_op(","):
+                break
+        return A.Values(tuple(rows))
+
+    def parse_select_core(self) -> A.Query:
+        """One SELECT..HAVING block (querySpecification in SqlBase.g4);
+        ORDER BY / LIMIT / WITH belong to the enclosing query."""
         self.expect_kw("SELECT")
         distinct = self.accept_kw("DISTINCT")
         self.accept_kw("ALL")
@@ -136,23 +226,8 @@ class Parser:
 
         having = self.parse_expr() if self.accept_kw("HAVING") else None
 
-        order_by: Tuple[A.OrderItem, ...] = ()
-        if self.accept_kw("ORDER"):
-            self.expect_kw("BY")
-            items_o = [self.order_item()]
-            while self.accept_op(","):
-                items_o.append(self.order_item())
-            order_by = tuple(items_o)
-
-        limit = None
-        if self.accept_kw("LIMIT"):
-            t = self.advance()
-            if t.kind != "number":
-                self.fail("LIMIT expects a number")
-            limit = int(t.text)
-
         return A.Query(tuple(select), distinct, relation, where, group_by,
-                       having, order_by, limit, tuple(ctes))
+                       having, (), None, ())
 
     # ---- select items / order items --------------------------------------
 
@@ -264,7 +339,12 @@ class Parser:
 
     def table_primary(self) -> A.Node:
         if self.accept_op("("):
-            if self.at_kw("SELECT"):
+            if self.at_kw("VALUES"):
+                v = self.parse_values()
+                self.expect_op(")")
+                alias, colnames = self.table_alias_with_columns()
+                return A.ValuesRef(v, alias or "values", colnames)
+            if self.at_kw("SELECT", "WITH"):
                 q = self.parse_query()
                 self.expect_op(")")
                 self.accept_kw("AS")
@@ -279,6 +359,23 @@ class Parser:
         parts = self.qualified_name()
         alias = self.maybe_alias()
         return A.TableRef(tuple(parts), alias)
+
+    def table_alias_with_columns(self):
+        """[AS] alias [(col, col, ...)] after a derived table."""
+        alias = self.maybe_alias()
+        colnames = None
+        if alias is not None and self.accept_op("("):
+            names = []
+            while True:
+                t = self.advance()
+                if t.kind not in ("name", "qident"):
+                    self.fail("expected column name in table alias")
+                names.append(t.raw if t.kind == "name" else t.text)
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            colnames = tuple(names)
+        return alias, colnames
 
     def qualified_name(self) -> List[str]:
         t = self.advance()
