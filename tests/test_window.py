@@ -1,0 +1,171 @@
+"""Window function tests against the sqlite oracle.
+
+Reference pattern: Trino's window operator tests (AbstractTestWindowQueries,
+operator/window/ unit tests) — here every query also runs on sqlite (3.25+
+implements the same SQL window semantics) over identical TPC-H tiny data.
+"""
+
+import pytest
+
+from oracle import assert_rows_match, load_oracle, oracle_query
+from trino_tpu.exec.session import Session
+
+TPCH_TABLES = ["region", "nation", "supplier", "customer", "part",
+               "partsupp", "orders", "lineitem"]
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session(default_schema="tiny")
+
+
+@pytest.fixture(scope="module")
+def oracle(session):
+    conn = session.catalog.connector("tpch")
+    return load_oracle([conn.get_table("tiny", t) for t in TPCH_TABLES])
+
+
+def check(session, oracle, sql, ordered=True, abs_tol=0.01):
+    got = session.execute(sql).rows
+    want = oracle_query(oracle, sql)
+    assert_rows_match(got, want, rel_tol=1e-9, abs_tol=abs_tol,
+                      ordered=ordered)
+    return got
+
+
+def test_row_number(session, oracle):
+    check(session, oracle, """
+        SELECT n_name, n_regionkey,
+               row_number() OVER (PARTITION BY n_regionkey
+                                  ORDER BY n_name) AS rn
+        FROM nation ORDER BY n_regionkey, rn""")
+
+
+def test_rank_dense_rank(session, oracle):
+    check(session, oracle, """
+        SELECT o_custkey, o_orderpriority,
+               rank() OVER (PARTITION BY o_orderpriority
+                            ORDER BY o_custkey) AS r,
+               dense_rank() OVER (PARTITION BY o_orderpriority
+                                  ORDER BY o_custkey) AS dr
+        FROM orders
+        ORDER BY o_orderpriority, o_custkey, r""")
+
+
+def test_running_sum_default_frame(session, oracle):
+    # default frame = RANGE UNBOUNDED PRECEDING..CURRENT ROW (peers included)
+    check(session, oracle, """
+        SELECT o_orderkey, o_custkey,
+               sum(o_totalprice) OVER (PARTITION BY o_custkey
+                                       ORDER BY o_orderkey) AS rt
+        FROM orders ORDER BY o_custkey, o_orderkey""")
+
+
+def test_partition_total_no_order(session, oracle):
+    check(session, oracle, """
+        SELECT l_orderkey, l_linenumber,
+               sum(l_quantity) OVER (PARTITION BY l_orderkey) AS part_total,
+               count(*) OVER (PARTITION BY l_orderkey) AS part_count
+        FROM lineitem ORDER BY l_orderkey, l_linenumber""")
+
+
+def test_rows_frame(session, oracle):
+    check(session, oracle, """
+        SELECT o_orderkey,
+               sum(o_totalprice) OVER (ORDER BY o_orderkey
+                   ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS rt,
+               min(o_totalprice) OVER (ORDER BY o_orderkey
+                   ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS mn,
+               max(o_totalprice) OVER (ORDER BY o_orderkey
+                   ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS mx
+        FROM orders ORDER BY o_orderkey""")
+
+
+def test_unbounded_following_frame(session, oracle):
+    check(session, oracle, """
+        SELECT n_nationkey,
+               sum(n_regionkey) OVER (ORDER BY n_nationkey
+                   RANGE BETWEEN UNBOUNDED PRECEDING
+                   AND UNBOUNDED FOLLOWING) AS total
+        FROM nation ORDER BY n_nationkey""")
+
+
+def test_lead_lag(session, oracle):
+    check(session, oracle, """
+        SELECT o_orderkey, o_custkey,
+               lag(o_orderkey) OVER (PARTITION BY o_custkey
+                                     ORDER BY o_orderkey) AS prev_key,
+               lead(o_orderkey, 1, -1) OVER (PARTITION BY o_custkey
+                                             ORDER BY o_orderkey) AS next_key
+        FROM orders ORDER BY o_custkey, o_orderkey""")
+
+
+def test_first_last_value(session, oracle):
+    check(session, oracle, """
+        SELECT l_orderkey, l_linenumber,
+               first_value(l_quantity) OVER (PARTITION BY l_orderkey
+                                             ORDER BY l_linenumber) AS fv,
+               last_value(l_quantity) OVER (PARTITION BY l_orderkey
+                   ORDER BY l_linenumber
+                   ROWS BETWEEN UNBOUNDED PRECEDING
+                   AND UNBOUNDED FOLLOWING) AS lv
+        FROM lineitem ORDER BY l_orderkey, l_linenumber""")
+
+
+def test_ntile(session, oracle):
+    check(session, oracle, """
+        SELECT o_orderkey,
+               ntile(4) OVER (ORDER BY o_orderkey) AS quartile
+        FROM orders ORDER BY o_orderkey""")
+
+
+def test_window_avg(session, oracle):
+    check(session, oracle, """
+        SELECT o_orderkey, o_custkey,
+               avg(o_totalprice) OVER (PARTITION BY o_custkey) AS cavg
+        FROM orders ORDER BY o_orderkey""", abs_tol=0.02)
+
+
+def test_window_over_aggregation(session, oracle):
+    # windows over aggregated output: sum(sum(x)) OVER (...)
+    check(session, oracle, """
+        SELECT o_custkey, sum(o_totalprice) AS t,
+               rank() OVER (ORDER BY sum(o_totalprice) DESC) AS r
+        FROM orders GROUP BY o_custkey
+        ORDER BY r, o_custkey""")
+
+
+def test_window_varchar_passthrough(session, oracle):
+    check(session, oracle, """
+        SELECT n_nationkey,
+               first_value(n_name) OVER (PARTITION BY n_regionkey
+                                         ORDER BY n_nationkey) AS first_name
+        FROM nation ORDER BY n_nationkey""")
+
+
+def test_window_in_expression(session, oracle):
+    check(session, oracle, """
+        SELECT o_orderkey,
+               o_totalprice - avg(o_totalprice) OVER () AS delta
+        FROM orders ORDER BY o_orderkey""", abs_tol=0.02)
+
+
+def test_multiple_window_specs(session, oracle):
+    # two different (partition, order) groups -> chained WindowNodes
+    check(session, oracle, """
+        SELECT o_orderkey,
+               row_number() OVER (ORDER BY o_totalprice DESC,
+                                  o_orderkey) AS by_price,
+               row_number() OVER (PARTITION BY o_orderpriority
+                                  ORDER BY o_orderkey) AS by_prio
+        FROM orders ORDER BY o_orderkey""")
+
+
+def test_window_with_nulls(session, oracle):
+    # lag at partition start is NULL; sum over empty frame is NULL
+    got = session.execute("""
+        SELECT o_custkey, o_orderkey,
+               lag(o_orderkey) OVER (PARTITION BY o_custkey
+                                     ORDER BY o_orderkey) AS prev
+        FROM orders ORDER BY o_custkey, o_orderkey LIMIT 5""").rows
+    assert got[0][2] is None

@@ -81,6 +81,8 @@ class Executor:
             return self.run_aggregate(node)
         if isinstance(node, L.JoinNode):
             return self.run_join(node)
+        if isinstance(node, L.WindowNode):
+            return self.run_window(node)
         if isinstance(node, L.SortNode):
             keys = tuple((k.index, k.ascending, k.nulls_first)
                          for k in node.keys)
@@ -196,6 +198,15 @@ class Executor:
             self.stats.scans += 1
             self.stats.rows_scanned += data.num_rows
         return self._scan_cache[key]
+
+    def run_window(self, node: L.WindowNode) -> Batch:
+        from ..ops.window import WinSpec, window_compute
+        child = self.run(node.child)
+        keys = tuple((k.index, k.ascending, k.nulls_first)
+                     for k in node.order_by)
+        specs = tuple(WinSpec(s.func, s.arg, s.frame, s.offset, s.default)
+                      for s in node.specs)
+        return window_compute(child, node.partition_by, keys, specs)
 
     def run_aggregate(self, node: L.AggregateNode) -> Batch:
         child = self.run(node.child)

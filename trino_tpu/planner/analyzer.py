@@ -66,7 +66,17 @@ class Scope:
 AGG_NAMES = {"sum", "avg", "count", "min", "max"}
 
 
+def contains_window(node: A.Node) -> bool:
+    if isinstance(node, A.WindowFunc):
+        return True
+    return any(contains_window(c) for c in ast_children(node))
+
+
 def contains_aggregate(node: A.Node) -> bool:
+    if isinstance(node, A.WindowFunc):
+        # window-function args may contain aggregates (sum(sum(x)) over ..),
+        # but the window call itself is not an aggregation
+        return any(contains_aggregate(c) for c in node.args)
     if isinstance(node, A.FunctionCall) and node.name in AGG_NAMES:
         return True
     for child in ast_children(node):
@@ -90,6 +100,9 @@ def ast_children(node: A.Node):
         return (node.arg, node.pattern)
     if isinstance(node, A.FunctionCall):
         return node.args
+    if isinstance(node, A.WindowFunc):
+        return node.args + node.partition_by + \
+            tuple(o.expr for o in node.order_by)
     if isinstance(node, A.CastExpr):
         return (node.arg,)
     if isinstance(node, A.ExtractExpr):
@@ -176,11 +189,18 @@ class ExpressionLowerer:
     subqueries fail to plan here and are handled by the planner's
     subquery-predicate pass (decorrelation to joins)."""
 
-    def __init__(self, scope: Scope, planner=None):
+    def __init__(self, scope: Scope, planner=None, window_slots=None):
         self.scope = scope
         self.planner = planner
+        self.window_slots = window_slots or {}
 
     def lower(self, node: A.Node) -> ir.Expr:
+        if isinstance(node, A.WindowFunc):
+            slot = self.window_slots.get(node)
+            if slot is None:
+                raise AnalysisError(
+                    f"window function {node.name}() not allowed here")
+            return slot
         if isinstance(node, A.Identifier):
             col = self.scope.resolve(node.parts)
             return ir.ColumnRef(col.index, col.dtype, col.name)

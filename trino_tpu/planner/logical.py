@@ -88,6 +88,31 @@ class JoinNode(PlanNode):
 
 
 @dataclass(frozen=True)
+class WinSpecNode:
+    """One window function (plan-level mirror of ops.window.WinSpec)."""
+    func: str                         # row_number|rank|dense_rank|ntile|
+                                      # lead|lag|first_value|last_value|
+                                      # sum|count|count_star|min|max
+    arg: Optional[int]                # child output column index
+    frame: str                        # partition|range_running|rows_running
+    offset: int                       # lead/lag offset, ntile buckets
+    default: Optional[object]         # lead/lag default literal
+    out_name: str
+    out_dtype: DataType
+
+
+@dataclass(frozen=True)
+class WindowNode(PlanNode):
+    """WindowNode (sql/planner/plan/WindowNode.java): appends one column
+    per function; all functions share (partition_by, order_by)."""
+    child: PlanNode
+    partition_by: Tuple[int, ...]     # child output column indices
+    order_by: Tuple                   # tuple[SortKey, ...]
+    specs: Tuple                      # tuple[WinSpecNode, ...]
+    output: Tuple
+
+
+@dataclass(frozen=True)
 class SortKey:
     index: int
     ascending: bool
@@ -150,7 +175,7 @@ class OutputNode(PlanNode):
 
 def children(node: PlanNode):
     if isinstance(node, (FilterNode, ProjectNode, AggregateNode, SortNode,
-                         LimitNode, OutputNode)):
+                         LimitNode, OutputNode, WindowNode)):
         return (node.child,)
     if isinstance(node, (JoinNode, SetOpNode)):
         return (node.left, node.right)
@@ -175,6 +200,10 @@ def explain_text(node: PlanNode, indent: int = 0) -> str:
     elif isinstance(node, JoinNode):
         line = (f"{pad}Join[{node.kind}, probe={list(node.left_keys)}, "
                 f"build={list(node.right_keys)}]")
+    elif isinstance(node, WindowNode):
+        fns = ", ".join(s.func for s in node.specs)
+        line = (f"{pad}Window[partition={list(node.partition_by)}, "
+                f"order={len(node.order_by)} keys, {fns}]")
     elif isinstance(node, SortNode):
         line = f"{pad}{'TopN' if node.limit else 'Sort'}[{len(node.keys)} keys]"
     elif isinstance(node, LimitNode):

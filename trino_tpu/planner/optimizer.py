@@ -122,6 +122,28 @@ def _prune(node: L.PlanNode, needed: frozenset):
                                   else ()),
             null_aware=node.null_aware), mapping
 
+    if isinstance(node, L.WindowNode):
+        c = len(node.child.output)
+        child_needed = {i for i in needed if i < c} | \
+            set(node.partition_by) | {k.index for k in node.order_by} | \
+            {s.arg for s in node.specs if s.arg is not None}
+        child, m = _prune(node.child, frozenset(child_needed))
+        nc = len(child.output)
+        specs = tuple(
+            L.WinSpecNode(s.func, None if s.arg is None else m[s.arg],
+                          s.frame, s.offset, s.default, s.out_name,
+                          s.out_dtype)
+            for s in node.specs)
+        mapping = dict(m)
+        for j in range(len(node.specs)):
+            mapping[c + j] = nc + j
+        return L.WindowNode(
+            child, tuple(m[i] for i in node.partition_by),
+            tuple(L.SortKey(m[k.index], k.ascending, k.nulls_first)
+                  for k in node.order_by),
+            specs,
+            tuple(child.output) + tuple(node.output[c:])), mapping
+
     if isinstance(node, L.SortNode):
         child_needed = needed | {k.index for k in node.keys}
         child, m = _prune(node.child, frozenset(child_needed))

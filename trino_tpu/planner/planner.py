@@ -36,8 +36,8 @@ from ..types import (BIGINT, BOOLEAN, DOUBLE, VARCHAR, DataType, TypeKind,
 from . import logical as L
 from .analyzer import (AGG_NAMES, AnalysisError, ExpressionLowerer, Scope,
                        ScopeColumn, ast_children, contains_aggregate,
-                       date_literal, flip, materialize_string,
-                       number_literal, parse_type)
+                       contains_window, date_literal, flip,
+                       materialize_string, number_literal, parse_type)
 
 from ..ops.aggregate import MAX_DIRECT_GROUPS  # dense-domain cutoff (64)
 
@@ -632,7 +632,24 @@ class Planner:
 
     def plan_plain_select(self, q: A.Query, rel: PlannedRelation):
         items = self.expand_star(q, rel.scope)
-        lowerer = ExpressionLowerer(rel.scope)
+
+        # window functions: plan WindowNode(s) below the final projection
+        wcalls: List[A.WindowFunc] = []
+        for ast, _ in items:
+            self.collect_windows(ast, wcalls)
+        for o in q.order_by:
+            self.collect_windows(o.expr, wcalls)
+        window_slots: Dict[A.WindowFunc, ir.Expr] = {}
+        wfields: Dict[A.WindowFunc, Optional[Field]] = {}
+        scope = rel.scope
+        if wcalls:
+            wl = ExpressionLowerer(scope, planner=self)
+            node, window_slots, wfields = self.plan_windows(
+                rel.node, wcalls, wl.lower, scope)
+            rel = PlannedRelation(node, scope)
+
+        lowerer = ExpressionLowerer(scope, planner=self,
+                                    window_slots=window_slots)
         exprs = []
         names = []
         out_cols = []
@@ -642,10 +659,187 @@ class Planner:
             exprs.append(e)
             names.append(name)
             out_cols.append((name, e.dtype))
-            fld = self.field_for(e, rel.scope)
+            fld = self.field_for(e, scope)
+            if fld is None and isinstance(ast, A.WindowFunc):
+                fld = wfields.get(ast)
             new_scope.append(ScopeColumn(None, name, e.dtype, i, fld))
         node = L.ProjectNode(rel.node, tuple(exprs), tuple(out_cols))
         return PlannedRelation(node, Scope(new_scope)), exprs, names
+
+    # ---- window functions -------------------------------------------------
+
+    WINDOW_NAMES = {"row_number", "rank", "dense_rank", "ntile", "lead",
+                    "lag", "first_value", "last_value"} | AGG_NAMES
+
+    def collect_windows(self, node: A.Node, out: List[A.WindowFunc]) -> None:
+        if isinstance(node, A.WindowFunc):
+            if node.name not in self.WINDOW_NAMES:
+                raise AnalysisError(
+                    f"unsupported window function {node.name}()")
+            if node not in out:
+                out.append(node)
+            return                    # args of a window call have no windows
+        for ch in ast_children(node):
+            self.collect_windows(ch, out)
+
+    @staticmethod
+    def frame_mode(call: A.WindowFunc) -> str:
+        """SQL frame -> kernel frame (ops/window.py FRAMES)."""
+        if not call.order_by:
+            return "partition"
+        f = call.frame
+        if f is None:
+            return "range_running"    # SQL default frame
+        if f.start != "unbounded_preceding":
+            raise AnalysisError(
+                "only UNBOUNDED PRECEDING frame starts are supported")
+        if f.end == "current_row":
+            return "rows_running" if f.unit == "rows" else "range_running"
+        return "partition"            # UNBOUNDED FOLLOWING
+
+    def plan_windows(self, node: L.PlanNode, calls: List[A.WindowFunc],
+                     lower, scope: Scope):
+        """Plan window calls over `node`: a pass-through pre-projection
+        adding window inputs, then one WindowNode per distinct
+        (PARTITION BY, ORDER BY) group (Trino merges compatible
+        specifications into shared WindowNodes the same way —
+        MergeAdjacentWindows / PushdownWindow rules).
+
+        Returns (new_node, slots {call -> ir.Expr over new output},
+        fields {call -> Field or None}).
+        """
+        base_n = len(node.output)
+        pre_exprs = [ir.ColumnRef(i, dt, nm)
+                     for i, (nm, dt) in enumerate(node.output)]
+        pre_cols = list(node.output)
+
+        def add_input(e: ir.Expr) -> int:
+            if isinstance(e, ir.ColumnRef) and e.index < base_n:
+                return e.index        # bare column: pass-through slot
+            pre_exprs.append(e)
+            pre_cols.append((f"$win{len(pre_cols)}", e.dtype))
+            return len(pre_cols) - 1
+
+        def const_int(ast: A.Node, what: str) -> int:
+            e = lower(ast)
+            if not isinstance(e, ir.Literal) or not isinstance(
+                    e.value, (int, np.integer)):
+                raise AnalysisError(f"{what} must be an integer literal")
+            return int(e.value)
+
+        groups: Dict[tuple, list] = {}
+        records: Dict[A.WindowFunc, dict] = {}
+        fields: Dict[A.WindowFunc, Optional[Field]] = {}
+        for call in calls:
+            part = tuple(add_input(lower(p)) for p in call.partition_by)
+            okeys = []
+            for o in call.order_by:
+                idx = add_input(lower(o.expr))
+                nf = o.nulls_first if o.nulls_first is not None \
+                    else not o.ascending
+                okeys.append(L.SortKey(idx, o.ascending, nf))
+            rec = {"part": part, "order": tuple(okeys)}
+            name, frame = call.name, self.frame_mode(call)
+            fields[call] = None
+            if name in ("row_number", "rank", "dense_rank"):
+                rec["specs"] = [L.WinSpecNode(name, None, frame, 1, None,
+                                              name, BIGINT)]
+            elif name == "ntile":
+                if len(call.args) != 1:
+                    raise AnalysisError("ntile takes one argument")
+                k = const_int(call.args[0], "ntile bucket count")
+                if k <= 0:
+                    raise AnalysisError("ntile buckets must be positive")
+                rec["specs"] = [L.WinSpecNode(name, None, frame, k, None,
+                                              name, BIGINT)]
+            elif name in ("lead", "lag"):
+                if not 1 <= len(call.args) <= 3:
+                    raise AnalysisError(f"{name} takes 1-3 arguments")
+                arg = lower(call.args[0])
+                off = const_int(call.args[1], f"{name} offset") \
+                    if len(call.args) > 1 else 1
+                default = None
+                if len(call.args) > 2:
+                    d = lower(call.args[2])
+                    if not isinstance(d, ir.Literal):
+                        raise AnalysisError(
+                            f"{name} default must be a literal")
+                    default = d.value
+                slot = add_input(arg)
+                fields[call] = self.field_for(arg, scope)
+                if arg.dtype.kind is TypeKind.VARCHAR and \
+                        default is not None:
+                    raise AnalysisError(
+                        f"{name} varchar default unsupported")
+                rec["specs"] = [L.WinSpecNode(name, slot, frame, off,
+                                              default, name, arg.dtype)]
+            elif name in ("first_value", "last_value"):
+                if len(call.args) != 1:
+                    raise AnalysisError(f"{name} takes one argument")
+                arg = lower(call.args[0])
+                slot = add_input(arg)
+                fields[call] = self.field_for(arg, scope)
+                rec["specs"] = [L.WinSpecNode(name, slot, frame, 1, None,
+                                              name, arg.dtype)]
+            elif name == "count" and (call.is_star or not call.args):
+                rec["specs"] = [L.WinSpecNode("count_star", None, frame, 1,
+                                              None, "count", BIGINT)]
+            else:                     # sum/count/min/max/avg aggregates
+                if len(call.args) != 1:
+                    raise AnalysisError(f"{name} takes one argument")
+                arg = lower(call.args[0])
+                t = arg.dtype
+                if t.kind is TypeKind.VARCHAR and name in ("min", "max"):
+                    raise AnalysisError(
+                        f"window {name}() over varchar unsupported")
+                slot = add_input(arg)
+                if name == "avg":
+                    rec["specs"] = [
+                        L.WinSpecNode("sum", slot, frame, 1, None,
+                                      "avg_sum", sum_type(t)),
+                        L.WinSpecNode("count", slot, frame, 1, None,
+                                      "avg_cnt", BIGINT)]
+                    rec["avg_t"] = t
+                elif name == "sum":
+                    rec["specs"] = [L.WinSpecNode("sum", slot, frame, 1,
+                                                  None, "sum", sum_type(t))]
+                elif name == "count":
+                    rec["specs"] = [L.WinSpecNode("count", slot, frame, 1,
+                                                  None, "count", BIGINT)]
+                else:
+                    rec["specs"] = [L.WinSpecNode(name, slot, frame, 1,
+                                                  None, name, t)]
+            records[call] = rec
+            groups.setdefault((part, rec["order"]), []).append(call)
+
+        current: L.PlanNode = L.ProjectNode(node, tuple(pre_exprs),
+                                            tuple(pre_cols))
+        slots: Dict[A.WindowFunc, ir.Expr] = {}
+        for (part, okeys), group_calls in groups.items():
+            specs = []
+            first_out = len(current.output)
+            for call in group_calls:
+                rec = records[call]
+                out0 = first_out + len(specs)
+                specs.extend(rec["specs"])
+                if "avg_t" in rec:
+                    t = rec["avg_t"]
+                    sum_ref = ir.ColumnRef(out0, sum_type(t))
+                    cnt_ref = ir.ColumnRef(out0 + 1, BIGINT)
+                    if t.kind is TypeKind.DECIMAL:
+                        slots[call] = ir.DecimalAvg(sum_ref, cnt_ref, t)
+                    else:
+                        slots[call] = ir.arith(
+                            "/", ir.Cast(sum_ref, DOUBLE),
+                            ir.Cast(cnt_ref, DOUBLE))
+                else:
+                    slots[call] = ir.ColumnRef(out0,
+                                               rec["specs"][0].out_dtype)
+            output = tuple(current.output) + tuple(
+                (s.out_name, s.out_dtype) for s in specs)
+            current = L.WindowNode(current, part, okeys, tuple(specs),
+                                   output)
+        return current, slots, fields
 
     def field_for(self, e: ir.Expr, scope: Scope):
         """Propagate dictionary fields through bare column projections."""
@@ -774,8 +968,16 @@ class Planner:
                                                i, fld))
         post_scope = Scope(post_scope_cols)
 
+        window_slots: Dict[A.WindowFunc, ir.Expr] = {}
+
         def rewrite(node: A.Node) -> ir.Expr:
             """Lower a select/having/order expression over the agg output."""
+            if isinstance(node, A.WindowFunc):
+                slot = window_slots.get(node)
+                if slot is None:
+                    raise AnalysisError(
+                        f"window function {node.name}() not allowed here")
+                return slot
             # group-by expression match (syntactic, like Trino)
             for i, g_ast in enumerate(group_asts):
                 if ast_equal(node, g_ast, q):
@@ -829,6 +1031,23 @@ class Planner:
             name = (item.alias or default_name(item.expr)).lower()
             items.append((item.expr, name))
 
+        current: L.PlanNode = agg_node
+        if q.having is not None:
+            pred = rewrite(q.having)
+            current = L.FilterNode(current, pred, current.output)
+
+        # windows over the aggregated output (sum(sum(x)) OVER (...) etc.)
+        wcalls: List[A.WindowFunc] = []
+        for ast, _ in items:
+            self.collect_windows(ast, wcalls)
+        for o in q.order_by:
+            self.collect_windows(o.expr, wcalls)
+        wfields: Dict[A.WindowFunc, Optional[Field]] = {}
+        if wcalls:
+            current, slots, wfields = self.plan_windows(
+                current, wcalls, rewrite, post_scope)
+            window_slots.update(slots)
+
         post_exprs = []
         names = []
         out_cols = []
@@ -841,12 +1060,10 @@ class Planner:
             fld = None
             if isinstance(e, ir.ColumnRef) and e.index < n_keys:
                 fld = post_scope.columns[e.index].field
+            if fld is None and isinstance(ast, A.WindowFunc):
+                fld = wfields.get(ast)
             final_scope.append(ScopeColumn(None, name, e.dtype, i, fld))
 
-        current: L.PlanNode = agg_node
-        if q.having is not None:
-            pred = rewrite(q.having)
-            current = L.FilterNode(current, pred, current.output)
         post_node = L.ProjectNode(current, tuple(post_exprs),
                                   tuple(out_cols))
         return (PlannedRelation(post_node, Scope(final_scope)),

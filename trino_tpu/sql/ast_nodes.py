@@ -123,6 +123,27 @@ class FunctionCall(Node):
 
 
 @dataclass(frozen=True)
+class WindowFrame(Node):
+    """ROWS/RANGE BETWEEN <start> AND <end>. Bounds are one of
+    'unbounded_preceding' | 'current_row' | 'unbounded_following'."""
+    unit: str                       # 'rows' | 'range'
+    start: str
+    end: str
+
+
+@dataclass(frozen=True)
+class WindowFunc(Node):
+    """fn(args) OVER (PARTITION BY ... ORDER BY ... [frame])
+    (tree/WindowOperation + WindowSpecification in the reference parser)."""
+    name: str                       # lower-case
+    args: Tuple[Node, ...]
+    is_star: bool                   # count(*) OVER ...
+    partition_by: Tuple[Node, ...]
+    order_by: Tuple["OrderItem", ...]
+    frame: Optional[WindowFrame]
+
+
+@dataclass(frozen=True)
 class CastExpr(Node):
     arg: Node
     type_name: str                  # e.g. 'bigint', 'decimal(12,2)', 'date'

@@ -572,6 +572,8 @@ class Parser:
             self.expect_op("(")
             if self.accept_op("*"):
                 self.expect_op(")")
+                if self.at_kw("OVER"):
+                    return self.parse_over(name, (), is_star=True)
                 return A.FunctionCall(name, (), is_star=True)
             distinct = self.accept_kw("DISTINCT")
             args: Tuple[A.Node, ...] = ()
@@ -581,12 +583,60 @@ class Parser:
                     lst.append(self.parse_expr())
                 args = tuple(lst)
             self.expect_op(")")
+            if self.at_kw("OVER"):
+                if distinct:
+                    self.fail("DISTINCT window aggregates unsupported")
+                return self.parse_over(name, args, is_star=False)
             return A.FunctionCall(name, args, distinct=distinct)
 
         if t.kind == "name" and t.text in RESERVED_STOPPERS:
             self.fail(f"unexpected keyword {t.raw!r}")
         parts = self.qualified_name()
         return A.Identifier(tuple(parts))
+
+    def parse_over(self, name: str, args, is_star: bool) -> A.Node:
+        """OVER (PARTITION BY ... ORDER BY ... [ROWS|RANGE frame])
+        (SqlBase.g4 windowSpecification)."""
+        self.expect_kw("OVER")
+        self.expect_op("(")
+        partition: Tuple[A.Node, ...] = ()
+        order: Tuple[A.OrderItem, ...] = ()
+        frame = None
+        if self.accept_kw("PARTITION"):
+            self.expect_kw("BY")
+            lst = [self.parse_expr()]
+            while self.accept_op(","):
+                lst.append(self.parse_expr())
+            partition = tuple(lst)
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            items = [self.order_item()]
+            while self.accept_op(","):
+                items.append(self.order_item())
+            order = tuple(items)
+        if self.at_kw("ROWS") or self.at_kw("RANGE"):
+            unit = self.advance().text.lower()
+
+            def bound() -> str:
+                if self.accept_kw("UNBOUNDED"):
+                    if self.accept_kw("PRECEDING"):
+                        return "unbounded_preceding"
+                    self.expect_kw("FOLLOWING")
+                    return "unbounded_following"
+                self.expect_kw("CURRENT")
+                self.expect_kw("ROW")
+                return "current_row"
+
+            if self.accept_kw("BETWEEN"):
+                start = bound()
+                self.expect_kw("AND")
+                end = bound()
+            else:
+                start = bound()
+                end = "current_row"
+            frame = A.WindowFrame(unit, start, end)
+        self.expect_op(")")
+        return A.WindowFunc(name, args, is_star, partition, order, frame)
 
     def parse_case(self) -> A.Node:
         operand = None
