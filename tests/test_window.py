@@ -161,6 +161,23 @@ def test_multiple_window_specs(session, oracle):
         FROM orders ORDER BY o_orderkey""")
 
 
+def test_lead_decimal_default_rescales(session, oracle):
+    # the default literal (1.5 at scale 1) must rescale to the column's
+    # decimal(12,2) representation
+    check(session, oracle, """
+        SELECT o_orderkey,
+               lead(o_totalprice, 1, 1.5) OVER (ORDER BY o_orderkey) AS nx
+        FROM orders ORDER BY o_orderkey""")
+
+
+def test_agg_inside_over_clause(session, oracle):
+    check(session, oracle, """
+        SELECT o_custkey,
+               rank() OVER (ORDER BY sum(o_totalprice) DESC,
+                            o_custkey) AS r
+        FROM orders GROUP BY o_custkey ORDER BY r""")
+
+
 def test_window_with_nulls(session, oracle):
     # lag at partition start is NULL; sum over empty frame is NULL
     got = session.execute("""

@@ -1,0 +1,106 @@
+"""Interactive SQL CLI.
+
+Reference: client/trino-cli (Console.java:87) — a line-oriented REPL that
+submits statements and renders aligned result tables. `python -m
+trino_tpu.client.cli [--server URI]`; with no --server it boots an
+in-process engine (the StandaloneQueryRunner pattern) so the CLI works
+without a running cluster.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def render_table(columns, rows, out=None) -> None:
+    """Aligned ASCII table (the CLI's ALIGNED output format)."""
+    out = out if out is not None else sys.stdout
+    cells = [[("NULL" if v is None else str(v)) for v in r] for r in rows]
+    widths = [len(c) for c in columns]
+    for r in cells:
+        for i, v in enumerate(r):
+            widths[i] = max(widths[i], len(v))
+    sep = "-+-".join("-" * w for w in widths)
+    out.write(" | ".join(c.ljust(w) for c, w in zip(columns, widths))
+              + "\n")
+    out.write(sep + "\n")
+    for r in cells:
+        out.write(" | ".join(v.ljust(w) for v, w in zip(r, widths)) + "\n")
+    out.write(f"({len(rows)} row{'s' if len(rows) != 1 else ''})\n")
+
+
+class LocalBackend:
+    """In-process engine (no server)."""
+
+    def __init__(self, schema: str = "tiny"):
+        from ..exec.session import Session
+        self.session = Session(default_schema=schema)
+
+    def execute(self, sql: str):
+        r = self.session.execute(sql)
+        return r.column_names, r.rows
+
+
+class RemoteBackend:
+    def __init__(self, uri: str, user: str):
+        from .client import Client
+        self.client = Client(uri, user=user)
+
+    def execute(self, sql: str):
+        r = self.client.execute(sql)
+        return r.columns, r.rows
+
+
+def repl(backend, inp=sys.stdin, out=sys.stdout) -> None:
+    buf = []
+    prompt = "trino-tpu> "
+    cont = "        -> "
+    while True:
+        out.write(prompt if not buf else cont)
+        out.flush()
+        line = inp.readline()
+        if not line:
+            break
+        line = line.rstrip("\n")
+        if not buf and line.strip().lower() in ("quit", "exit", "quit;",
+                                                "exit;"):
+            break
+        if not line.strip():
+            continue
+        buf.append(line)
+        if not line.rstrip().endswith(";"):
+            continue
+        sql = "\n".join(buf).rstrip().rstrip(";")
+        buf = []
+        t0 = time.monotonic()
+        try:
+            columns, rows = backend.execute(sql)
+        except Exception as e:           # noqa: BLE001 — REPL boundary
+            out.write(f"Query failed: {e}\n")
+            continue
+        render_table(columns, rows, out)
+        out.write(f"Elapsed: {time.monotonic() - t0:.2f}s\n\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="trino-tpu-cli")
+    ap.add_argument("--server", help="coordinator URI (default: in-process)")
+    ap.add_argument("--user", default="cli")
+    ap.add_argument("--schema", default="tiny",
+                    help="tpch schema for in-process mode")
+    ap.add_argument("--execute", "-e", help="run one statement and exit")
+    args = ap.parse_args(argv)
+    backend = RemoteBackend(args.server, args.user) if args.server \
+        else LocalBackend(args.schema)
+    if args.execute:
+        columns, rows = backend.execute(args.execute.rstrip(";"))
+        render_table(columns, rows)
+        return 0
+    repl(backend)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

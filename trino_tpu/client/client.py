@@ -1,0 +1,90 @@
+"""Python client for the statement protocol.
+
+Reference: client/trino-client's StatementClientV1
+(StatementClientV1.java:76) — POST /v1/statement, then follow `nextUri`
+(advance:391) accumulating data pages until no nextUri remains; DELETE the
+current uri to cancel.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+from urllib.request import Request, urlopen
+
+
+class QueryError(Exception):
+    def __init__(self, message: str, error_name: str = ""):
+        super().__init__(message)
+        self.error_name = error_name
+
+
+@dataclass
+class ClientResult:
+    query_id: str
+    columns: List[str]
+    rows: List[list]
+    state: str
+    elapsed_ms: int = 0
+
+
+class Client:
+    def __init__(self, uri: str, user: str = "anonymous",
+                 poll_interval_s: float = 0.05, timeout_s: float = 300.0):
+        self.uri = uri.rstrip("/")
+        self.user = user
+        self.poll_interval_s = poll_interval_s
+        self.timeout_s = timeout_s
+
+    def _request(self, method: str, url: str,
+                 body: Optional[bytes] = None) -> dict:
+        req = Request(url, data=body, method=method,
+                      headers={"X-Trino-User": self.user,
+                               "Content-Type": "text/plain"})
+        with urlopen(req, timeout=30) as resp:
+            payload = resp.read()
+        return json.loads(payload) if payload else {}
+
+    def execute(self, sql: str) -> ClientResult:
+        """Submit and drain the nextUri chain to completion."""
+        doc = self._request("POST", f"{self.uri}/v1/statement",
+                            sql.encode())
+        columns: List[str] = []
+        rows: List[list] = []
+        deadline = time.time() + self.timeout_s
+        while True:
+            if "error" in doc:
+                err = doc["error"]
+                raise QueryError(err.get("message", "query failed"),
+                                 err.get("errorName", ""))
+            if "columns" in doc and not columns:
+                columns = [c["name"] for c in doc["columns"]]
+            if "data" in doc:
+                rows.extend(doc["data"])
+            next_uri = doc.get("nextUri")
+            if next_uri is None:
+                return ClientResult(
+                    doc.get("id", ""), columns, rows,
+                    doc.get("stats", {}).get("state", "FINISHED"),
+                    doc.get("stats", {}).get("elapsedTimeMillis", 0))
+            if time.time() > deadline:
+                self._request("DELETE", next_uri)
+                raise QueryError("client timeout", "CLIENT_TIMEOUT")
+            state = doc.get("stats", {}).get("state", "")
+            if state in ("QUEUED", "PLANNING", "RUNNING", "STARTING"):
+                time.sleep(self.poll_interval_s)
+            doc = self._request("GET", next_uri)
+
+    def query_info(self, query_id: str) -> dict:
+        return self._request("GET", f"{self.uri}/v1/query/{query_id}")
+
+    def list_queries(self) -> list:
+        return self._request("GET", f"{self.uri}/v1/query")
+
+    def nodes(self) -> list:
+        return self._request("GET", f"{self.uri}/v1/node")
+
+    def server_info(self) -> dict:
+        return self._request("GET", f"{self.uri}/v1/info")

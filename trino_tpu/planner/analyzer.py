@@ -66,17 +66,12 @@ class Scope:
 AGG_NAMES = {"sum", "avg", "count", "min", "max"}
 
 
-def contains_window(node: A.Node) -> bool:
-    if isinstance(node, A.WindowFunc):
-        return True
-    return any(contains_window(c) for c in ast_children(node))
-
-
 def contains_aggregate(node: A.Node) -> bool:
     if isinstance(node, A.WindowFunc):
-        # window-function args may contain aggregates (sum(sum(x)) over ..),
-        # but the window call itself is not an aggregation
-        return any(contains_aggregate(c) for c in node.args)
+        # the window call itself is not an aggregation, but aggregates may
+        # appear in its args (sum(sum(x)) OVER ..) or its OVER clause
+        # (rank() OVER (ORDER BY sum(x)))
+        return any(contains_aggregate(c) for c in ast_children(node))
     if isinstance(node, A.FunctionCall) and node.name in AGG_NAMES:
         return True
     for child in ast_children(node):

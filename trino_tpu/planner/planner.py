@@ -36,8 +36,8 @@ from ..types import (BIGINT, BOOLEAN, DOUBLE, VARCHAR, DataType, TypeKind,
 from . import logical as L
 from .analyzer import (AGG_NAMES, AnalysisError, ExpressionLowerer, Scope,
                        ScopeColumn, ast_children, contains_aggregate,
-                       contains_window, date_literal, flip,
-                       materialize_string, number_literal, parse_type)
+                       date_literal, flip, materialize_string,
+                       number_literal, parse_type)
 
 from ..ops.aggregate import MAX_DIRECT_GROUPS  # dense-domain cutoff (64)
 
@@ -57,6 +57,9 @@ class Planner:
         self.default_catalog = default_catalog
         self.default_schema = default_schema
         self.ctes: Dict[str, A.Query] = {}   # WITH-bound names, lexically scoped
+        # (from_node, from_scope, window_slots) of the latest plain select —
+        # lets ORDER BY lower hidden sort expressions over the FROM scope
+        self._plain_from: Optional[tuple] = None
 
     # ------------------------------------------------------------------
     # relations
@@ -593,18 +596,57 @@ class Planner:
                                 DEFAULT_SORT_GROUPS, node.output),
                 rel.scope)
 
-        # ORDER BY over the select output scope (+ alias resolution)
+        # ORDER BY over the select output scope (+ alias resolution);
+        # expressions not in the select list become hidden sort columns
+        # appended to the projection and dropped after the sort (Trino's
+        # PruneOrderByInAggregation / hidden-symbol ordering scheme)
         if q.order_by:
-            keys = []
+            plain_from = self._plain_from
+            proj = rel.node
+            can_hide = (not has_agg and not q.distinct and
+                        isinstance(proj, L.ProjectNode) and
+                        plain_from is not None and
+                        plain_from[0] is proj.child)
+            idxs = []
             for item in q.order_by:
-                idx = self.resolve_order_expr(item.expr, q, rel, names)
+                try:
+                    idx = self.resolve_order_expr(item.expr, q, rel, names)
+                except AnalysisError:
+                    if not can_hide:
+                        raise
+                    idx = None
+                idxs.append(idx)
+            if any(i is None for i in idxs):
+                _, from_scope, wslots = plain_from
+                lowerer = ExpressionLowerer(from_scope, planner=self,
+                                            window_slots=wslots)
+                exprs = list(proj.exprs)
+                out_cols = list(proj.output)
+                for k, item in enumerate(q.order_by):
+                    if idxs[k] is None:
+                        e = materialize_string(lowerer.lower(item.expr))
+                        exprs.append(e)
+                        out_cols.append((f"$sort{len(out_cols)}", e.dtype))
+                        idxs[k] = len(out_cols) - 1
+                base: L.PlanNode = L.ProjectNode(proj.child, tuple(exprs),
+                                                 tuple(out_cols))
+            else:
+                base = rel.node
+            keys = []
+            for idx, item in zip(idxs, q.order_by):
                 nulls_first = item.nulls_first
                 if nulls_first is None:
                     nulls_first = not item.ascending   # Trino default
                 keys.append(L.SortKey(idx, item.ascending, nulls_first))
-            rel = PlannedRelation(
-                L.SortNode(rel.node, tuple(keys), q.limit, rel.node.output),
-                rel.scope)
+            sorted_node: L.PlanNode = L.SortNode(base, tuple(keys), q.limit,
+                                                 base.output)
+            if base is not rel.node:      # drop hidden sort columns
+                sorted_node = L.ProjectNode(
+                    sorted_node,
+                    tuple(ir.ColumnRef(i, dt)
+                          for i, (_, dt) in enumerate(proj.output)),
+                    proj.output)
+            rel = PlannedRelation(sorted_node, rel.scope)
         elif q.limit is not None:
             rel = PlannedRelation(
                 L.LimitNode(rel.node, q.limit, rel.node.output), rel.scope)
@@ -664,6 +706,7 @@ class Planner:
                 fld = wfields.get(ast)
             new_scope.append(ScopeColumn(None, name, e.dtype, i, fld))
         node = L.ProjectNode(rel.node, tuple(exprs), tuple(out_cols))
+        self._plain_from = (rel.node, scope, window_slots)
         return PlannedRelation(node, Scope(new_scope)), exprs, names
 
     # ---- window functions -------------------------------------------------
@@ -716,6 +759,9 @@ class Planner:
         def add_input(e: ir.Expr) -> int:
             if isinstance(e, ir.ColumnRef) and e.index < base_n:
                 return e.index        # bare column: pass-through slot
+            for i, prev in enumerate(pre_exprs[base_n:]):
+                if prev == e:         # structural dedup merges window groups
+                    return base_n + i
             pre_exprs.append(e)
             pre_cols.append((f"$win{len(pre_cols)}", e.dtype))
             return len(pre_cols) - 1
@@ -758,13 +804,17 @@ class Planner:
                 arg = lower(call.args[0])
                 off = const_int(call.args[1], f"{name} offset") \
                     if len(call.args) > 1 else 1
+                if off < 0:
+                    raise AnalysisError(f"{name} offset must be >= 0")
                 default = None
                 if len(call.args) > 2:
                     d = lower(call.args[2])
                     if not isinstance(d, ir.Literal):
                         raise AnalysisError(
                             f"{name} default must be a literal")
-                    default = d.value
+                    # rescale to the argument's representation (a DECIMAL
+                    # default literal carries its own scale)
+                    default = _convert_const(d.value, d.dtype, arg.dtype)
                 slot = add_input(arg)
                 fields[call] = self.field_for(arg, scope)
                 if arg.dtype.kind is TypeKind.VARCHAR and \
@@ -1036,12 +1086,12 @@ class Planner:
             pred = rewrite(q.having)
             current = L.FilterNode(current, pred, current.output)
 
-        # windows over the aggregated output (sum(sum(x)) OVER (...) etc.)
+        # windows over the aggregated output (sum(sum(x)) OVER (...) etc.);
+        # ORDER BY windows must match a select item (there is no hidden-
+        # sort-column path through aggregation), so only items are scanned
         wcalls: List[A.WindowFunc] = []
         for ast, _ in items:
             self.collect_windows(ast, wcalls)
-        for o in q.order_by:
-            self.collect_windows(o.expr, wcalls)
         wfields: Dict[A.WindowFunc, Optional[Field]] = {}
         if wcalls:
             current, slots, wfields = self.plan_windows(

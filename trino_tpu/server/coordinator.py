@@ -1,0 +1,306 @@
+"""Coordinator HTTP server — the statement protocol front end.
+
+Reference: the queued->executing REST protocol
+(dispatcher/QueuedStatementResource.java:109 `POST /v1/statement`,
+server/protocol/ExecutingStatementResource.java:67 with `nextUri` paging),
+DispatchManager.createQuery (dispatcher/DispatchManager.java:175), query
+info at /v1/query/{id} (server/QueryResource.java), node inventory
+(node/CoordinatorNodeManager.java) fed by worker announcements
+(node/Announcer.java), and /v1/status liveness used by the heartbeat
+failure detector (failuredetector/HeartbeatFailureDetector.java:344).
+
+stdlib http.server only — the protocol layer is host-side control plane;
+the TPU data plane stays inside the jitted stage programs.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import urlparse
+
+from ..exec.session import Session
+from .statemachine import QueryStateMachine, QueryTracker, TrackedQuery
+
+PAGE_ROWS = 1000          # rows per protocol page (target-result-size analog)
+
+
+class RegisteredNode:
+    """One announced worker (node/InternalNodeManager inventory entry)."""
+
+    def __init__(self, node_id: str, uri: str):
+        self.node_id = node_id
+        self.uri = uri
+        self.last_announce = time.time()
+        self.state = "ACTIVE"        # ACTIVE | SHUTTING_DOWN | FAILED
+
+
+class Dispatcher:
+    """Admission + async execution (DispatchManager + SqlQueryManager).
+
+    `max_concurrency` plays the resource-group concurrency limit
+    (InternalResourceGroup.java hardConcurrencyLimit); queries past it sit
+    QUEUED. Execution itself is serialized per engine session via an
+    executor lock (the single-process mesh is one 'cluster').
+    """
+
+    def __init__(self, session: Session, tracker: QueryTracker,
+                 max_concurrency: int = 4):
+        self.session = session
+        self.tracker = tracker
+        self.pool = ThreadPoolExecutor(max_workers=max_concurrency,
+                                       thread_name_prefix="dispatch")
+        self.exec_lock = threading.Lock()
+        self.failure_injector = None      # set by tests (FailureInjector)
+
+    def submit(self, sql: str, user: str) -> TrackedQuery:
+        qid = self.tracker.next_query_id()
+        tq = TrackedQuery(qid, sql, user, QueryStateMachine(qid))
+        self.tracker.register(tq)
+        self.pool.submit(self._run, tq)
+        return tq
+
+    def _run(self, tq: TrackedQuery) -> None:
+        sm = tq.state_machine
+        try:
+            if not sm.transition("PLANNING"):
+                return                    # canceled while queued
+            if self.failure_injector is not None:
+                self.failure_injector(tq)
+            with self.exec_lock:
+                if sm.is_done():
+                    return
+                sm.transition("RUNNING")
+                t0 = time.monotonic()
+                result = self.session.execute(tq.sql)
+                tq.elapsed_s = time.monotonic() - t0
+            tq.result = result
+            tq.rows_returned = len(result.rows)
+            sm.transition("FINISHING")
+            sm.transition("FINISHED")
+        except Exception as e:            # noqa: BLE001 — protocol boundary
+            sm.fail(f"{type(e).__name__}: {e}")
+            tq.plan_text = traceback.format_exc()
+
+
+class CoordinatorState:
+    def __init__(self, session: Session, max_concurrency: int = 4):
+        self.session = session
+        self.tracker = QueryTracker()
+        self.dispatcher = Dispatcher(session, self.tracker, max_concurrency)
+        self.nodes: Dict[str, RegisteredNode] = {}
+        self.nodes_lock = threading.Lock()
+        self.started_at = time.time()
+
+    def announce(self, node_id: str, uri: str) -> None:
+        with self.nodes_lock:
+            node = self.nodes.get(node_id)
+            if node is None or node.uri != uri:
+                self.nodes[node_id] = RegisteredNode(node_id, uri)
+            else:
+                node.last_announce = time.time()
+                if node.state == "FAILED":
+                    node.state = "ACTIVE"    # recovered
+
+    def active_nodes(self) -> List[RegisteredNode]:
+        with self.nodes_lock:
+            return [n for n in self.nodes.values() if n.state == "ACTIVE"]
+
+
+def _column_json(result) -> List[dict]:
+    cols = []
+    for name in result.column_names:
+        cols.append({"name": name, "type": "unknown"})
+    return cols
+
+
+def _rows_json(rows: List[tuple]) -> List[list]:
+    out = []
+    for r in rows:
+        vals = []
+        for v in r:
+            if v is None or isinstance(v, (int, float, str, bool)):
+                vals.append(v)
+            else:
+                vals.append(str(v))      # Decimal, date -> text like Trino
+        out.append(vals)
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: CoordinatorState = None       # injected by make_server
+    protocol_version = "HTTP/1.1"
+
+    # -- helpers ----------------------------------------------------------
+
+    def _send(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _base(self) -> str:
+        host = self.headers.get("Host", "localhost")
+        return f"http://{host}"
+
+    def log_message(self, fmt, *args):   # quiet
+        pass
+
+    def _read_body(self) -> str:
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n).decode()
+
+    def _query_payload(self, tq: TrackedQuery, token: int) -> dict:
+        """One protocol page: state + columns + data + nextUri while more."""
+        base = self._base()
+        payload = {
+            "id": tq.query_id,
+            "infoUri": f"{base}/v1/query/{tq.query_id}",
+            "stats": {
+                "state": tq.state,
+                "queued": tq.state == "QUEUED",
+                "elapsedTimeMillis": int(tq.elapsed_s * 1000),
+                "rows": tq.rows_returned,
+            },
+        }
+        sm = tq.state_machine
+        if sm.state == "FAILED":
+            payload["error"] = {"message": sm.error,
+                                "errorCode": 1,
+                                "errorName": "GENERIC_INTERNAL_ERROR"}
+            return payload
+        if sm.state == "CANCELED":
+            payload["error"] = {"message": "Query was canceled",
+                                "errorCode": 2, "errorName": "USER_CANCELED"}
+            return payload
+        if sm.state != "FINISHED":
+            payload["nextUri"] = (f"{base}/v1/statement/executing/"
+                                  f"{tq.query_id}/{token}")
+            return payload
+        result = tq.result
+        payload["columns"] = _column_json(result)
+        start = token * PAGE_ROWS
+        chunk = result.rows[start:start + PAGE_ROWS]
+        payload["data"] = _rows_json(chunk)
+        if start + PAGE_ROWS < len(result.rows):
+            payload["nextUri"] = (f"{base}/v1/statement/executing/"
+                                  f"{tq.query_id}/{token + 1}")
+        return payload
+
+    # -- routes -----------------------------------------------------------
+
+    def do_POST(self):
+        path = urlparse(self.path).path
+        if path == "/v1/statement":
+            sql = self._read_body()
+            if not sql.strip():
+                self._send(400, {"error": {"message": "empty statement"}})
+                return
+            user = self.headers.get("X-Trino-User", "anonymous")
+            tq = self.state.dispatcher.submit(sql, user)
+            self._send(200, self._query_payload(tq, 0))
+            return
+        if path == "/v1/announce":
+            body = json.loads(self._read_body() or "{}")
+            self.state.announce(body.get("nodeId", "unknown"),
+                                body.get("uri", ""))
+            self._send(202, {"ok": True})
+            return
+        self._send(404, {"error": {"message": f"no route {path}"}})
+
+    def do_GET(self):
+        path = urlparse(self.path).path
+        parts = [p for p in path.split("/") if p]
+        if path == "/v1/info":
+            self._send(200, {
+                "nodeVersion": {"version": "trino-tpu-0.1"},
+                "coordinator": True, "starting": False,
+                "uptime": time.time() - self.state.started_at})
+            return
+        if path == "/v1/status":
+            self._send(200, {"nodeId": "coordinator", "state": "ACTIVE"})
+            return
+        if path == "/v1/node":
+            nodes = [{"nodeId": n.node_id, "uri": n.uri, "state": n.state}
+                     for n in self.state.nodes.values()]
+            self._send(200, nodes)
+            return
+        if len(parts) == 2 and parts[0] == "v1" and parts[1] == "query":
+            out = []
+            for tq in self.state.tracker.all():
+                out.append({"queryId": tq.query_id, "state": tq.state,
+                            "query": tq.sql, "user": tq.session_user})
+            self._send(200, out)
+            return
+        if len(parts) == 3 and parts[0] == "v1" and parts[1] == "query":
+            tq = self.state.tracker.get(parts[2])
+            if tq is None:
+                self._send(404, {"error": {"message": "unknown query"}})
+                return
+            sm = tq.state_machine
+            self._send(200, {
+                "queryId": tq.query_id, "state": tq.state, "query": tq.sql,
+                "user": tq.session_user, "error": sm.error,
+                "elapsedSeconds": tq.elapsed_s,
+                "rows": tq.rows_returned, "retries": tq.retries})
+            return
+        if len(parts) >= 4 and parts[:3] == ["v1", "statement", "executing"]:
+            qid, token = parts[3], int(parts[4]) if len(parts) > 4 else 0
+            tq = self.state.tracker.get(qid)
+            if tq is None:
+                self._send(404, {"error": {"message": "unknown query"}})
+                return
+            # long-poll lite: give the dispatcher a moment before answering
+            # (ExecutingStatementResource waits up to ~1s the same way)
+            deadline = time.time() + 0.5
+            while not tq.state_machine.is_done() and time.time() < deadline:
+                time.sleep(0.01)
+            self._send(200, self._query_payload(tq, token))
+            return
+        self._send(404, {"error": {"message": f"no route {path}"}})
+
+    def do_DELETE(self):
+        path = urlparse(self.path).path
+        parts = [p for p in path.split("/") if p]
+        if len(parts) >= 4 and parts[:3] == ["v1", "statement", "executing"]:
+            tq = self.state.tracker.get(parts[3])
+            if tq is not None:
+                tq.state_machine.cancel()
+            self._send(204, {})
+            return
+        self._send(404, {"error": {"message": f"no route {path}"}})
+
+
+class CoordinatorServer:
+    """In-process coordinator (TestingTrinoServer.java:155 pattern: real
+    HTTP, embeddable in one process for tests)."""
+
+    def __init__(self, session: Optional[Session] = None, port: int = 0,
+                 max_concurrency: int = 4):
+        self.state = CoordinatorState(session or Session(),
+                                      max_concurrency)
+        handler = type("BoundHandler", (_Handler,), {"state": self.state})
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_address[1]
+        self.uri = f"http://127.0.0.1:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "CoordinatorServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="coordinator-http",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

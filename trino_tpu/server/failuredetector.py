@@ -1,0 +1,87 @@
+"""Heartbeat failure detector.
+
+Reference: failuredetector/HeartbeatFailureDetector.java:76 — the
+coordinator pings every worker's /v1/status (ping:344) and keeps an
+exponentially-decayed failure ratio per node; nodes above the threshold are
+excluded from scheduling until they recover (:91, :377).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+from urllib.request import urlopen
+
+from .coordinator import CoordinatorState
+
+
+class NodeStats:
+    """Exponentially-decayed success/failure ratio for one node."""
+
+    def __init__(self, decay: float = 0.8):
+        self.decay = decay
+        self.failure_ratio = 0.0
+        self.last_seen = time.time()
+
+    def record(self, success: bool) -> None:
+        sample = 0.0 if success else 1.0
+        self.failure_ratio = (self.decay * self.failure_ratio +
+                              (1 - self.decay) * sample)
+        if success:
+            self.last_seen = time.time()
+
+
+class HeartbeatFailureDetector:
+    """Pings announced workers; marks nodes FAILED past the threshold and
+    ACTIVE again when the decayed ratio drops back (same hysteresis as the
+    reference's failure-detector.threshold, default 0.1)."""
+
+    def __init__(self, state: CoordinatorState,
+                 interval_s: float = 0.5, threshold: float = 0.1,
+                 timeout_s: float = 2.0):
+        self.state = state
+        self.interval_s = interval_s
+        self.threshold = threshold
+        self.timeout_s = timeout_s
+        self.stats: Dict[str, NodeStats] = {}
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self) -> "HeartbeatFailureDetector":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="failure-detector", daemon=True)
+        self._thread.start()
+        return self
+
+    def ping_all(self) -> None:
+        with self.state.nodes_lock:
+            nodes = list(self.state.nodes.values())
+        for node in nodes:
+            st = self.stats.setdefault(node.node_id, NodeStats())
+            ok = False
+            try:
+                with urlopen(f"{node.uri}/v1/status",
+                             timeout=self.timeout_s) as resp:
+                    ok = resp.status == 200
+            except Exception:
+                ok = False
+            st.record(ok)
+            with self.state.nodes_lock:
+                live = self.state.nodes.get(node.node_id)
+                if live is None:
+                    continue
+                if st.failure_ratio > self.threshold:
+                    live.state = "FAILED"
+                elif live.state == "FAILED":
+                    live.state = "ACTIVE"
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.ping_all()
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

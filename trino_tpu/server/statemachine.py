@@ -1,0 +1,141 @@
+"""Query state machine and query tracking.
+
+Reference: execution/QueryState.java:21 (QUEUED -> WAITING_FOR_RESOURCES ->
+DISPATCHING -> PLANNING -> STARTING -> RUNNING -> FINISHING -> FINISHED /
+FAILED), the generic CAS StateMachine (execution/StateMachine.java:43) and
+QueryTracker (execution/QueryTracker.java:51). Python edition: a lock-guarded
+state holder with listeners, plus a registry with expiry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+TERMINAL = ("FINISHED", "FAILED", "CANCELED")
+ORDER = ("QUEUED", "PLANNING", "STARTING", "RUNNING", "FINISHING",
+         "FINISHED", "FAILED", "CANCELED")
+
+
+class QueryStateMachine:
+    """CAS-style state transitions; listeners fire outside the lock."""
+
+    def __init__(self, query_id: str):
+        self.query_id = query_id
+        self._state = "QUEUED"
+        self._lock = threading.Lock()
+        self._listeners: List[Callable[[str], None]] = []
+        self.error: Optional[str] = None
+        self.created_at = time.time()
+        self.ended_at: Optional[float] = None
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def is_done(self) -> bool:
+        return self._state in TERMINAL
+
+    def transition(self, new_state: str) -> bool:
+        """Advance to new_state; never moves backward or out of terminal."""
+        to_fire = []
+        with self._lock:
+            if self._state in TERMINAL:
+                return False
+            if ORDER.index(new_state) <= ORDER.index(self._state):
+                return False
+            self._state = new_state
+            if new_state in TERMINAL:
+                self.ended_at = time.time()
+            to_fire = list(self._listeners)
+        for fn in to_fire:
+            fn(new_state)
+        return True
+
+    def fail(self, message: str) -> bool:
+        with self._lock:
+            if self._state in TERMINAL:
+                return False
+            self.error = message
+            self._state = "FAILED"
+            self.ended_at = time.time()
+            to_fire = list(self._listeners)
+        for fn in to_fire:
+            fn("FAILED")
+        return True
+
+    def cancel(self) -> bool:
+        with self._lock:
+            if self._state in TERMINAL:
+                return False
+            self._state = "CANCELED"
+            self.error = "Query was canceled"
+            self.ended_at = time.time()
+            to_fire = list(self._listeners)
+        for fn in to_fire:
+            fn("CANCELED")
+        return True
+
+    def add_listener(self, fn: Callable[[str], None]) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+
+
+@dataclass
+class TrackedQuery:
+    """One query's full lifecycle record (QueryInfo essentials)."""
+    query_id: str
+    sql: str
+    session_user: str
+    state_machine: QueryStateMachine
+    result: Optional[object] = None       # exec.session.QueryResult
+    plan_text: Optional[str] = None
+    rows_returned: int = 0
+    cpu_time_s: float = 0.0
+    elapsed_s: float = 0.0
+    retries: int = 0
+
+    @property
+    def state(self) -> str:
+        return self.state_machine.state
+
+
+class QueryTracker:
+    """Registry of live + recently finished queries (QueryTracker.java:51;
+    expiry mirrors query.min-expire-age)."""
+
+    def __init__(self, max_history: int = 100):
+        self._queries: Dict[str, TrackedQuery] = {}
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.max_history = max_history
+
+    def next_query_id(self) -> str:
+        with self._lock:
+            self._seq += 1
+            # Trino ids look like 20240101_000000_00000_abcde
+            return time.strftime("%Y%m%d_%H%M%S") + f"_{self._seq:05d}_tpu"
+
+    def register(self, q: TrackedQuery) -> None:
+        with self._lock:
+            self._queries[q.query_id] = q
+            self._expire_locked()
+
+    def get(self, query_id: str) -> Optional[TrackedQuery]:
+        with self._lock:
+            return self._queries.get(query_id)
+
+    def all(self) -> List[TrackedQuery]:
+        with self._lock:
+            return list(self._queries.values())
+
+    def _expire_locked(self) -> None:
+        done = [q for q in self._queries.values()
+                if q.state_machine.is_done()]
+        excess = len(done) - self.max_history
+        if excess > 0:
+            done.sort(key=lambda q: q.state_machine.ended_at or 0)
+            for q in done[:excess]:
+                del self._queries[q.query_id]

@@ -1,0 +1,114 @@
+"""Worker server + announcer.
+
+Reference: the worker role of Server.java (ServerMainModule.java:200
+WorkerModule) — a worker exposes /v1/status for liveness and /v1/task for
+fragment execution, and announces itself to discovery (node/Announcer.java).
+
+In the TPU runtime a "worker" owns a slice of the device mesh within the
+host process; across hosts each worker process owns its host's chips and
+the coordinator drives them over this control plane. The data plane between
+co-located workers is ICI collectives inside the jitted stage programs, so
+/v1/task here accepts work descriptors rather than serialized pages.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlparse
+from urllib.request import Request, urlopen
+
+
+class _WorkerHandler(BaseHTTPRequestHandler):
+    worker: "WorkerServer" = None
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _send(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        path = urlparse(self.path).path
+        if path == "/v1/status":
+            if self.worker.fail_status:      # fault injection hook
+                self._send(500, {"error": "injected failure"})
+                return
+            self._send(200, {"nodeId": self.worker.node_id,
+                             "state": self.worker.state,
+                             "uptime": time.time() - self.worker.started_at})
+            return
+        if path == "/v1/info":
+            self._send(200, {"nodeVersion": {"version": "trino-tpu-0.1"},
+                             "coordinator": False})
+            return
+        self._send(404, {"error": f"no route {path}"})
+
+    def do_PUT(self):
+        path = urlparse(self.path).path
+        if path == "/v1/info/state":         # graceful shutdown / drain
+            n = int(self.headers.get("Content-Length", 0))
+            state = json.loads(self.rfile.read(n).decode())
+            self.worker.state = state
+            self._send(200, {"state": self.worker.state})
+            return
+        self._send(404, {"error": f"no route {path}"})
+
+
+class WorkerServer:
+    """One worker process stand-in: HTTP status endpoint + announcer loop."""
+
+    def __init__(self, node_id: str, coordinator_uri: str, port: int = 0,
+                 announce_interval_s: float = 1.0):
+        self.node_id = node_id
+        self.coordinator_uri = coordinator_uri
+        self.state = "ACTIVE"
+        self.fail_status = False
+        self.started_at = time.time()
+        handler = type("BoundWorkerHandler", (_WorkerHandler,),
+                       {"worker": self})
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_address[1]
+        self.uri = f"http://127.0.0.1:{self.port}"
+        self.announce_interval_s = announce_interval_s
+        self._stop = threading.Event()
+        self._threads = []
+
+    def start(self) -> "WorkerServer":
+        t1 = threading.Thread(target=self.httpd.serve_forever,
+                              name=f"worker-{self.node_id}", daemon=True)
+        t1.start()
+        t2 = threading.Thread(target=self._announce_loop,
+                              name=f"announcer-{self.node_id}", daemon=True)
+        t2.start()
+        self._threads = [t1, t2]
+        return self
+
+    def announce_once(self) -> None:
+        body = json.dumps({"nodeId": self.node_id, "uri": self.uri}).encode()
+        req = Request(f"{self.coordinator_uri}/v1/announce", data=body,
+                      headers={"Content-Type": "application/json"})
+        with urlopen(req, timeout=5):
+            pass
+
+    def _announce_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.announce_once()
+            except Exception:
+                pass                      # coordinator down: keep trying
+            self._stop.wait(self.announce_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
