@@ -30,6 +30,16 @@ from .statemachine import QueryStateMachine, QueryTracker, TrackedQuery
 PAGE_ROWS = 1000          # rows per protocol page (target-result-size analog)
 
 
+def _is_retryable(e: Exception) -> bool:
+    """User errors (bad SQL, missing columns) never retry; runtime/injected
+    failures do — the reference draws the same line via error categories
+    (USER_ERROR vs INTERNAL_ERROR/EXTERNAL)."""
+    from ..planner.analyzer import AnalysisError
+    from ..sql.tokenizer import SqlSyntaxError
+    return not isinstance(e, (AnalysisError, SqlSyntaxError,
+                              AssertionError))
+
+
 class RegisteredNode:
     """One announced worker (node/InternalNodeManager inventory entry)."""
 
@@ -50,13 +60,20 @@ class Dispatcher:
     """
 
     def __init__(self, session: Session, tracker: QueryTracker,
-                 max_concurrency: int = 4):
+                 max_concurrency: int = 4, retry_policy: str = "NONE",
+                 max_retries: int = 3):
         self.session = session
         self.tracker = tracker
         self.pool = ThreadPoolExecutor(max_workers=max_concurrency,
                                        thread_name_prefix="dispatch")
         self.exec_lock = threading.Lock()
-        self.failure_injector = None      # set by tests (FailureInjector)
+        self.failure_injector = None      # FailureInjector (tests/ops)
+        # retry-policy QUERY (admin/fault-tolerant-execution.md): rerun the
+        # whole query on failure; deterministic kernels + the dedup of
+        # serving only the final attempt's result give identical output
+        # (DeduplicatingDirectExchangeBuffer.java:87's role)
+        self.retry_policy = retry_policy  # NONE | QUERY
+        self.max_retries = max_retries
 
     def submit(self, sql: str, user: str) -> TrackedQuery:
         qid = self.tracker.next_query_id()
@@ -67,32 +84,49 @@ class Dispatcher:
 
     def _run(self, tq: TrackedQuery) -> None:
         sm = tq.state_machine
-        try:
-            if not sm.transition("PLANNING"):
-                return                    # canceled while queued
-            if self.failure_injector is not None:
-                self.failure_injector(tq)
-            with self.exec_lock:
-                if sm.is_done():
-                    return
-                sm.transition("RUNNING")
-                t0 = time.monotonic()
-                result = self.session.execute(tq.sql)
-                tq.elapsed_s = time.monotonic() - t0
-            tq.result = result
-            tq.rows_returned = len(result.rows)
-            sm.transition("FINISHING")
-            sm.transition("FINISHED")
-        except Exception as e:            # noqa: BLE001 — protocol boundary
-            sm.fail(f"{type(e).__name__}: {e}")
-            tq.plan_text = traceback.format_exc()
+        attempts = 1 + (self.max_retries
+                        if self.retry_policy == "QUERY" else 0)
+        if not sm.transition("PLANNING"):
+            return                        # canceled while queued
+        last_error: Optional[str] = None
+        for attempt in range(attempts):
+            if sm.is_done():
+                return
+            try:
+                if attempt > 0:
+                    tq.retries = attempt
+                if self.failure_injector is not None:
+                    self.failure_injector.maybe_fail("DISPATCH", tq.sql)
+                with self.exec_lock:
+                    if sm.is_done():
+                        return
+                    sm.transition("RUNNING")
+                    if self.failure_injector is not None:
+                        self.failure_injector.maybe_fail("EXECUTION",
+                                                         tq.sql)
+                    t0 = time.monotonic()
+                    result = self.session.execute(tq.sql)
+                    tq.elapsed_s = time.monotonic() - t0
+                tq.result = result
+                tq.rows_returned = len(result.rows)
+                sm.transition("FINISHING")
+                sm.transition("FINISHED")
+                return
+            except Exception as e:        # noqa: BLE001 — retry boundary
+                last_error = f"{type(e).__name__}: {e}"
+                tq.plan_text = traceback.format_exc()
+                if not _is_retryable(e):
+                    break
+        sm.fail(last_error or "query failed")
 
 
 class CoordinatorState:
-    def __init__(self, session: Session, max_concurrency: int = 4):
+    def __init__(self, session: Session, max_concurrency: int = 4,
+                 retry_policy: str = "NONE"):
         self.session = session
         self.tracker = QueryTracker()
-        self.dispatcher = Dispatcher(session, self.tracker, max_concurrency)
+        self.dispatcher = Dispatcher(session, self.tracker, max_concurrency,
+                                     retry_policy)
         self.nodes: Dict[str, RegisteredNode] = {}
         self.nodes_lock = threading.Lock()
         self.started_at = time.time()
@@ -283,9 +317,9 @@ class CoordinatorServer:
     HTTP, embeddable in one process for tests)."""
 
     def __init__(self, session: Optional[Session] = None, port: int = 0,
-                 max_concurrency: int = 4):
+                 max_concurrency: int = 4, retry_policy: str = "NONE"):
         self.state = CoordinatorState(session or Session(),
-                                      max_concurrency)
+                                      max_concurrency, retry_policy)
         handler = type("BoundHandler", (_Handler,), {"state": self.state})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]

@@ -1,0 +1,61 @@
+"""Fault injection for failure-recovery testing.
+
+Reference: FailureInjector is part of the engine proper
+(execution/FailureInjector.java:35,51 — injected failure types fired at
+task-management and results-fetch boundaries), driven by
+BaseFailureRecoveryTest (testing/trino-testing/.../BaseFailureRecoveryTest.java:85)
+to kill work mid-query and assert identical results under retry.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+# Injection points in the query lifecycle (the reference's
+# InjectedFailureType values, mapped to this runtime's boundaries).
+DISPATCH = "DISPATCH"          # before planning (task-management analog)
+EXECUTION = "EXECUTION"        # during stage execution (results-fetch analog)
+
+
+class InjectedFailure(Exception):
+    pass
+
+
+@dataclass
+class _Rule:
+    point: str
+    remaining: int             # fail this many times, then let through
+    match_sql: Optional[str]   # substring filter, None = all queries
+
+
+class FailureInjector:
+    """Fails matching queries at a chosen point a fixed number of times."""
+
+    def __init__(self):
+        self._rules: list = []
+        self._lock = threading.Lock()
+        self.injected_count = 0
+
+    def inject(self, point: str, times: int = 1,
+               match_sql: Optional[str] = None) -> None:
+        with self._lock:
+            self._rules.append(_Rule(point, times, match_sql))
+
+    def maybe_fail(self, point: str, sql: str) -> None:
+        with self._lock:
+            for rule in self._rules:
+                if rule.point != point or rule.remaining <= 0:
+                    continue
+                if rule.match_sql is not None and \
+                        rule.match_sql not in sql:
+                    continue
+                rule.remaining -= 1
+                self.injected_count += 1
+                raise InjectedFailure(
+                    f"injected {point} failure ({rule.remaining} left)")
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules.clear()
