@@ -88,6 +88,13 @@ def load_oracle(tables: Iterable[TableData]) -> sqlite3.Connection:
                 host_cols.append(np.asarray(arr) / (10 ** f.dtype.scale))
             else:
                 host_cols.append(np.asarray(arr))
+        if t.valids is not None:
+            for j, v in enumerate(t.valids):
+                if v is None:
+                    continue
+                col = np.asarray(host_cols[j], dtype=object)
+                col[~np.asarray(v)] = None
+                host_cols[j] = col
         rows = list(zip(*[c.tolist() for c in host_cols]))
         ph = ", ".join("?" * len(t.schema))
         conn.executemany(f"INSERT INTO {t.name} VALUES ({ph})", rows)

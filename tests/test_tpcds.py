@@ -1,0 +1,46 @@
+"""TPC-DS query tests against the sqlite oracle.
+
+Reference pattern: trino-tpcds conformance + benchmark query suites
+(SURVEY.md §2.11, §6) — the engine and an independent SQL engine run the
+same queries over identical generated data.
+"""
+
+import pytest
+
+from oracle import assert_rows_match, load_oracle, oracle_query
+from tpcds_queries import QUERIES
+from trino_tpu.connectors.tpcds.connector import TABLE_NAMES
+from trino_tpu.exec.session import Session
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session(default_cat="tpcds", default_schema="tiny")
+
+
+@pytest.fixture(scope="module")
+def oracle(session):
+    conn = session.catalog.connector("tpcds")
+    return load_oracle([conn.get_table("tiny", t) for t in TABLE_NAMES])
+
+
+def test_datagen_shapes(session):
+    conn = session.catalog.connector("tpcds")
+    ss = conn.get_table("tiny", "store_sales")
+    assert ss.num_rows >= 100000
+    dd = conn.get_table("tiny", "date_dim")
+    assert dd.num_rows == 1826
+
+
+def test_fact_nulls_present(session):
+    r = session.execute(
+        "SELECT count(*) - count(ss_customer_sk) FROM store_sales")
+    assert r.rows[0][0] > 0
+
+
+@pytest.mark.parametrize("qid", sorted(QUERIES))
+def test_tpcds_query(session, oracle, qid):
+    sql = QUERIES[qid]
+    got = session.execute(sql).rows
+    want = oracle_query(oracle, sql)
+    assert_rows_match(got, want, rel_tol=1e-9, abs_tol=0.02, ordered=True)

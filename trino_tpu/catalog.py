@@ -34,6 +34,8 @@ class Catalog:
 def default_catalog() -> Catalog:
     cat = Catalog()
     cat.register("tpch", TpchConnector())
+    from .connectors.tpcds.connector import TpcdsConnector
+    cat.register("tpcds", TpcdsConnector())
     from .connectors.memory import MemoryConnector
     cat.register("memory", MemoryConnector())
     return cat

@@ -1,0 +1,51 @@
+"""TPC-DS connector.
+
+Reference: plugin/trino-tpcds (TpcdsMetadata/TpcdsRecordSet over the
+Teradata generators) — schemas tiny/sf1/... map to scale factors, tables
+generated deterministically and cached per scale.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional
+
+from ..tpch.datagen import TableData
+from .datagen import PRIMARY_KEYS, generate
+
+_SCHEMAS = {"tiny": 0.01, "sf1": 1.0, "sf10": 10.0, "sf100": 100.0,
+            "sf1000": 1000.0}
+
+TABLE_NAMES = list(PRIMARY_KEYS)
+
+
+class TpcdsConnector:
+    name = "tpcds"
+
+    def __init__(self):
+        self._cache: Dict[float, Dict[str, TableData]] = {}
+
+    @staticmethod
+    def scale_for_schema(schema: str) -> Optional[float]:
+        if schema in _SCHEMAS:
+            return _SCHEMAS[schema]
+        m = re.fullmatch(r"sf([0-9.]+)", schema)
+        if m:
+            return float(m.group(1))
+        return None
+
+    def schema_names(self):
+        return list(_SCHEMAS)
+
+    def table_names(self, schema: str):
+        return list(TABLE_NAMES)
+
+    def get_table(self, schema: str, table: str) -> TableData:
+        scale = self.scale_for_schema(schema)
+        if scale is None:
+            raise KeyError(f"tpcds schema {schema!r} not found")
+        if table not in TABLE_NAMES:
+            raise KeyError(f"tpcds table {table!r} not found")
+        if scale not in self._cache:
+            self._cache[scale] = generate(scale)
+        return self._cache[scale][table]

@@ -154,6 +154,27 @@ class DerivedDict(Expr):
 
 
 @dataclass(frozen=True)
+class ScalarFunc(Expr):
+    """Generic elementwise scalar function (abs/round/mod/coalesce/...).
+
+    The engine analog of Trino's operator/scalar/ built-ins resolved via
+    InternalFunctionBundle — evaluated branch-free in ops/project.py."""
+    name: str
+    args: tuple                  # tuple[Expr, ...]
+    dtype: DataType
+    params: tuple = ()           # static extras (e.g. round digits)
+
+
+@dataclass(frozen=True)
+class DictValueMap(Expr):
+    """Map dictionary codes to precomputed host values (e.g. length(col)):
+    one device gather through a per-code LUT."""
+    arg: Expr                    # varchar codes
+    values: tuple                # per-code value
+    dtype: DataType
+
+
+@dataclass(frozen=True)
 class DecimalAvg(Expr):
     """Exact decimal AVG finalizer: round-half-away-from-zero of
     sum/count at the argument's scale (Trino avg(decimal) semantics,
@@ -206,8 +227,10 @@ def walk(expr: Expr):
     if isinstance(expr, Arith):
         children = (expr.left, expr.right)
     elif isinstance(expr, (Negate, Not, Cast, ExtractField, DictPredicate,
-                           DerivedDict)):
+                           DerivedDict, DictValueMap)):
         children = (expr.arg,)
+    elif isinstance(expr, ScalarFunc):
+        children = expr.args
     elif isinstance(expr, IsNull):
         children = (expr.arg,)
     elif isinstance(expr, Compare):
@@ -277,6 +300,14 @@ def remap_columns(expr: Expr, mapping) -> Expr:
     if isinstance(expr, DerivedDict):
         return DerivedDict(remap_columns(expr.arg, mapping), expr.lut,
                            expr.pool, expr.dtype)
+    if isinstance(expr, ScalarFunc):
+        return ScalarFunc(expr.name,
+                          tuple(remap_columns(a, mapping)
+                                for a in expr.args),
+                          expr.dtype, expr.params)
+    if isinstance(expr, DictValueMap):
+        return DictValueMap(remap_columns(expr.arg, mapping), expr.values,
+                            expr.dtype)
     if isinstance(expr, ScalarSubqueryRef):
         return expr          # no column refs into the enclosing batch
     raise NotImplementedError(type(expr).__name__)

@@ -173,7 +173,10 @@ def sort_group_aggregate(batch: Batch, key_indices: tuple, aggs: tuple,
     for ki in key_indices:
         col = batch.columns[ki]
         operands.append((~col.valid).astype(jnp.int8))
-        operands.append(col.data)
+        # NULL keys must form ONE group: normalize masked-out data so the
+        # boundary detector can't split NULL rows on garbage values
+        operands.append(jnp.where(col.valid, col.data,
+                                  jnp.zeros((), col.data.dtype)))
     n_group_ops = len(operands)
     # DISTINCT aggregate columns join the sort key (after the group keys) so
     # duplicates within a group are adjacent; they do NOT define segment

@@ -51,8 +51,8 @@ def _to_comparable(expr: ir.Expr, data: jax.Array, target) -> jax.Array:
         return data.astype(jnp.int64) * (10 ** target.scale)
     if target.kind is TypeKind.DOUBLE:
         if t.kind is TypeKind.DECIMAL:
-            return data.astype(jnp.float32) / (10 ** t.scale)
-        return data.astype(jnp.float32)
+            return data.astype(jnp.float64) / (10 ** t.scale)
+        return data.astype(jnp.float64)
     return data
 
 
@@ -133,7 +133,7 @@ def eval_expr(expr: ir.Expr, batch: Batch):
                 # raises DIVISION_BY_ZERO; a vectorized engine can't raise
                 # per-row, so we degrade to NULL rather than emit a bogus
                 # value marked valid)
-                res = l / jnp.where(r == 0, jnp.float32(1), r)
+                res = l / jnp.where(r == 0, jnp.float64(1), r)
                 valid = valid & (r != 0)
             return res, valid
         # integer-like (BIGINT/INTEGER/DATE)
@@ -249,15 +249,15 @@ def eval_expr(expr: ir.Expr, batch: Batch):
             if src.kind is TypeKind.DOUBLE:
                 # HALF_UP (away from zero), matching rescale(); jnp.round is
                 # half-to-even and would disagree at *.5
-                xs = d.astype(jnp.float32) * (10 ** dst.scale)
+                xs = d.astype(jnp.float64) * (10 ** dst.scale)
                 half_up = jnp.where(xs >= 0, jnp.floor(xs + 0.5),
                                     jnp.ceil(xs - 0.5))
                 return half_up.astype(jnp.int64), v
             return d.astype(jnp.int64) * (10 ** dst.scale), v
         if dst.kind is TypeKind.DOUBLE:
             if src.kind is TypeKind.DECIMAL:
-                return d.astype(jnp.float32) / (10 ** src.scale), v
-            return d.astype(jnp.float32), v
+                return d.astype(jnp.float64) / (10 ** src.scale), v
+            return d.astype(jnp.float64), v
         if dst.kind in (TypeKind.BIGINT, TypeKind.INTEGER):
             if src.kind is TypeKind.DECIMAL:
                 return rescale(d, src.scale, 0).astype(dst.np_dtype), v
@@ -291,7 +291,99 @@ def eval_expr(expr: ir.Expr, batch: Batch):
         res = {'year': year, 'month': month, 'day': day}[expr.part]
         return res.astype(jnp.int64), v
 
+    if isinstance(expr, ir.DictValueMap):
+        d, v = eval_expr(expr.arg, batch)
+        lut = jnp.asarray(expr.values, dtype=expr.dtype.np_dtype)
+        codes = jnp.clip(d.astype(jnp.int32), 0, len(expr.values) - 1)
+        return lut[codes], v
+
+    if isinstance(expr, ir.ScalarFunc):
+        return eval_scalar_func(expr, batch)
+
     raise NotImplementedError(f"eval of {type(expr).__name__}")
+
+
+def eval_scalar_func(expr: ir.ScalarFunc, batch: Batch):
+    """Built-in scalar functions (reference: operator/scalar/ — MathFunctions,
+    ConditionalFunctions), branch-free with three-valued logic."""
+    name = expr.name
+    parts = [eval_expr(a, batch) for a in expr.args]
+
+    if name == "coalesce":
+        d, v = parts[-1]
+        d = d.astype(expr.dtype.np_dtype)
+        for pd, pv in reversed(parts[:-1]):
+            d = jnp.where(pv, pd.astype(expr.dtype.np_dtype), d)
+            v = pv | v
+        return d, v
+
+    if name == "nullif":
+        (ad, av), (bd, bv) = parts
+        eq = av & bv & (ad == bd.astype(ad.dtype))
+        return ad, av & ~eq
+
+    if name in ("greatest", "least"):
+        op = jnp.maximum if name == "greatest" else jnp.minimum
+        d, v = parts[0]
+        d = d.astype(expr.dtype.np_dtype)
+        for pd, pv in parts[1:]:
+            d = op(d, pd.astype(expr.dtype.np_dtype))
+            v = v & pv          # NULL if any argument is NULL (Trino)
+        return d, v
+
+    (d, v) = parts[0]
+    t = expr.args[0].dtype
+    if name == "abs":
+        return jnp.abs(d), v
+    if name == "round":
+        digits = expr.params[0] if expr.params else 0
+        if t.kind is TypeKind.DECIMAL:
+            # round at `digits` decimal places, keep the scale
+            if digits >= t.scale:
+                return d, v
+            return rescale(rescale(d, t.scale, digits), digits, t.scale), v
+        factor = jnp.float64(10.0 ** digits)
+        xs = d.astype(jnp.float64) * factor
+        half_up = jnp.where(xs >= 0, jnp.floor(xs + 0.5),
+                            jnp.ceil(xs - 0.5))
+        return half_up / factor, v
+    if name in ("floor", "ceil"):
+        if t.kind is TypeKind.DECIMAL:
+            s = 10 ** t.scale
+            # on scaled ints: floor -> toward -inf, ceil -> toward +inf
+            q = d // s if name == "floor" else -((-d) // s)
+            return q, v
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            op = jnp.floor if name == "floor" else jnp.ceil
+            return op(d), v
+        return d.astype(jnp.int64), v
+    if name == "mod":
+        (rd, rv) = parts[1]
+        r = rd.astype(d.dtype)
+        safe = jnp.where(r == 0, jnp.ones_like(r), r)
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            res = d - jnp.trunc(d / safe) * safe
+        else:
+            q = d // safe
+            rem = d - q * safe
+            # SQL mod truncates toward zero: sign follows the dividend
+            res = jnp.where((rem != 0) & ((d < 0) != (r < 0)),
+                            rem - safe, rem)
+            res = jnp.where(rem == 0, rem, res)
+        return res, v & parts[1][1] & (rd != 0)
+    if name == "sqrt":
+        x = d.astype(jnp.float64)
+        return jnp.sqrt(jnp.abs(x)), v & (x >= 0)
+    if name == "power":
+        (rd, rv) = parts[1]
+        return jnp.power(d.astype(jnp.float64),
+                         rd.astype(jnp.float64)), v & rv
+    if name == "exp":
+        return jnp.exp(d.astype(jnp.float64)), v
+    if name == "ln":
+        x = d.astype(jnp.float64)
+        return jnp.log(jnp.where(x > 0, x, jnp.float64(1))), v & (x > 0)
+    raise NotImplementedError(f"scalar function {name}")
 
 
 def filter_mask(expr: ir.Expr, batch: Batch) -> jax.Array:

@@ -24,7 +24,9 @@ def _sort_key_encoding(col: Column, ascending: bool, nulls_first: bool):
         null_rank = jnp.where(col.valid, 1, 0)
     else:
         null_rank = jnp.where(col.valid, 0, 1)
-    data = col.data
+    # normalize NULL slots: garbage data must not order NULL rows among
+    # themselves (window peer groups require NULLs to compare equal)
+    data = jnp.where(col.valid, col.data, jnp.zeros((), col.data.dtype))
     if not ascending:
         if jnp.issubdtype(data.dtype, jnp.bool_):
             data = ~data

@@ -75,7 +75,10 @@ def window_compute(batch: Batch, partition_keys: tuple, order_keys: tuple,
     for ki in partition_keys:
         col = batch.columns[ki]
         operands.append((~col.valid).astype(jnp.int8))
-        operands.append(col.data)
+        # NULL keys form one partition: normalize masked data (see
+        # sort_group_aggregate)
+        operands.append(jnp.where(col.valid, col.data,
+                                  jnp.zeros((), col.data.dtype)))
     n_part_ops = len(operands)
     for (ki, asc, nf) in order_keys:
         nr, data = _sort_key_encoding(batch.columns[ki], asc, nf)

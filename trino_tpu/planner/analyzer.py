@@ -17,8 +17,8 @@ from typing import List, Optional, Tuple
 
 from .. import ir
 from ..batch import Field
-from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, DataType, TypeKind,
-                     decimal)
+from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, VARCHAR, DataType,
+                     TypeKind, common_super_type, decimal)
 from ..sql import ast_nodes as A
 
 EPOCH = datetime.date(1970, 1, 1)
@@ -303,7 +303,7 @@ class ExpressionLowerer:
                     f"aggregate {node.name}() not allowed here")
             if node.name in ("substring", "substr"):
                 return self.lower_substring(node)
-            raise AnalysisError(f"unsupported function {node.name}()")
+            return self.lower_scalar_func(node)
 
         if isinstance(node, A.ScalarSubquery):
             if self.planner is None:
@@ -335,6 +335,110 @@ class ExpressionLowerer:
         index = {s: i for i, s in enumerate(new_pool)}
         lut = tuple(index[s] for s in transformed)
         return ir.DerivedDict(arg, lut, new_pool, arg.dtype)
+
+    def lower_scalar_func(self, node: A.FunctionCall) -> ir.Expr:
+        """Built-in scalar functions (metadata/InternalFunctionBundle.java's
+        registry role): numeric ones lower to ir.ScalarFunc, varchar ones to
+        host-side dictionary-pool transforms."""
+        name = node.name
+        args = [self.lower(a) for a in node.args]
+
+        # -- varchar functions: pool transforms / LUTs --------------------
+        if name in ("upper", "lower", "trim", "ltrim", "rtrim"):
+            if len(args) != 1:
+                raise AnalysisError(f"{name} takes one argument")
+            fn = {"upper": str.upper, "lower": str.lower,
+                  "trim": str.strip, "ltrim": str.lstrip,
+                  "rtrim": str.rstrip}[name]
+            return self.dict_transform(args[0], fn)
+        if name == "length":
+            if len(args) != 1:
+                raise AnalysisError("length takes one argument")
+            pool = self.pool_of(args[0])
+            return ir.DictValueMap(args[0],
+                                   tuple(len(s) for s in pool), BIGINT)
+        if name == "concat":
+            return self.lower_concat(args)
+        if name in ("year", "month", "day"):
+            if len(args) != 1 or args[0].dtype.kind is not TypeKind.DATE:
+                raise AnalysisError(f"{name} requires a date argument")
+            return ir.ExtractField(name, args[0])
+
+        # -- numeric / conditional ----------------------------------------
+        for a in args:
+            if isinstance(a, _StringConst):
+                raise AnalysisError(
+                    f"{name}() does not take string literals")
+        if name in ("coalesce", "nullif", "greatest", "least"):
+            if name == "nullif" and len(args) != 2:
+                raise AnalysisError("nullif takes two arguments")
+            if len(args) < 2:
+                raise AnalysisError(f"{name} takes at least two arguments")
+            out_t = args[0].dtype
+            if name != "nullif":
+                for a in args[1:]:
+                    out_t = common_super_type(out_t, a.dtype)
+            return ir.ScalarFunc(name, tuple(args), out_t)
+        if name in ("abs", "round", "floor", "ceil", "ceiling"):
+            t = args[0].dtype
+            digits = ()
+            if name == "round" and len(args) == 2:
+                if not isinstance(args[1], ir.Literal):
+                    raise AnalysisError("round digits must be a literal")
+                digits = (int(args[1].value),)
+                args = args[:1]
+            if name in ("floor", "ceil", "ceiling"):
+                out_t = BIGINT if t.kind in (TypeKind.DECIMAL,
+                                             TypeKind.BIGINT,
+                                             TypeKind.INTEGER) else DOUBLE
+                return ir.ScalarFunc("ceil" if name == "ceiling" else name,
+                                     tuple(args), out_t)
+            return ir.ScalarFunc(name, tuple(args), t, digits)
+        if name == "mod":
+            if len(args) != 2:
+                raise AnalysisError("mod takes two arguments")
+            out_t = common_super_type(args[0].dtype, args[1].dtype)
+            return ir.ScalarFunc(name, tuple(args), out_t)
+        if name in ("sqrt", "power", "pow", "exp", "ln"):
+            return ir.ScalarFunc("power" if name == "pow" else name,
+                                 tuple(args), DOUBLE)
+        raise AnalysisError(f"unsupported function {name}()")
+
+    def dict_transform(self, col: ir.Expr, fn) -> ir.Expr:
+        """Apply a host string transform to the pool (DerivedDict)."""
+        pool = self.pool_of(col)
+        transformed = [fn(s) for s in pool]
+        new_pool = tuple(sorted(set(transformed)))
+        index = {s: i for i, s in enumerate(new_pool)}
+        lut = tuple(index[s] for s in transformed)
+        return ir.DerivedDict(col, lut, new_pool, col.dtype
+                              if not isinstance(col, _StringConst)
+                              else VARCHAR)
+
+    def lower_concat(self, args) -> ir.Expr:
+        """col || literal / literal || col (pool transform). col || col
+        would explode the pool cross-product — unsupported."""
+        cols = [a for a in args
+                if not isinstance(a, _StringConst)]
+        if len(cols) != 1:
+            raise AnalysisError(
+                "concat supports one varchar column plus literals")
+        col = cols[0]
+        if col.dtype.kind is not TypeKind.VARCHAR:
+            raise AnalysisError("concat requires varchar arguments")
+        prefix = ""
+        suffix = ""
+        before = True
+        for a in args:
+            if a is col:
+                before = False
+            elif isinstance(a, _StringConst):
+                if before:
+                    prefix += a.value
+                else:
+                    suffix += a.value
+        return self.dict_transform(col,
+                                   lambda s: f"{prefix}{s}{suffix}")
 
     # ---- helpers ----------------------------------------------------------
 
@@ -383,8 +487,12 @@ class ExpressionLowerer:
             left = self.lower(node.left)
             right = self.lower(node.right)
             if op == "%":
-                raise AnalysisError("modulo not yet supported")
+                out_t = common_super_type(left.dtype, right.dtype)
+                return ir.ScalarFunc("mod", (left, right), out_t)
             return ir.arith(op, left, right)
+        if op == "||":
+            return self.lower_concat([self.lower(node.left),
+                                      self.lower(node.right)])
         raise AnalysisError(f"unsupported operator {op!r}")
 
     def lower_case(self, node: A.CaseExpr) -> ir.Expr:

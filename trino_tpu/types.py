@@ -5,8 +5,11 @@ Role of the reference's ``core/trino-spi`` type system (spi/type/Type.java,
 Our physical layout is chosen for TPU/XLA rather than the JVM:
 
 - BIGINT / INTEGER      -> int64 / int32 arrays
-- DOUBLE                -> float32 on device (float64 is not TPU-native;
-                           finalization arithmetic runs host-side in f64)
+- DOUBLE                -> float64 (SQL double semantics: discrete
+                           functions like ceil/floor must not jump on f32
+                           rounding error; XLA emulates f64 on the TPU VPU
+                           — acceptable since hot aggregation arithmetic is
+                           scaled-int64 decimal, not double)
 - BOOLEAN               -> bool arrays
 - DATE                  -> int32 days since 1970-01-01 (same as Trino)
 - DECIMAL(p, s)         -> int64 scaled by 10**s (Trino short decimal,
@@ -60,7 +63,7 @@ class DataType:
         return {
             TypeKind.BIGINT: np.dtype(np.int64),
             TypeKind.INTEGER: np.dtype(np.int32),
-            TypeKind.DOUBLE: np.dtype(np.float32),
+            TypeKind.DOUBLE: np.dtype(np.float64),
             TypeKind.BOOLEAN: np.dtype(np.bool_),
             TypeKind.DATE: np.dtype(np.int32),
             TypeKind.DECIMAL: np.dtype(np.int64),
