@@ -28,7 +28,75 @@ class Catalog:
         return self._connectors[name]
 
     def get_table(self, catalog: str, schema: str, table: str):
+        if schema == "information_schema":
+            return self.information_schema_table(catalog, table)
         return self.connector(catalog).get_table(schema, table)
+
+    def information_schema_table(self, catalog: str, table: str):
+        """Synthesize information_schema.{schemata,tables,columns} from
+        connector metadata (reference: the engine-provided
+        information_schema connector, connector/informationschema/)."""
+        conn = self.connector(catalog)
+        if table == "schemata":
+            names = list(conn.schema_names())
+            return _strings_table("schemata",
+                                  [("catalog_name", [catalog] * len(names)),
+                                   ("schema_name", names)])
+        if table == "tables":
+            cats, schemas, tables = [], [], []
+            for s in conn.schema_names():
+                for t in conn.table_names(s):
+                    cats.append(catalog)
+                    schemas.append(s)
+                    tables.append(t)
+            return _strings_table("tables",
+                                  [("table_catalog", cats),
+                                   ("table_schema", schemas),
+                                   ("table_name", tables)])
+        if table == "columns":
+            get_schema = getattr(conn, "get_table_schema",
+                                 lambda s, t: conn.get_table(s, t).schema)
+            schemas, tables, cols, types, positions = [], [], [], [], []
+            for s in conn.schema_names():
+                for t in conn.table_names(s):
+                    table_schema = get_schema(s, t)
+                    for i, f in enumerate(table_schema):
+                        schemas.append(s)
+                        tables.append(t)
+                        cols.append(f.name)
+                        types.append(str(f.dtype))
+                        positions.append(i + 1)
+            out = _strings_table("columns",
+                                 [("table_schema", schemas),
+                                  ("table_name", tables),
+                                  ("column_name", cols),
+                                  ("data_type", types)])
+            import numpy as np
+            from .batch import Field, Schema
+            from .types import BIGINT
+            return type(out)(
+                "columns",
+                Schema(out.schema.fields + (Field("ordinal_position",
+                                                  BIGINT),)),
+                out.columns + [np.asarray(positions, dtype=np.int64)])
+        raise KeyError(f"information_schema table {table!r} not found")
+
+
+def _strings_table(name: str, cols):
+    """Build a TableData of VARCHAR columns from python string lists."""
+    import numpy as np
+    from .batch import Field, Schema
+    from .connectors.tpch.datagen import TableData
+    from .types import VARCHAR
+    fields = []
+    arrays = []
+    for col_name, values in cols:
+        pool = sorted(set(values))
+        index = {s: i for i, s in enumerate(pool)}
+        fields.append(Field(col_name, VARCHAR, dictionary=tuple(pool)))
+        arrays.append(np.array([index[v] for v in values],
+                               dtype=np.int32))
+    return TableData(name, Schema(tuple(fields)), arrays)
 
 
 def default_catalog() -> Catalog:

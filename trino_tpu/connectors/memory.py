@@ -1,11 +1,15 @@
 """In-memory connector (reference: plugin/trino-memory, MemoryMetadata/
-MemoryPagesStore) — tables created programmatically or via INSERT, held as
-host numpy columns."""
+MemoryPagesStore) — tables created via CREATE TABLE / CTAS / INSERT or
+programmatically, held as host numpy columns."""
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
+from ..batch import Field, Schema
+from ..types import TypeKind
 from .tpch.datagen import TableData
 
 
@@ -16,13 +20,78 @@ class MemoryConnector:
         self._tables: Dict[Tuple[str, str], TableData] = {}
 
     def schema_names(self):
-        return sorted({s for (s, _) in self._tables})
+        return sorted({s for (s, _) in self._tables}) or ["default"]
 
     def table_names(self, schema: str):
         return sorted(t for (s, t) in self._tables if s == schema)
 
-    def create_table(self, schema: str, name: str, data: TableData) -> None:
-        self._tables[(schema, name)] = data
+    def create_table(self, schema: str, name: str, data: TableData,
+                     if_not_exists: bool = False) -> None:
+        key = (schema, name)
+        if key in self._tables:
+            if if_not_exists:
+                return
+            raise KeyError(f"table {schema}.{name} already exists")
+        self._tables[key] = data
+
+    def drop_table(self, schema: str, name: str,
+                   if_exists: bool = False) -> None:
+        key = (schema, name)
+        if key not in self._tables:
+            if if_exists:
+                return
+            raise KeyError(f"memory table {schema}.{name} not found")
+        del self._tables[key]
+
+    def insert(self, schema: str, name: str, arrays: List[np.ndarray],
+               valids: List[Optional[np.ndarray]],
+               fields: List[Field]) -> int:
+        """Append rows (ConnectorPageSink.appendPage's role). VARCHAR
+        columns arrive as codes + their pool in `fields`; they are remapped
+        into the stored table's pool, extending it with unseen strings."""
+        key = (schema, name)
+        if key not in self._tables:
+            raise KeyError(f"memory table {schema}.{name} not found")
+        t = self._tables[key]
+        if len(arrays) != len(t.schema.fields):
+            raise ValueError(
+                f"INSERT has {len(arrays)} columns, table has "
+                f"{len(t.schema.fields)}")
+        new_cols = []
+        new_fields = []
+        new_valids = []
+        for i, (tf, nf) in enumerate(zip(t.schema.fields, fields)):
+            old = np.asarray(t.columns[i])
+            add = np.asarray(arrays[i])
+            fld = tf
+            if tf.dtype.kind is TypeKind.VARCHAR:
+                pool = list(tf.dictionary or ())
+                index = {s: j for j, s in enumerate(pool)}
+                src_pool = nf.dictionary or ()
+                remap = np.zeros(max(len(src_pool), 1), dtype=np.int32)
+                for j, s in enumerate(src_pool):
+                    if s not in index:
+                        index[s] = len(pool)
+                        pool.append(s)
+                    remap[j] = index[s]
+                add = remap[add.astype(np.int32)]
+                fld = Field(tf.name, tf.dtype, dictionary=tuple(pool))
+            elif add.dtype != old.dtype:
+                add = add.astype(old.dtype)
+            new_cols.append(np.concatenate([old, add]))
+            new_fields.append(fld)
+            ov = None if t.valids is None else t.valids[i]
+            if ov is None:
+                ov = np.ones(len(old), dtype=np.bool_)
+            nv = valids[i]
+            if nv is None:
+                nv = np.ones(len(add), dtype=np.bool_)
+            new_valids.append(np.concatenate([np.asarray(ov),
+                                              np.asarray(nv)]))
+        self._tables[key] = TableData(
+            t.name, Schema(tuple(new_fields)), new_cols,
+            primary_key=(), valids=new_valids)
+        return len(arrays[0]) if arrays else 0
 
     def get_table(self, schema: str, table: str) -> TableData:
         key = (schema, table)

@@ -49,3 +49,7 @@ class TpcdsConnector:
         if scale not in self._cache:
             self._cache[scale] = generate(scale)
         return self._cache[scale][table]
+
+    def get_table_schema(self, schema: str, table: str):
+        """Scale-independent schema without data generation (see tpch)."""
+        return self.get_table("tiny", table).schema

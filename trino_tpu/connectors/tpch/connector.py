@@ -49,3 +49,9 @@ class TpchConnector:
         if scale not in self._cache:
             self._cache[scale] = generate(scale)
         return self._cache[scale][table]
+
+    def get_table_schema(self, schema: str, table: str):
+        """Schema without materializing data (information_schema must not
+        trigger SF1000 generation); scale-independent, so read from the
+        smallest scale."""
+        return self.get_table("tiny", table).schema

@@ -52,6 +52,8 @@ class Executor:
         self._scan_cache: Dict[Tuple[str, str, str, tuple], Batch] = {}
         self._scalar_cache: Dict[object, object] = {}
         self.stats = ExecStats()
+        self.profile = False           # EXPLAIN ANALYZE per-node timing
+        self.node_stats: Dict[int, tuple] = {}   # id(node) -> (wall_s, rows)
 
     # ------------------------------------------------------------------
 
@@ -60,6 +62,20 @@ class Executor:
         return self.run(root.child)
 
     def run(self, node: L.PlanNode) -> Batch:
+        if not self.profile:
+            return self.dispatch(node)
+        # EXPLAIN ANALYZE: per-operator wall time + output rows, the
+        # OperatorStats role (operator/OperatorStats.java:37). Blocking per
+        # node serializes XLA async dispatch, so profiled times include the
+        # node's own device work only.
+        import time
+        t0 = time.monotonic()
+        out = self.dispatch(node)
+        rows = int(jnp.sum(out.live))          # forces completion
+        self.node_stats[id(node)] = (time.monotonic() - t0, rows)
+        return out
+
+    def dispatch(self, node: L.PlanNode) -> Batch:
         if isinstance(node, L.ScanNode):
             return self.run_scan(node)
         if isinstance(node, L.FilterNode):

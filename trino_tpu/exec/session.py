@@ -40,6 +40,14 @@ class QueryResult:
         return len(self.rows)
 
 
+# session properties (SystemSessionProperties.java:61's role); each entry:
+# name -> (default, parser)
+SESSION_PROPERTY_DEFAULTS = {
+    "distributed": (False, lambda v: str(v).lower() in ("true", "1")),
+    "query_max_rows": (10_000_000, int),
+}
+
+
 class Session:
     def __init__(self, catalog: Optional[Catalog] = None,
                  default_cat: str = "tpch", default_schema: str = "tiny"):
@@ -47,38 +55,36 @@ class Session:
         self.default_cat = default_cat
         self.default_schema = default_schema
         self.executor = Executor(self.catalog)
+        self.properties = {k: v for k, (v, _) in
+                           SESSION_PROPERTY_DEFAULTS.items()}
 
     def planner(self) -> Planner:
         return Planner(self.catalog, self.default_cat, self.default_schema)
 
     def plan(self, sql: str):
         stmt = parse(sql)
-        if isinstance(stmt, A.Explain):
-            return stmt, None
-        assert isinstance(stmt, (A.Query, A.SetOp, A.Values, A.ShowTables))
-        if isinstance(stmt, A.ShowTables):
-            return stmt, None
-        rel = self.planner().plan_query(stmt)
-        return stmt, rel
+        if isinstance(stmt, (A.Query, A.SetOp, A.Values)):
+            return stmt, self.planner().plan_query(stmt)
+        return stmt, None
 
     def execute(self, sql: str) -> QueryResult:
         t0 = time.monotonic()
         stmt = parse(sql)
 
-        if isinstance(stmt, A.ShowTables):
-            cat = stmt.catalog or self.default_cat
-            sch = stmt.schema or self.default_schema
-            names = self.catalog.connector(cat).table_names(sch)
-            return QueryResult(["table"], [(n,) for n in names],
-                               time.monotonic() - t0)
-
+        if isinstance(stmt, (A.Query, A.SetOp, A.Values)):
+            return self.execute_query(stmt, t0)
         if isinstance(stmt, A.Explain):
-            rel = self.planner().plan_query(stmt.query)
-            text = explain_text(prune_plan(rel.node))
-            return QueryResult(["query plan"],
-                               [(line,) for line in text.split("\n")],
-                               time.monotonic() - t0)
+            return self.execute_explain(stmt, t0)
+        if isinstance(stmt, (A.ShowTables, A.ShowCatalogs, A.ShowSchemas,
+                             A.ShowSession, A.ShowColumns)):
+            return self.execute_show(stmt, t0)
+        if isinstance(stmt, A.SetSession):
+            return self.execute_set_session(stmt, t0)
+        if isinstance(stmt, (A.CreateTable, A.DropTable, A.InsertInto)):
+            return self.execute_ddl(stmt, t0)
+        raise NotImplementedError(type(stmt).__name__)
 
+    def execute_query(self, stmt, t0) -> QueryResult:
         rel = self.planner().plan_query(stmt)
         root = rel.node
         assert isinstance(root, OutputNode)
@@ -88,6 +94,147 @@ class Session:
         rows = self.decode_rows(rel, arrays, valids)
         return QueryResult(names, rows, time.monotonic() - t0,
                            self.executor.stats)
+
+    def execute_explain(self, stmt: A.Explain, t0) -> QueryResult:
+        rel = self.planner().plan_query(stmt.query)
+        root = prune_plan(rel.node)
+        annotate = None
+        if stmt.analyze:
+            saved = self.executor.profile
+            self.executor.profile = True
+            self.executor.node_stats = {}
+            try:
+                self.executor.execute(root)
+            finally:
+                self.executor.profile = saved
+            stats = self.executor.node_stats
+
+            def annotate(node):
+                s = stats.get(id(node))
+                if s is None:
+                    return ""
+                return f"[{s[0] * 1000:.2f}ms, {s[1]} rows]"
+        text = explain_text(root, annotate=annotate)
+        return QueryResult(["query plan"],
+                           [(line,) for line in text.split("\n")],
+                           time.monotonic() - t0)
+
+    def execute_show(self, stmt, t0) -> QueryResult:
+        el = time.monotonic() - t0
+        if isinstance(stmt, A.ShowTables):
+            cat = stmt.catalog or self.default_cat
+            sch = stmt.schema or self.default_schema
+            names = self.catalog.connector(cat).table_names(sch)
+            return QueryResult(["table"], [(n,) for n in names], el)
+        if isinstance(stmt, A.ShowCatalogs):
+            return QueryResult(
+                ["catalog"],
+                [(n,) for n in sorted(self.catalog._connectors)], el)
+        if isinstance(stmt, A.ShowSchemas):
+            cat = stmt.catalog or self.default_cat
+            names = self.catalog.connector(cat).schema_names()
+            return QueryResult(["schema"], [(n,) for n in names], el)
+        if isinstance(stmt, A.ShowSession):
+            rows = [(k, str(self.properties[k]),
+                     str(SESSION_PROPERTY_DEFAULTS[k][0]))
+                    for k in sorted(self.properties)]
+            return QueryResult(["name", "value", "default"], rows, el)
+        # SHOW COLUMNS / DESCRIBE
+        cat, sch, tbl = self.resolve_table(stmt.table)
+        data = self.catalog.get_table(cat, sch, tbl)
+        rows = [(f.name, str(f.dtype)) for f in data.schema]
+        return QueryResult(["column", "type"], rows, el)
+
+    def execute_set_session(self, stmt: A.SetSession, t0) -> QueryResult:
+        if stmt.name not in SESSION_PROPERTY_DEFAULTS:
+            raise KeyError(f"unknown session property {stmt.name!r}")
+        _, parser = SESSION_PROPERTY_DEFAULTS[stmt.name]
+        raw = getattr(stmt.value, "value", getattr(stmt.value, "text",
+                                                   None))
+        self.properties[stmt.name] = parser(raw)
+        if stmt.name == "distributed":
+            self.set_distributed(self.properties["distributed"])
+        return QueryResult(["result"], [("SET SESSION",)],
+                           time.monotonic() - t0)
+
+    def set_distributed(self, on: bool) -> None:
+        """Swap the executor (single-device vs mesh GSPMD)."""
+        if on:
+            from ..parallel.dist_executor import MeshExecutor
+            if not isinstance(self.executor, MeshExecutor):
+                self.executor = MeshExecutor(self.catalog)
+        elif type(self.executor) is not Executor:
+            self.executor = Executor(self.catalog)
+
+    def resolve_table(self, parts):
+        parts = tuple(p.lower() for p in parts)
+        if len(parts) == 3:
+            return parts
+        if len(parts) == 2:
+            return self.default_cat, parts[0], parts[1]
+        return self.default_cat, self.default_schema, parts[0]
+
+    def execute_ddl(self, stmt, t0) -> QueryResult:
+        from ..connectors.tpch.datagen import TableData
+        import numpy as np
+        from ..batch import Field, Schema
+        from ..planner.analyzer import parse_type
+
+        if isinstance(stmt, A.DropTable):
+            cat, sch, tbl = self.resolve_table(stmt.table)
+            self.catalog.connector(cat).drop_table(sch, tbl,
+                                                   stmt.if_exists)
+            self.executor = type(self.executor)(self.catalog)
+            return QueryResult(["result"], [("DROP TABLE",)],
+                               time.monotonic() - t0)
+
+        if isinstance(stmt, A.CreateTable):
+            cat, sch, tbl = self.resolve_table(stmt.table)
+            conn = self.catalog.connector(cat)
+            if stmt.query is not None:     # CTAS
+                fields, arrays, valids = self.query_to_columns(stmt.query)
+                data = TableData(tbl, Schema(tuple(fields)), arrays,
+                                 valids=valids)
+                conn.create_table(sch, tbl, data, stmt.if_not_exists)
+                n = data.num_rows
+                return QueryResult(["rows"], [(n,)],
+                                   time.monotonic() - t0)
+            fields = [Field(name, parse_type(tn))
+                      for name, tn in stmt.columns]
+            arrays = [np.zeros(0, dtype=f.dtype.np_dtype) for f in fields]
+            fields = [Field(f.name, f.dtype, dictionary=()
+                            if f.dtype.kind is TypeKind.VARCHAR else None)
+                      for f in fields]
+            conn.create_table(sch, tbl,
+                              TableData(tbl, Schema(tuple(fields)),
+                                        arrays),
+                              stmt.if_not_exists)
+            return QueryResult(["result"], [("CREATE TABLE",)],
+                               time.monotonic() - t0)
+
+        # INSERT INTO
+        cat, sch, tbl = self.resolve_table(stmt.table)
+        fields, arrays, valids = self.query_to_columns(stmt.query)
+        n = self.catalog.connector(cat).insert(sch, tbl, arrays, valids,
+                                               fields)
+        # stored table changed: refresh any cached scans
+        self.executor._scan_cache.clear()
+        return QueryResult(["rows"], [(n,)], time.monotonic() - t0)
+
+    def query_to_columns(self, query):
+        """Run a query and return (fields, host arrays, valids) — the
+        TableWriterOperator boundary (raw codes, not decoded strings)."""
+        rel = self.planner().plan_query(query)
+        root = prune_plan(rel.node)
+        batch = self.executor.execute(root)
+        names, arrays, valids = self.executor.result_to_host(root, batch)
+        fields = []
+        for sc, name in zip(rel.scope.columns, names):
+            fld = sc.field if sc.field is not None else Field(name,
+                                                              sc.dtype)
+            fields.append(Field(name, sc.dtype,
+                                dictionary=fld.dictionary))
+        return fields, list(arrays), list(valids)
 
     def decode_rows(self, rel, arrays, valids) -> List[tuple]:
         cols = []

@@ -182,8 +182,10 @@ def children(node: PlanNode):
     return ()
 
 
-def explain_text(node: PlanNode, indent: int = 0) -> str:
-    """EXPLAIN rendering (textual plan like Trino's PlanPrinter)."""
+def explain_text(node: PlanNode, indent: int = 0, annotate=None) -> str:
+    """EXPLAIN rendering (textual plan like Trino's PlanPrinter).
+    `annotate(node) -> str` appends per-node runtime stats
+    (EXPLAIN ANALYZE / ExplainAnalyzeOperator's role)."""
     pad = "  " * indent
     if isinstance(node, ScanNode):
         cols = ", ".join(n for n, _ in node.output)
@@ -216,5 +218,9 @@ def explain_text(node: PlanNode, indent: int = 0) -> str:
         line = f"{pad}Output[{', '.join(node.names)}]"
     else:
         line = f"{pad}{type(node).__name__}"
-    return "\n".join([line] + [explain_text(c, indent + 1)
+    if annotate is not None:
+        extra = annotate(node)
+        if extra:
+            line = f"{line}   {extra}"
+    return "\n".join([line] + [explain_text(c, indent + 1, annotate)
                                for c in children(node)])

@@ -130,6 +130,9 @@ class CoordinatorState:
         self.nodes: Dict[str, RegisteredNode] = {}
         self.nodes_lock = threading.Lock()
         self.started_at = time.time()
+        # system.runtime.{queries,nodes} backed by this coordinator's state
+        from .system_connector import SystemConnector
+        session.catalog.register("system", SystemConnector(self))
 
     def announce(self, node_id: str, uri: str) -> None:
         with self.nodes_lock:

@@ -1,0 +1,67 @@
+"""System connector: runtime tables queryable via SQL.
+
+Reference: the `system` catalog (connector/system/ in trino-main — 86
+files) exposing system.runtime.queries / .nodes backed by live engine
+state. Registered by the coordinator with its tracker + node inventory.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..batch import Field, Schema
+from ..catalog import _strings_table
+from ..connectors.tpch.datagen import TableData
+from ..types import BIGINT, DOUBLE
+
+
+class SystemConnector:
+    name = "system"
+
+    def __init__(self, coordinator_state=None):
+        self.state = coordinator_state
+
+    def schema_names(self):
+        return ["runtime"]
+
+    def table_names(self, schema: str):
+        if schema == "runtime":
+            return ["queries", "nodes"]
+        return []
+
+    def get_table(self, schema: str, table: str) -> TableData:
+        if schema != "runtime":
+            raise KeyError(f"system schema {schema!r} not found")
+        if table == "queries":
+            return self._queries_table()
+        if table == "nodes":
+            return self._nodes_table()
+        raise KeyError(f"system table {table!r} not found")
+
+    def _queries_table(self) -> TableData:
+        queries = self.state.tracker.all() if self.state else []
+        ids = [q.query_id for q in queries]
+        states = [q.state for q in queries]
+        users = [q.session_user for q in queries]
+        sqls = [q.sql[:200] for q in queries]
+        base = _strings_table("queries",
+                              [("query_id", ids), ("state", states),
+                               ("user", users), ("query", sqls)])
+        elapsed = np.array([q.elapsed_s for q in queries],
+                           dtype=np.float64)
+        rows = np.array([q.rows_returned for q in queries],
+                        dtype=np.int64)
+        return TableData(
+            "queries",
+            Schema(base.schema.fields +
+                   (Field("elapsed_seconds", DOUBLE),
+                    Field("rows", BIGINT))),
+            base.columns + [elapsed, rows])
+
+    def _nodes_table(self) -> TableData:
+        nodes = list(self.state.nodes.values()) if self.state else []
+        return _strings_table(
+            "nodes",
+            [("node_id", [n.node_id for n in nodes]),
+             ("http_uri", [n.uri for n in nodes]),
+             ("state", [n.state for n in nodes])])

@@ -251,3 +251,51 @@ class Explain(Node):
 class ShowTables(Node):
     catalog: Optional[str] = None
     schema: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class ShowCatalogs(Node):
+    pass
+
+
+@dataclass(frozen=True)
+class ShowSchemas(Node):
+    catalog: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class ShowSession(Node):
+    pass
+
+
+@dataclass(frozen=True)
+class ShowColumns(Node):
+    """SHOW COLUMNS FROM t / DESCRIBE t (tree/ShowColumns.java)."""
+    table: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class SetSession(Node):
+    name: str
+    value: Node                     # literal
+
+
+@dataclass(frozen=True)
+class CreateTable(Node):
+    """CREATE TABLE [AS query]; plain form takes (name, type) columns."""
+    table: Tuple[str, ...]
+    columns: Tuple = ()             # ((name, type_name), ...)
+    query: Optional[Node] = None    # CTAS
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
+class DropTable(Node):
+    table: Tuple[str, ...]
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class InsertInto(Node):
+    table: Tuple[str, ...]
+    query: Node                     # Query | Values

@@ -79,12 +79,57 @@ class Parser:
             node: A.Node = A.Explain(q, analyze)
         elif self.at_kw("SHOW"):
             node = self.parse_show()
+        elif self.accept_kw("DESCRIBE") or self.accept_kw("DESC"):
+            node = A.ShowColumns(tuple(self.qualified_name()))
+        elif self.accept_kw("SET"):
+            self.expect_kw("SESSION")
+            name = ".".join(self.qualified_name())
+            self.expect_op("=")
+            node = A.SetSession(name, self.parse_expr())
+        elif self.at_kw("CREATE"):
+            node = self.parse_create_table()
+        elif self.accept_kw("DROP"):
+            self.expect_kw("TABLE")
+            if_exists = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                if_exists = True
+            node = A.DropTable(tuple(self.qualified_name()), if_exists)
+        elif self.accept_kw("INSERT"):
+            self.expect_kw("INTO")
+            table = tuple(self.qualified_name())
+            node = A.InsertInto(table, self.parse_query())
         else:
             node = self.parse_query()
         self.accept_op(";")
         if self.peek().kind != "eof":
             self.fail(f"unexpected trailing input {self.peek().raw!r}")
         return node
+
+    def parse_create_table(self) -> A.Node:
+        self.expect_kw("CREATE")
+        self.expect_kw("TABLE")
+        if_not_exists = False
+        if self.accept_kw("IF"):
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            if_not_exists = True
+        table = tuple(self.qualified_name())
+        if self.accept_kw("AS"):
+            return A.CreateTable(table, (), self.parse_query(),
+                                 if_not_exists)
+        self.expect_op("(")
+        cols = []
+        while True:
+            name = self.advance()
+            if name.kind not in ("name", "qident"):
+                self.fail("expected column name")
+            cols.append((name.raw if name.kind == "qident"
+                         else name.text.lower(), self.parse_type_name()))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return A.CreateTable(table, tuple(cols), None, if_not_exists)
 
     def parse_show(self) -> A.Node:
         self.expect_kw("SHOW")
@@ -97,6 +142,18 @@ class Parser:
                 else:
                     schema = parts[0]
             return A.ShowTables(catalog, schema)
+        if self.accept_kw("CATALOGS"):
+            return A.ShowCatalogs()
+        if self.accept_kw("SCHEMAS"):
+            catalog = None
+            if self.accept_kw("FROM") or self.accept_kw("IN"):
+                catalog = self.qualified_name()[0]
+            return A.ShowSchemas(catalog)
+        if self.accept_kw("SESSION"):
+            return A.ShowSession()
+        if self.accept_kw("COLUMNS"):
+            self.expect_kw("FROM")
+            return A.ShowColumns(tuple(self.qualified_name()))
         self.fail("unsupported SHOW statement")
 
     def parse_query(self) -> A.Node:
