@@ -122,3 +122,17 @@ def test_avg_decimal_finalize_half_up():
     # 10/4=2.5 -> 3; 11/2=5.5 -> 6; -11/2=-5.5 -> -6; 7/2=3.5 -> 4
     np.testing.assert_array_equal(avg_decimal_finalize(sums, counts),
                                   [3, 6, -6, 4])
+
+
+def test_dynamic_filter_compaction():
+    """Build-side key range prunes + compacts the probe (DynamicFilterService
+    role, executor edition)."""
+    from trino_tpu.exec.session import Session
+    s = Session(default_cat="memory", default_schema="default")
+    s.execute("CREATE TABLE big AS SELECT o_orderkey k, o_totalprice v "
+              "FROM tpch.tiny.orders")
+    s.execute("CREATE TABLE dim (k bigint, name varchar)")
+    s.execute("INSERT INTO dim VALUES (97, 'a'), (101, 'b'), (103, 'c')")
+    r = s.execute("SELECT count(*) FROM big, dim WHERE big.k = dim.k")
+    assert r.rows[0][0] == 3
+    assert s.executor.stats.dynamic_filter_compactions >= 1

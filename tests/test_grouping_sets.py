@@ -1,0 +1,104 @@
+"""ROLLUP / CUBE / GROUPING SETS tests.
+
+sqlite has no ROLLUP, so the oracle runs the hand-expanded UNION ALL
+equivalent (the same lowering Trino's GroupIdOperator performs).
+"""
+
+import pytest
+
+from oracle import assert_rows_match, load_oracle, oracle_query
+from trino_tpu.exec.session import Session
+
+TPCH_TABLES = ["region", "nation", "supplier", "customer", "part",
+               "partsupp", "orders", "lineitem"]
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session(default_schema="tiny")
+
+
+@pytest.fixture(scope="module")
+def oracle(session):
+    conn = session.catalog.connector("tpch")
+    return load_oracle([conn.get_table("tiny", t) for t in TPCH_TABLES])
+
+
+def check(session, oracle, engine_sql, oracle_sql, abs_tol=0.01):
+    got = session.execute(engine_sql).rows
+    want = oracle_query(oracle, oracle_sql)
+    assert_rows_match(got, want, rel_tol=1e-9, abs_tol=abs_tol)
+
+
+def test_rollup(session, oracle):
+    check(session, oracle, """
+        SELECT n_regionkey, n_nationkey, count(*) c
+        FROM nation GROUP BY ROLLUP (n_regionkey, n_nationkey)
+        ORDER BY n_regionkey NULLS FIRST, n_nationkey NULLS FIRST""", """
+        SELECT n_regionkey, n_nationkey, count(*) c FROM nation
+          GROUP BY n_regionkey, n_nationkey
+        UNION ALL
+        SELECT n_regionkey, NULL, count(*) FROM nation
+          GROUP BY n_regionkey
+        UNION ALL
+        SELECT NULL, NULL, count(*) FROM nation
+        ORDER BY n_regionkey, n_nationkey""")
+
+
+def test_cube(session, oracle):
+    check(session, oracle, """
+        SELECT o_orderstatus, o_orderpriority, sum(o_totalprice) s
+        FROM orders GROUP BY CUBE (o_orderstatus, o_orderpriority)
+        ORDER BY o_orderstatus NULLS FIRST,
+                 o_orderpriority NULLS FIRST""", """
+        SELECT o_orderstatus, o_orderpriority, sum(o_totalprice) s
+          FROM orders GROUP BY o_orderstatus, o_orderpriority
+        UNION ALL
+        SELECT o_orderstatus, NULL, sum(o_totalprice) FROM orders
+          GROUP BY o_orderstatus
+        UNION ALL
+        SELECT NULL, o_orderpriority, sum(o_totalprice) FROM orders
+          GROUP BY o_orderpriority
+        UNION ALL
+        SELECT NULL, NULL, sum(o_totalprice) FROM orders
+        ORDER BY o_orderstatus, o_orderpriority""")
+
+
+def test_grouping_sets_explicit(session, oracle):
+    check(session, oracle, """
+        SELECT o_orderstatus, o_orderpriority, count(*) c
+        FROM orders
+        GROUP BY GROUPING SETS ((o_orderstatus), (o_orderpriority), ())
+        ORDER BY o_orderstatus NULLS FIRST,
+                 o_orderpriority NULLS FIRST""", """
+        SELECT o_orderstatus, NULL, count(*) c FROM orders
+          GROUP BY o_orderstatus
+        UNION ALL
+        SELECT NULL, o_orderpriority, count(*) FROM orders
+          GROUP BY o_orderpriority
+        UNION ALL
+        SELECT NULL, NULL, count(*) FROM orders
+        ORDER BY 1, 2""")
+
+
+def test_rollup_with_having(session, oracle):
+    check(session, oracle, """
+        SELECT o_orderstatus, count(*) c
+        FROM orders GROUP BY ROLLUP (o_orderstatus)
+        HAVING count(*) > 100
+        ORDER BY o_orderstatus NULLS FIRST""", """
+        SELECT * FROM (
+          SELECT o_orderstatus, count(*) c FROM orders
+            GROUP BY o_orderstatus
+          UNION ALL
+          SELECT NULL, count(*) FROM orders)
+        WHERE c > 100 ORDER BY o_orderstatus""")
+
+
+def test_rollup_varchar_key_decode(session):
+    rows = session.execute("""
+        SELECT n_name, count(*) FROM nation
+        GROUP BY ROLLUP (n_name)
+        ORDER BY n_name NULLS FIRST LIMIT 3""").rows
+    assert rows[0] == (None, 25)
+    assert rows[1][1] == 1

@@ -44,6 +44,7 @@ class ExecStats:
     join_fallbacks: int = 0
     join_expansion_retries: int = 0
     agg_capacity_retries: int = 0
+    dynamic_filter_compactions: int = 0
 
 
 class Executor:
@@ -289,6 +290,7 @@ class Executor:
         probe = self.run(node.left)
         build = self.run(node.right)
         self.validate_key_ranges(build, node.right_keys)
+        probe = self.apply_dynamic_filter(node, probe, build)
         if node.kind in ("semi", "anti"):
             return self.run_membership_join(node, probe, build)
         if node.build_unique:
@@ -307,6 +309,39 @@ class Executor:
                 return out
             cap = pad_capacity(total)     # exact requirement, one retry
             self.stats.join_expansion_retries += 1
+
+    def apply_dynamic_filter(self, node: L.JoinNode, probe: Batch,
+                             build: Batch) -> Batch:
+        """Dynamic filtering (server/DynamicFilterService.java:103 +
+        operator/DynamicFilterSourceOperator): the build side's key range
+        prunes probe rows before the join. TPU adaptation: the filter is a
+        live-mask AND (free), and when it kills most of the probe the
+        batch is compacted to a smaller capacity so every downstream
+        kernel (sort/join/agg) runs at the reduced size — the analog of
+        Trino skipping probe splits entirely.
+
+        Skipped for anti joins (they keep non-matching rows) and left
+        joins (outer rows survive)."""
+        if node.kind in ("anti", "left") or node.null_aware:
+            return probe
+        for pk_i, bk_i in zip(node.left_keys, node.right_keys):
+            bk = build.columns[bk_i]
+            m = build.live & bk.valid
+            info = jnp.iinfo(bk.data.dtype) if \
+                jnp.issubdtype(bk.data.dtype, jnp.integer) else None
+            if info is None:
+                continue
+            kmin = jnp.min(jnp.where(m, bk.data, info.max))
+            kmax = jnp.max(jnp.where(m, bk.data, info.min))
+            pk = probe.columns[pk_i]
+            keep = pk.valid & (pk.data >= kmin) & (pk.data <= kmax)
+            probe = probe.with_live(probe.live & keep)
+        live = int(jnp.sum(probe.live))
+        new_cap = pad_capacity(live)
+        if new_cap * 4 <= probe.capacity:
+            self.stats.dynamic_filter_compactions += 1
+            probe = compact_batch(probe, new_cap)
+        return probe
 
     def run_membership_join(self, node: L.JoinNode, probe: Batch,
                             build: Batch) -> Batch:
@@ -376,6 +411,20 @@ def remap_codes(batch: Batch, remaps) -> Batch:
             lut = jnp.asarray(np.asarray(rm, dtype=np.int32))
             cols.append(Column(jnp.take(lut, col.data, axis=0), col.valid))
     return Batch(tuple(cols), batch.live)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def compact_batch(batch: Batch, new_capacity: int) -> Batch:
+    """Gather live rows (in order) into a smaller-capacity batch — the
+    two-pass mask-then-gather compaction (SURVEY.md §7 hard part 1).
+    Caller guarantees new_capacity >= live count."""
+    n = batch.capacity
+    order = jax.lax.sort(((~batch.live).astype(jnp.int8),
+                          jnp.arange(n, dtype=jnp.int32)),
+                         num_keys=1)[1][:new_capacity]
+    cols = tuple(Column(c.data[order], c.valid[order])
+                 for c in batch.columns)
+    return Batch(cols, batch.live[order])
 
 
 @jax.jit

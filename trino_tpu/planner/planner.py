@@ -999,15 +999,20 @@ class Planner:
         pre_node = L.ProjectNode(rel.node, tuple(pre_exprs),
                                  tuple(pre_cols))
 
-        # aggregation strategy
-        strategy, domains, capacity = self.agg_strategy(
-            group_irs, scope, pre_node, any_distinct=bool(distinct_args))
         agg_out = tuple(
             [(f"gk{i}", e.dtype) for i, e in enumerate(group_irs)] +
             [(s.out_name, s.out_dtype) for s in agg_specs])
-        agg_node = L.AggregateNode(
-            pre_node, tuple(range(n_keys)), tuple(agg_specs),
-            strategy, domains, capacity, agg_out)
+        if q.grouping_sets:
+            agg_node = self.plan_grouping_sets(
+                q.grouping_sets, pre_node, group_irs, agg_specs, scope,
+                agg_out, bool(distinct_args))
+        else:
+            strategy, domains, capacity = self.agg_strategy(
+                group_irs, scope, pre_node,
+                any_distinct=bool(distinct_args))
+            agg_node = L.AggregateNode(
+                pre_node, tuple(range(n_keys)), tuple(agg_specs),
+                strategy, domains, capacity, agg_out)
 
         # post-projection scope: group keys (referencing original key ASTs)
         # then aggregate slots
@@ -1118,6 +1123,43 @@ class Planner:
                                   tuple(out_cols))
         return (PlannedRelation(post_node, Scope(final_scope)),
                 post_exprs, names)
+
+    def plan_grouping_sets(self, sets, pre_node, group_irs, agg_specs,
+                           scope, agg_out, any_distinct) -> L.PlanNode:
+        """ROLLUP/CUBE/GROUPING SETS: one aggregation per set over the
+        shared pre-projection, aligned to the full key layout with NULL
+        padding, concatenated with UNION ALL (the role of Trino's
+        GroupIdOperator + single pass, expressed set-at-a-time — each
+        branch still runs as one fused device program)."""
+        n_keys = len(group_irs)
+        branches = []
+        for set_idxs in sets:
+            set_idxs = tuple(set_idxs)
+            sub_irs = [group_irs[i] for i in set_idxs]
+            strategy, domains, capacity = self.agg_strategy(
+                sub_irs, scope, pre_node, any_distinct=any_distinct)
+            sub_out = tuple(
+                [(f"gk{i}", group_irs[i].dtype) for i in set_idxs] +
+                [(s.out_name, s.out_dtype) for s in agg_specs])
+            node = L.AggregateNode(pre_node, set_idxs, tuple(agg_specs),
+                                   strategy, domains, capacity, sub_out)
+            # align to the full (gk0..gkN, aggs) layout with NULL keys
+            pos = {k: j for j, k in enumerate(set_idxs)}
+            exprs = []
+            for i, g in enumerate(group_irs):
+                if i in pos:
+                    exprs.append(ir.ColumnRef(pos[i], g.dtype))
+                else:
+                    exprs.append(ir.Literal(None, g.dtype))
+            for j, s in enumerate(agg_specs):
+                exprs.append(ir.ColumnRef(len(set_idxs) + j, s.out_dtype))
+            branches.append(L.ProjectNode(node, tuple(exprs), agg_out))
+        current = branches[0]
+        none_maps = (None,) * len(agg_out)
+        for b in branches[1:]:
+            current = L.SetOpNode("union_all", current, b, none_maps,
+                                  none_maps, agg_out)
+        return current
 
     def agg_strategy(self, group_irs, scope: Scope, pre_node,
                      any_distinct: bool = False):

@@ -211,6 +211,9 @@ class Query(Node):
     order_by: Tuple[OrderItem, ...]
     limit: Optional[int]
     ctes: Tuple = ()                # WITH name AS (query), ...
+    grouping_sets: Tuple = ()       # sets of indexes into group_by
+                                    # (ROLLUP/CUBE/GROUPING SETS); empty =
+                                    # single implicit full set
 
 
 @dataclass(frozen=True)

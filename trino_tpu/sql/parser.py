@@ -274,17 +274,71 @@ class Parser:
         where = self.parse_expr() if self.accept_kw("WHERE") else None
 
         group_by: Tuple[A.Node, ...] = ()
+        grouping_sets: Tuple = ()
         if self.accept_kw("GROUP"):
             self.expect_kw("BY")
-            items = [self.parse_expr()]
-            while self.accept_op(","):
-                items.append(self.parse_expr())
-            group_by = tuple(items)
+            group_by, grouping_sets = self.parse_group_by()
 
         having = self.parse_expr() if self.accept_kw("HAVING") else None
 
         return A.Query(tuple(select), distinct, relation, where, group_by,
-                       having, (), None, ())
+                       having, (), None, (), grouping_sets)
+
+    def parse_group_by(self):
+        """GROUP BY exprs | ROLLUP(..) | CUBE(..) | GROUPING SETS((..),..).
+        Returns (distinct exprs, sets of indexes into them); plain GROUP BY
+        yields no sets (single implicit full set)."""
+        if self.accept_kw("ROLLUP"):
+            self.expect_op("(")
+            items = [self.parse_expr()]
+            while self.accept_op(","):
+                items.append(self.parse_expr())
+            self.expect_op(")")
+            sets = tuple(tuple(range(k))
+                         for k in range(len(items), -1, -1))
+            return tuple(items), sets
+        if self.accept_kw("CUBE"):
+            self.expect_op("(")
+            items = [self.parse_expr()]
+            while self.accept_op(","):
+                items.append(self.parse_expr())
+            self.expect_op(")")
+            n = len(items)
+            sets = tuple(tuple(i for i in range(n) if mask & (1 << i))
+                         for mask in range((1 << n) - 1, -1, -1))
+            return tuple(items), sets
+        if self.accept_kw("GROUPING"):
+            self.expect_kw("SETS")
+            self.expect_op("(")
+            raw_sets = []
+            items: list = []
+
+            def parse_one_set():
+                exprs = []
+                if self.accept_op("("):
+                    if not self.at_op(")"):
+                        exprs.append(self.parse_expr())
+                        while self.accept_op(","):
+                            exprs.append(self.parse_expr())
+                    self.expect_op(")")
+                else:
+                    exprs.append(self.parse_expr())
+                idxs = []
+                for e in exprs:
+                    if e not in items:
+                        items.append(e)
+                    idxs.append(items.index(e))
+                raw_sets.append(tuple(idxs))
+
+            parse_one_set()
+            while self.accept_op(","):
+                parse_one_set()
+            self.expect_op(")")
+            return tuple(items), tuple(raw_sets)
+        items = [self.parse_expr()]
+        while self.accept_op(","):
+            items.append(self.parse_expr())
+        return tuple(items), ()
 
     # ---- select items / order items --------------------------------------
 
