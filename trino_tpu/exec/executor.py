@@ -45,6 +45,7 @@ class ExecStats:
     join_expansion_retries: int = 0
     agg_capacity_retries: int = 0
     dynamic_filter_compactions: int = 0
+    agg_spill_chunks: int = 0
 
 
 class Executor:
@@ -55,25 +56,45 @@ class Executor:
         self.stats = ExecStats()
         self.profile = False           # EXPLAIN ANALYZE per-node timing
         self.node_stats: Dict[int, tuple] = {}   # id(node) -> (wall_s, rows)
+        from .memory import MemoryPool
+        self.pool = MemoryPool(64 << 30)         # query memory limit
+        self._node_bytes: Dict[int, int] = {}
+        # bounded-memory aggregation: process scan chains in chunks of this
+        # many rows (the spill-to-host analog; None = off)
+        self.spill_chunk_rows: Optional[int] = None
 
     # ------------------------------------------------------------------
 
     def execute(self, root: L.OutputNode) -> Batch:
         assert isinstance(root, L.OutputNode)
+        # release reservations surviving from the previous query (the root
+        # batch lives until its results are drained)
+        for b in self._node_bytes.values():
+            self.pool.free(b)
+        self._node_bytes.clear()
         return self.run(root.child)
 
     def run(self, node: L.PlanNode) -> Batch:
-        if not self.profile:
-            return self.dispatch(node)
-        # EXPLAIN ANALYZE: per-operator wall time + output rows, the
-        # OperatorStats role (operator/OperatorStats.java:37). Blocking per
-        # node serializes XLA async dispatch, so profiled times include the
-        # node's own device work only.
-        import time
-        t0 = time.monotonic()
-        out = self.dispatch(node)
-        rows = int(jnp.sum(out.live))          # forces completion
-        self.node_stats[id(node)] = (time.monotonic() - t0, rows)
+        if self.profile:
+            import time
+            t0 = time.monotonic()
+            out = self.dispatch(node)
+            # blocking per node serializes XLA async dispatch, so profiled
+            # times cover the node's own device work (OperatorStats role,
+            # operator/OperatorStats.java:37)
+            rows = int(jnp.sum(out.live))
+            self.node_stats[id(node)] = (time.monotonic() - t0, rows)
+        else:
+            out = self.dispatch(node)
+        # memory accounting: reserve this node's output, release the
+        # children's (their batches die once the parent has consumed them)
+        # — the operator->query context pyramid collapsed to plan nodes
+        from .memory import batch_bytes
+        b = batch_bytes(out)
+        self.pool.reserve(b)
+        self._node_bytes[id(node)] = b
+        for c in L.children(node):
+            self.pool.free(self._node_bytes.pop(id(c), 0))
         return out
 
     def dispatch(self, node: L.PlanNode) -> Batch:
@@ -226,12 +247,87 @@ class Executor:
         return window_compute(child, node.partition_by, keys, specs)
 
     def run_aggregate(self, node: L.AggregateNode) -> Batch:
-        child = self.run(node.child)
         aggs = tuple(AggSpec(
             a.func,
             a.arg.index if a.arg is not None else None,
             a.distinct)
             for a in node.aggs)
+        if self.spill_chunk_rows:
+            out = self.try_chunked_aggregate(node, aggs)
+            if out is not None:
+                return out
+        child = self.run(node.child)
+        return self.aggregate_batch(node, child, aggs)
+
+    # ---- bounded-memory (chunked) aggregation ------------------------
+
+    MERGE_FUNC = {"sum": "sum", "count": "sum", "count_star": "sum",
+                  "min": "min", "max": "max"}
+
+    def linear_chain(self, node: L.PlanNode):
+        """[outermost .. ScanNode] if the subtree is a Filter/Project
+        chain over a scan, else None."""
+        chain = []
+        while isinstance(node, (L.FilterNode, L.ProjectNode)):
+            chain.append(node)
+            node = node.child
+        if isinstance(node, L.ScanNode):
+            chain.append(node)
+            return chain
+        return None
+
+    def try_chunked_aggregate(self, node: L.AggregateNode, aggs):
+        """Bounded-memory aggregation: stream the scan in chunks, keep
+        only partial aggregate states, merge at the end — the role of
+        SpillableHashAggregationBuilder + MergingHashAggregationBuilder
+        (operator/aggregation/builder/), with host RAM as the spill tier
+        and partial states as the only device-resident state."""
+        if any(a.distinct for a in aggs):
+            return None                 # distinct needs global dedup
+        chain = self.linear_chain(node.child)
+        if chain is None:
+            return None
+        scan = chain[-1]
+        data = self.catalog.get_table(scan.catalog, scan.schema_name,
+                                      scan.table)
+        chunk = self.spill_chunk_rows
+        if data.num_rows <= chunk:
+            return None
+        partials: List[Batch] = []
+        for start in range(0, data.num_rows, chunk):
+            arrays = [np.asarray(data.columns[i])[start:start + chunk]
+                      for i in scan.column_indices]
+            valids = None
+            if data.valids is not None:
+                valids = [None if data.valids[i] is None else
+                          np.asarray(data.valids[i])[start:start + chunk]
+                          for i in scan.column_indices]
+            batch = batch_from_numpy(arrays, valids=valids)
+            for nd in reversed(chain[:-1]):
+                if isinstance(nd, L.FilterNode):
+                    batch = apply_filter(
+                        batch, self.fold_scalars(nd.predicate))
+                else:
+                    batch = filter_project(
+                        batch, None, self.fold_scalars_tuple(nd.exprs))
+            partials.append(self.aggregate_batch(node, batch, aggs))
+            self.stats.agg_spill_chunks += 1
+        merged = partials[0]
+        for p in partials[1:]:
+            merged = concat_batches(merged, p)
+        n_keys = len(node.group_keys)
+        merge_aggs = tuple(
+            AggSpec(self.MERGE_FUNC[a.func], n_keys + j)
+            for j, a in enumerate(aggs))
+        if node.strategy == "global":
+            return global_aggregate(merged, merge_aggs)
+        capacity = max(node.out_capacity, pad_capacity(
+            int(np.asarray(merged.live).sum())))
+        return sort_group_aggregate(merged, tuple(range(n_keys)),
+                                    merge_aggs, capacity)
+
+    def aggregate_batch(self, node: L.AggregateNode, child: Batch, aggs):
+        """One partial aggregation (the PARTIAL step)."""
         if node.strategy == "global":
             return global_aggregate(child, aggs)
         if node.strategy == "direct":
@@ -244,7 +340,7 @@ class Executor:
             n_groups = int(out.live.sum())
             if n_groups < capacity or capacity >= child.capacity:
                 return out
-            capacity *= 4    # table filled: grow and retry (rehash analog)
+            capacity *= 4
             self.stats.agg_capacity_retries += 1
 
     # ---- uncorrelated scalar subqueries (fold to constants) ----------

@@ -1,0 +1,75 @@
+"""Memory accounting: pool + hierarchical contexts.
+
+Reference: lib/trino-memory-context (AggregatedMemoryContext.java:16,
+LocalMemoryContext.java:18) + MemoryPool.java:44 — operators reserve
+against a per-query pool; exceeding the limit kills the query (or triggers
+revocation/spill). TPU edition: reservations track device HBM batch bytes;
+the revocation analog is the executor's chunked aggregation (bounded-memory
+scan processing) rather than disk spill — host RAM plays the disk's role.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class ExceededMemoryLimitError(RuntimeError):
+    def __init__(self, pool: str, requested: int, limit: int):
+        super().__init__(
+            f"Query exceeded per-query memory limit of {limit} bytes "
+            f"in pool {pool} (requested {requested})")
+
+
+class MemoryPool:
+    """Byte budget shared by a query's operators (memory/MemoryPool.java:44
+    reserve:127)."""
+
+    def __init__(self, limit_bytes: int, name: str = "general"):
+        self.limit = limit_bytes
+        self.name = name
+        self.reserved = 0
+        self.peak = 0
+        self._lock = threading.Lock()
+
+    def reserve(self, bytes_: int) -> None:
+        with self._lock:
+            if self.reserved + bytes_ > self.limit:
+                raise ExceededMemoryLimitError(self.name,
+                                               self.reserved + bytes_,
+                                               self.limit)
+            self.reserved += bytes_
+            self.peak = max(self.peak, self.reserved)
+
+    def free(self, bytes_: int) -> None:
+        with self._lock:
+            self.reserved = max(0, self.reserved - bytes_)
+
+
+class MemoryContext:
+    """One operator/node's reservation against the pool
+    (LocalMemoryContext.setBytes semantics: delta-adjusted)."""
+
+    def __init__(self, pool: MemoryPool, name: str):
+        self.pool = pool
+        self.name = name
+        self.bytes = 0
+
+    def set_bytes(self, new_bytes: int) -> None:
+        delta = new_bytes - self.bytes
+        if delta > 0:
+            self.pool.reserve(delta)
+        elif delta < 0:
+            self.pool.free(-delta)
+        self.bytes = new_bytes
+
+    def close(self) -> None:
+        self.set_bytes(0)
+
+
+def batch_bytes(batch) -> int:
+    """Device bytes of a Batch (data + validity + live mask)."""
+    total = batch.live.size  # bool mask
+    for col in batch.columns:
+        total += col.data.size * col.data.dtype.itemsize + col.valid.size
+    return int(total)

@@ -45,6 +45,10 @@ class QueryResult:
 SESSION_PROPERTY_DEFAULTS = {
     "distributed": (False, lambda v: str(v).lower() in ("true", "1")),
     "query_max_rows": (10_000_000, int),
+    # per-query memory limit (memory/MemoryPool reserve path)
+    "query_max_memory_mb": (64 << 10, int),
+    # bounded-memory aggregation chunk size, 0 = off (spill analog)
+    "spill_chunk_rows": (0, int),
 }
 
 
@@ -154,6 +158,13 @@ class Session:
         self.properties[stmt.name] = parser(raw)
         if stmt.name == "distributed":
             self.set_distributed(self.properties["distributed"])
+        elif stmt.name == "query_max_memory_mb":
+            from .memory import MemoryPool
+            self.executor.pool = MemoryPool(
+                self.properties[stmt.name] << 20)
+        elif stmt.name == "spill_chunk_rows":
+            self.executor.spill_chunk_rows = \
+                self.properties[stmt.name] or None
         return QueryResult(["result"], [("SET SESSION",)],
                            time.monotonic() - t0)
 
