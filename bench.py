@@ -100,6 +100,9 @@ def main():
             AggSpec("sum", 5), AggSpec("sum", 6),
             AggSpec("count_star", None))
 
+    # XLA masked-reduction path: measured faster than the Pallas MXU
+    # kernel at this shape (see ops/pallas_agg.py docstring) because the
+    # whole filter+project+aggregate stage fuses into one HBM pass
     @jax.jit
     def q1_step(b):
         filtered = apply_filter(b, flt)

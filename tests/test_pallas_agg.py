@@ -1,0 +1,73 @@
+"""Pallas MXU aggregation kernel tests (interpret mode on the CPU mesh;
+the real-TPU path is exercised by bench.py and the driver's entry())."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trino_tpu.batch import batch_from_numpy
+from trino_tpu.ops.aggregate import AggSpec, direct_group_aggregate
+from trino_tpu.ops.pallas_agg import (direct_group_aggregate_mxu, supports)
+
+
+def make_batch(n, rng, null_frac=0.1):
+    group = rng.integers(0, 3, n).astype(np.int32)
+    flag = rng.integers(0, 2, n).astype(np.int32)
+    v1 = rng.integers(-2**44, 2**44, n).astype(np.int64)
+    v2 = rng.integers(0, 10_000, n).astype(np.int64)
+    valids = [None, None, rng.random(n) >= null_frac, None]
+    return batch_from_numpy([group, flag, v1, v2], valids=valids)
+
+
+AGGS = (AggSpec("sum", 2), AggSpec("count", 2), AggSpec("sum", 3),
+        AggSpec("count_star", None))
+
+
+def test_supports():
+    assert supports(AGGS, (3, 2))
+    assert not supports((AggSpec("min", 2),), (3, 2))
+    assert not supports(AGGS, (64, 2))     # beyond MAX_GROUPS
+
+
+def test_matches_xla_path():
+    rng = np.random.default_rng(7)
+    batch = make_batch(5000, rng)
+    want = direct_group_aggregate(batch, (0, 1), (3, 2), AGGS)
+    got = direct_group_aggregate_mxu(batch, (0, 1), (3, 2), AGGS,
+                                     interpret=True)
+    assert np.array_equal(np.asarray(want.live), np.asarray(got.live))
+    for cw, cg in zip(want.columns, got.columns):
+        live = np.asarray(want.live)
+        assert np.array_equal(np.asarray(cw.valid)[live],
+                              np.asarray(cg.valid)[live])
+        keep = np.asarray(cw.valid) & live
+        assert np.array_equal(np.asarray(cw.data)[keep],
+                              np.asarray(cg.data)[keep])
+
+
+def test_dead_rows_and_null_keys_excluded():
+    rng = np.random.default_rng(3)
+    batch = make_batch(2000, rng)
+    # kill half the rows; NULL some keys
+    live = np.asarray(batch.live).copy()
+    live[::2] = False
+    batch = batch.with_live(jnp.asarray(live))
+    want = direct_group_aggregate(batch, (0,), (3,), AGGS)
+    got = direct_group_aggregate_mxu(batch, (0,), (3,), AGGS,
+                                     interpret=True)
+    live_mask = np.asarray(want.live)
+    for cw, cg in zip(want.columns, got.columns):
+        keep = np.asarray(cw.valid) & live_mask
+        assert np.array_equal(np.asarray(cw.data)[keep],
+                              np.asarray(cg.data)[keep])
+
+
+def test_negative_sums_exact():
+    rng = np.random.default_rng(11)
+    n = 4096 * 8
+    group = np.zeros(n, dtype=np.int32)
+    vals = np.full(n, -(2**44) + 17, dtype=np.int64)
+    batch = batch_from_numpy([group, vals])
+    got = direct_group_aggregate_mxu(
+        batch, (0,), (1,), (AggSpec("sum", 1),), interpret=True)
+    assert int(np.asarray(got.columns[1].data)[0]) == int(vals.sum())

@@ -46,6 +46,7 @@ class ExecStats:
     agg_capacity_retries: int = 0
     dynamic_filter_compactions: int = 0
     agg_spill_chunks: int = 0
+    mxu_agg_calls: int = 0
 
 
 class Executor:
@@ -62,6 +63,7 @@ class Executor:
         # bounded-memory aggregation: process scan chains in chunks of this
         # many rows (the spill-to-host analog; None = off)
         self.spill_chunk_rows: Optional[int] = None
+        self.enable_mxu_agg = False    # Pallas MXU aggregation (opt-in)
 
     # ------------------------------------------------------------------
 
@@ -259,6 +261,25 @@ class Executor:
         child = self.run(node.child)
         return self.aggregate_batch(node, child, aggs)
 
+    def use_mxu_agg(self, child: Batch, aggs, domains) -> bool:
+        """Pallas MXU aggregation: TPU backend, sum/count aggregates over
+        integer columns, small dense group domain (ops/pallas_agg.py).
+        Opt-in (`SET SESSION mxu_agg = true`) — see the measured trade-off
+        in the kernel docstring."""
+        if not self.enable_mxu_agg:
+            return False
+        import jax as _jax
+        if _jax.default_backend() != "tpu":
+            return False
+        from ..ops.pallas_agg import supports
+        if not supports(aggs, domains):
+            return False
+        for a in aggs:
+            if a.arg_index is not None and not jnp.issubdtype(
+                    child.columns[a.arg_index].data.dtype, jnp.integer):
+                return False
+        return True
+
     # ---- bounded-memory (chunked) aggregation ------------------------
 
     MERGE_FUNC = {"sum": "sum", "count": "sum", "count_star": "sum",
@@ -331,6 +352,11 @@ class Executor:
         if node.strategy == "global":
             return global_aggregate(child, aggs)
         if node.strategy == "direct":
+            if self.use_mxu_agg(child, aggs, node.key_domains):
+                from ..ops.pallas_agg import direct_group_aggregate_mxu
+                self.stats.mxu_agg_calls += 1
+                return direct_group_aggregate_mxu(
+                    child, node.group_keys, node.key_domains, aggs)
             return direct_group_aggregate(child, node.group_keys,
                                           node.key_domains, aggs)
         capacity = node.out_capacity

@@ -49,6 +49,8 @@ SESSION_PROPERTY_DEFAULTS = {
     "query_max_memory_mb": (64 << 10, int),
     # bounded-memory aggregation chunk size, 0 = off (spill analog)
     "spill_chunk_rows": (0, int),
+    # Pallas MXU one-pass aggregation kernel (ops/pallas_agg.py)
+    "mxu_agg": (False, lambda v: str(v).lower() in ("true", "1")),
 }
 
 
@@ -165,6 +167,8 @@ class Session:
         elif stmt.name == "spill_chunk_rows":
             self.executor.spill_chunk_rows = \
                 self.properties[stmt.name] or None
+        elif stmt.name == "mxu_agg":
+            self.executor.enable_mxu_agg = self.properties[stmt.name]
         return QueryResult(["result"], [("SET SESSION",)],
                            time.monotonic() - t0)
 
