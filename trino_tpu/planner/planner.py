@@ -320,15 +320,18 @@ class Planner:
                 sub = self.plan_query(node.query)
                 relations.append(self.wrap_subplan(sub, node.alias.lower()))
             elif isinstance(node, A.Join):
-                if node.kind not in ("inner", "cross", "left"):
+                if node.kind not in ("inner", "cross", "left", "right",
+                                     "full"):
                     raise AnalysisError(
                         f"{node.kind} join not yet supported")
-                if node.kind == "left":
-                    # left joins keep tree structure: handled pairwise
+                if node.kind in ("left", "right", "full"):
+                    # outer joins keep tree structure: handled pairwise
                     left = self.combine_relations(*self.subtree(node.left))
                     right = self.combine_relations(*self.subtree(node.right))
-                    relations.append(self.plan_left_join(left, right,
-                                                         node.condition))
+                    planner = {"left": self.plan_left_join,
+                               "right": self.plan_right_join,
+                               "full": self.plan_full_join}[node.kind]
+                    relations.append(planner(left, right, node.condition))
                     return
                 walk(node.left)
                 walk(node.right)
@@ -359,26 +362,103 @@ class Planner:
         """Left-deep join tree; equi-conjuncts become join keys,
         single-relation conjuncts push down, leftovers become filters.
 
-        Order: start from the first FROM relation, then greedily take the
-        next relation connected to the accumulated tree by an equi edge —
-        the connectivity-driven part of Trino's join-graph reordering
-        (iterative/rule/ReorderJoins.java:97), without the cost search."""
-        pending = list(relations[1:])
-        acc = self.apply_local_filters(relations[0], conjuncts)
+        Order: cost-driven greedy — start from the largest relation (it
+        stays the probe side throughout) and at each step join the
+        connected relation with the smallest estimated cardinality, so
+        build sides stay small and selective dimensions reduce the probe
+        early. This is the greedy core of Trino's ReorderJoins
+        (iterative/rule/ReorderJoins.java:97) driven by the row-count /
+        selectivity estimates in estimate_rows (cost/StatsCalculator's
+        role)."""
+        pending = [self.apply_local_filters(r, conjuncts)
+                   for r in relations]
+        pending.sort(key=lambda r: -self.estimate_rows(r.node))
+        acc = pending.pop(0)
         while pending:
-            chosen = None
-            for nxt in pending:
-                if self.has_equi_edge(acc, nxt, conjuncts):
-                    chosen = nxt
-                    break
-            if chosen is None:
+            connected = [r for r in pending
+                         if self.has_equi_edge(acc, r, conjuncts)]
+            if not connected:
                 raise AnalysisError(
                     "cross join without equi-condition not yet supported")
+            chosen = min(connected, key=lambda r:
+                         self.estimate_rows(r.node))
             pending.remove(chosen)
-            chosen = self.apply_local_filters(chosen, conjuncts)
             acc = self.join_pair(acc, chosen, conjuncts, kind="inner")
             acc = self.apply_local_filters(acc, conjuncts)
         return acc
+
+    # ---- cardinality estimation (cost/StatsCalculator.java:22's role) --
+
+    FILTER_SELECTIVITY = {"=": 0.05, "<>": 0.9, "<": 0.3, "<=": 0.3,
+                          ">": 0.3, ">=": 0.3}
+
+    def estimate_rows(self, node: L.PlanNode) -> float:
+        if isinstance(node, L.ScanNode):
+            try:
+                conn = self.catalog.connector(node.catalog)
+                if hasattr(conn, "_cache"):
+                    # generator connectors: report exact counts only for
+                    # already-materialized scales — plan-time stats must
+                    # never trigger SF1000 generation (EXPLAIN included)
+                    data = conn._cache.get(
+                        conn.scale_for_schema(node.schema_name), {}
+                    ).get(node.table)
+                    return float(data.num_rows) if data is not None else 1e6
+                data = conn.get_table(node.schema_name, node.table)
+                return float(data.num_rows)
+            except Exception:
+                return 1e6
+        if isinstance(node, L.FilterNode):
+            return self.estimate_rows(node.child) * \
+                self.predicate_selectivity(node.predicate)
+        if isinstance(node, (L.ProjectNode, L.WindowNode, L.SortNode)):
+            return self.estimate_rows(node.child)
+        if isinstance(node, L.LimitNode):
+            return min(float(node.count), self.estimate_rows(node.child))
+        if isinstance(node, L.AggregateNode):
+            if not node.group_keys:
+                return 1.0
+            return max(1.0, self.estimate_rows(node.child) / 10)
+        if isinstance(node, L.JoinNode):
+            probe = self.estimate_rows(node.left)
+            if node.kind in ("semi", "anti"):
+                return probe * 0.5
+            return probe if node.build_unique else probe * 2
+        if isinstance(node, L.ValuesNode):
+            return float(node.num_rows)
+        if isinstance(node, L.SetOpNode):
+            return self.estimate_rows(node.left) + \
+                self.estimate_rows(node.right)
+        return 1e6
+
+    def predicate_selectivity(self, pred: ir.Expr) -> float:
+        """Heuristic selectivities; dictionary predicates are near-exact
+        (fraction of pool values passing — the payoff of pool-side string
+        predicate evaluation)."""
+        if isinstance(pred, ir.DictPredicate):
+            if len(pred.lut) == 0:
+                return 0.1
+            return max(0.01, sum(pred.lut) / len(pred.lut))
+        if isinstance(pred, ir.Compare):
+            return self.FILTER_SELECTIVITY.get(pred.op, 0.33)
+        if isinstance(pred, ir.Between):
+            return 0.25
+        if isinstance(pred, ir.InList):
+            return min(0.9, 0.05 * len(pred.values))
+        if isinstance(pred, ir.Logical):
+            parts = [self.predicate_selectivity(a) for a in pred.args]
+            if pred.op == "and":
+                out = 1.0
+                for p in parts:
+                    out *= p
+                return out
+            out = 0.0
+            for p in parts:
+                out = out + p - out * p
+            return out
+        if isinstance(pred, ir.Not):
+            return 1.0 - self.predicate_selectivity(pred.arg)
+        return 0.33
 
     def has_equi_edge(self, left: PlannedRelation, right: PlannedRelation,
                       conjuncts: List[A.Node]) -> bool:
@@ -480,6 +560,71 @@ class Planner:
         if conjuncts:
             raise AnalysisError("non-equi LEFT JOIN condition unsupported")
         return rel
+
+    def plan_right_join(self, left: PlannedRelation,
+                        right: PlannedRelation,
+                        condition: Optional[A.Node]) -> PlannedRelation:
+        """RIGHT JOIN = LEFT JOIN with sides swapped, re-projected back to
+        (left columns, right columns) order (Trino's planner performs the
+        same flip — there is no RIGHT at the operator level)."""
+        rel = self.plan_left_join(right, left, condition)
+        n_right = len(right.node.output)
+        total = len(rel.node.output)
+        perm = list(range(n_right, total)) + list(range(n_right))
+        exprs = tuple(ir.ColumnRef(p, rel.node.output[p][1]) for p in perm)
+        output = tuple(rel.node.output[p] for p in perm)
+        node = L.ProjectNode(rel.node, exprs, output)
+        new_pos = {old: new for new, old in enumerate(perm)}
+        cols = sorted((ScopeColumn(c.qualifier, c.name, c.dtype,
+                                   new_pos[c.index], c.field)
+                       for c in rel.scope.columns),
+                      key=lambda c: c.index)
+        return PlannedRelation(node, Scope(cols))
+
+    def plan_full_join(self, left: PlannedRelation,
+                       right: PlannedRelation,
+                       condition: Optional[A.Node]) -> PlannedRelation:
+        """FULL JOIN = LEFT JOIN union-all (right rows with no match,
+        NULL-padded on the left) — the lowering Trino reaches via
+        LookupJoinOperator + LookupOuterOperator, expressed set-at-a-time."""
+        conjuncts: List[A.Node] = []
+        if condition is not None:
+            split_conjuncts(condition, conjuncts)
+        lj = self.join_pair(left, right, conjuncts, kind="left")
+        if conjuncts:
+            raise AnalysisError("non-equi FULL JOIN condition unsupported")
+        # right rows with no left match (anti join, probe = right)
+        conj2: List[A.Node] = []
+        if condition is not None:
+            split_conjuncts(condition, conj2)
+        rk: List[int] = []
+        lk: List[int] = []
+        for c in list(conj2):
+            eq = as_equi(c)
+            if eq is None:
+                continue
+            a, b = eq
+            ra, lb = right.scope.try_resolve(a), left.scope.try_resolve(b)
+            if ra is not None and lb is not None:
+                rk.append(ra.index)
+                lk.append(lb.index)
+                continue
+            rb, la = right.scope.try_resolve(b), left.scope.try_resolve(a)
+            if rb is not None and la is not None:
+                rk.append(rb.index)
+                lk.append(la.index)
+        anti = L.JoinNode("anti", right.node, left.node, tuple(rk),
+                          tuple(lk), None, False,
+                          tuple(right.node.output))
+        pad_exprs = tuple(
+            [ir.Literal(None, dt) for _, dt in left.node.output] +
+            [ir.ColumnRef(i, dt)
+             for i, (_, dt) in enumerate(right.node.output)])
+        pad = L.ProjectNode(anti, pad_exprs, lj.node.output)
+        none_maps = (None,) * len(lj.node.output)
+        full = L.SetOpNode("union_all", lj.node, pad, none_maps, none_maps,
+                           lj.node.output)
+        return PlannedRelation(full, lj.scope)
 
     def is_unique(self, rel: PlannedRelation, key_indices: List[int]) -> bool:
         return self.node_unique_on(rel.node, frozenset(key_indices))
