@@ -1,0 +1,67 @@
+"""Tracing spans, event listeners, and verifier tests."""
+
+import pytest
+
+from trino_tpu.client.client import Client
+from trino_tpu.events import EventListener
+from trino_tpu.exec.session import Session
+from trino_tpu.server.coordinator import CoordinatorServer
+from trino_tpu.utils.tracing import Tracer
+from trino_tpu.verifier import Verifier
+
+
+def test_tracer_spans_nest_and_time():
+    s = Session(default_schema="tiny")
+    s.tracer = Tracer()
+    s.execute("SELECT count(*) FROM nation")
+    names = [sp["name"] for sp in s.tracer.export()]
+    assert {"plan", "optimize", "execute", "decode"} <= set(names)
+    ex = next(sp for sp in s.tracer.export() if sp["name"] == "execute")
+    assert ex["durationMs"] >= 0
+
+
+def test_noop_tracer_collects_nothing():
+    s = Session(default_schema="tiny")
+    s.execute("SELECT 1")
+    assert s.tracer.export() == []
+
+
+class Recorder(EventListener):
+    def __init__(self):
+        self.created = []
+        self.completed = []
+
+    def query_created(self, ev):
+        self.created.append(ev)
+
+    def query_completed(self, ev):
+        self.completed.append(ev)
+
+
+def test_event_listener_dispatch():
+    coord = CoordinatorServer(Session(default_schema="tiny")).start()
+    try:
+        rec = Recorder()
+        coord.state.dispatcher.event_listeners.register(rec)
+        client = Client(coord.uri, user="ev")
+        r = client.execute("SELECT count(*) FROM nation")
+        assert any(e.query_id == r.query_id for e in rec.created)
+        done = [e for e in rec.completed if e.query_id == r.query_id]
+        assert done and done[0].state == "FINISHED"
+        with pytest.raises(Exception):
+            client.execute("SELECT broken_col FROM nation")
+        assert any(e.state == "FAILED" for e in rec.completed)
+    finally:
+        coord.stop()
+
+
+def test_verifier_detects_match_and_mismatch():
+    session = Session(default_schema="tiny")
+    v = Verifier(session, ["region", "nation"])
+    r = v.verify("q", "SELECT n_regionkey, count(*) FROM nation "
+                      "GROUP BY n_regionkey ORDER BY n_regionkey")
+    assert r.status == "MATCH"
+    # control differs: compare against a deliberately different query
+    r2 = v.verify("bad", "SELECT count(*) FROM nation",
+                  control_sql="SELECT count(*) FROM region")
+    assert r2.status == "MISMATCH"

@@ -1,0 +1,74 @@
+"""Event listener SPI.
+
+Reference: spi/eventlistener (QueryCreatedEvent / QueryCompletedEvent /
+SplitCompletedEvent) dispatched by EventListenerManager
+(eventlistener/EventListenerManager.java:56) to plugins (http, kafka,
+mysql, openlineage). Here: the same contract as a Python protocol; the
+coordinator dispatches on query creation and completion.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class QueryCreatedEvent:
+    query_id: str
+    user: str
+    sql: str
+    create_time: float
+
+
+@dataclass(frozen=True)
+class QueryCompletedEvent:
+    query_id: str
+    user: str
+    sql: str
+    state: str                    # FINISHED | FAILED | CANCELED
+    error: Optional[str]
+    elapsed_s: float
+    rows: int
+    retries: int
+    end_time: float
+
+
+class EventListener:
+    """Subclass and override; both hooks are optional (the SPI's default
+    methods)."""
+
+    def query_created(self, event: QueryCreatedEvent) -> None:
+        pass
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        pass
+
+
+class EventListenerManager:
+    def __init__(self):
+        self._listeners: List[EventListener] = []
+
+    def register(self, listener: EventListener) -> None:
+        self._listeners.append(listener)
+
+    def query_created(self, tq) -> None:
+        ev = QueryCreatedEvent(tq.query_id, tq.session_user, tq.sql,
+                               time.time())
+        for li in self._listeners:
+            try:
+                li.query_created(ev)
+            except Exception:          # listener failures never kill queries
+                pass
+
+    def query_completed(self, tq) -> None:
+        ev = QueryCompletedEvent(
+            tq.query_id, tq.session_user, tq.sql, tq.state,
+            tq.state_machine.error, tq.elapsed_s, tq.rows_returned,
+            tq.retries, time.time())
+        for li in self._listeners:
+            try:
+                li.query_completed(ev)
+            except Exception:
+                pass

@@ -63,6 +63,8 @@ class Session:
         self.executor = Executor(self.catalog)
         self.properties = {k: v for k, (v, _) in
                            SESSION_PROPERTY_DEFAULTS.items()}
+        from ..utils.tracing import NOOP
+        self.tracer = NOOP          # swap for utils.tracing.Tracer()
 
     def planner(self) -> Planner:
         return Planner(self.catalog, self.default_cat, self.default_schema)
@@ -91,13 +93,21 @@ class Session:
         raise NotImplementedError(type(stmt).__name__)
 
     def execute_query(self, stmt, t0) -> QueryResult:
-        rel = self.planner().plan_query(stmt)
+        # spans mirror the reference's: planner / fragment-plan / execute
+        # (SqlQueryExecution.java:473,501)
+        with self.tracer.span("plan"):
+            rel = self.planner().plan_query(stmt)
         root = rel.node
         assert isinstance(root, OutputNode)
-        root = prune_plan(root)
-        batch = self.executor.execute(root)
-        names, arrays, valids = self.executor.result_to_host(root, batch)
-        rows = self.decode_rows(rel, arrays, valids)
+        with self.tracer.span("optimize"):
+            root = prune_plan(root)
+        with self.tracer.span("execute"):
+            batch = self.executor.execute(root)
+            names, arrays, valids = self.executor.result_to_host(root,
+                                                                 batch)
+        with self.tracer.span("decode", rows=len(arrays[0])
+                              if arrays else 0):
+            rows = self.decode_rows(rel, arrays, valids)
         return QueryResult(names, rows, time.monotonic() - t0,
                            self.executor.stats)
 

@@ -74,11 +74,17 @@ class Dispatcher:
         # (DeduplicatingDirectExchangeBuffer.java:87's role)
         self.retry_policy = retry_policy  # NONE | QUERY
         self.max_retries = max_retries
+        from ..events import EventListenerManager
+        self.event_listeners = EventListenerManager()
 
     def submit(self, sql: str, user: str) -> TrackedQuery:
         qid = self.tracker.next_query_id()
         tq = TrackedQuery(qid, sql, user, QueryStateMachine(qid))
         self.tracker.register(tq)
+        self.event_listeners.query_created(tq)
+        tq.state_machine.add_listener(
+            lambda state: self.event_listeners.query_completed(tq)
+            if state in ("FINISHED", "FAILED", "CANCELED") else None)
         self.pool.submit(self._run, tq)
         return tq
 

@@ -1,0 +1,89 @@
+"""Span tracing.
+
+Reference: Trino wires OpenTelemetry spans through the whole query path —
+TracingModule at bootstrap (server/Server.java:106), spans around planning
+(SqlQueryExecution.java:473,501), split scheduling
+(split/SplitManager.java:85), decorators like tracing/TracingMetadata.java,
+semantic attributes in tracing/TrinoAttributes.java.
+
+Here: a dependency-free tracer with the same shape — named spans with
+attributes, parent/child nesting via a context stack, exportable as JSON
+(OTLP-like dicts) or injectable into any OpenTelemetry SDK by swapping the
+tracer object. Disabled tracers are zero-overhead no-ops.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    end: Optional[float] = None
+    attributes: Dict[str, object] = field(default_factory=dict)
+    parent: Optional[str] = None
+    span_id: int = 0
+
+    @property
+    def duration_ms(self) -> float:
+        return ((self.end or time.monotonic()) - self.start) * 1000
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "spanId": self.span_id,
+                "parent": self.parent,
+                "durationMs": round(self.duration_ms, 3),
+                "attributes": self.attributes}
+
+
+class Tracer:
+    """Collects spans per thread; `span()` nests via a context stack."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.spans: List[Span] = []
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def _stack(self) -> list:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    @contextmanager
+    def span(self, name: str, **attributes):
+        if not self.enabled:
+            yield None
+            return
+        stack = self._stack()
+        parent = stack[-1].name if stack else None
+        with self._lock:
+            self._seq += 1
+            sid = self._seq
+        s = Span(name, time.monotonic(), attributes=dict(attributes),
+                 parent=parent, span_id=sid)
+        stack.append(s)
+        try:
+            yield s
+        finally:
+            s.end = time.monotonic()
+            stack.pop()
+            with self._lock:
+                self.spans.append(s)
+
+    def export(self) -> List[dict]:
+        with self._lock:
+            return [s.to_dict() for s in self.spans]
+
+    def clear(self) -> None:
+        with self._lock:
+            self.spans.clear()
+
+
+NOOP = Tracer(enabled=False)
