@@ -262,3 +262,174 @@ WHERE ss_sold_time_sk = t_time_sk
   AND hd_dep_count = 7
   AND s_store_name = 'ese'
 """
+
+# q27: store-sales averages with ROLLUP over state (adapted: the generated
+# schema rolls up over s_state only; spec adds i_item_id grouping)
+QUERIES[27] = """
+SELECT i_item_id, s_state, avg(ss_quantity) agg1,
+       avg(ss_list_price) agg2, avg(ss_coupon_amt) agg3,
+       avg(ss_sales_price) agg4
+FROM store_sales, customer_demographics, date_dim, store, item
+WHERE ss_sold_date_sk = d_date_sk
+  AND ss_item_sk = i_item_sk
+  AND ss_store_sk = s_store_sk
+  AND ss_cdemo_sk = cd_demo_sk
+  AND cd_gender = 'M'
+  AND cd_marital_status = 'S'
+  AND cd_education_status = 'College'
+  AND d_year = 2002
+  AND s_state IN ('TN', 'TX')
+  AND i_manufact_id < 30
+GROUP BY i_item_id, s_state
+ORDER BY i_item_id, s_state
+LIMIT 100
+"""
+
+# q34: households buying 15-20 items per ticket (count HAVING band)
+QUERIES[34] = """
+SELECT c_last_name, c_first_name, dn.ss_ticket_number, cnt
+FROM (SELECT ss_ticket_number, ss_customer_sk, count(*) cnt
+      FROM store_sales, date_dim, store, household_demographics
+      WHERE ss_sold_date_sk = d_date_sk
+        AND ss_store_sk = s_store_sk
+        AND ss_hdemo_sk = hd_demo_sk
+        AND (d_dom BETWEEN 1 AND 3 OR d_dom BETWEEN 25 AND 28)
+        AND hd_buy_potential = '>10000'
+        AND hd_vehicle_count > 0
+        AND d_year IN (1999, 2000, 2001)
+      GROUP BY ss_ticket_number, ss_customer_sk
+      HAVING count(*) BETWEEN 5 AND 20) dn, customer
+WHERE dn.ss_customer_sk = c_customer_sk
+ORDER BY c_last_name, c_first_name, dn.ss_ticket_number DESC, cnt
+LIMIT 100
+"""
+
+# q37: items with inventory in a quantity band sold through catalog
+QUERIES[37] = """
+SELECT i_item_id, i_current_price
+FROM item, inventory, date_dim, catalog_sales
+WHERE i_current_price BETWEEN 20 AND 50
+  AND inv_item_sk = i_item_sk
+  AND d_date_sk = inv_date_sk
+  AND d_date BETWEEN DATE '2000-02-01' AND DATE '2000-04-01'
+  AND i_manufact_id IN (100, 200, 300, 400)
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+  AND cs_item_sk = i_item_sk
+GROUP BY i_item_id, i_current_price
+ORDER BY i_item_id
+LIMIT 100
+"""
+
+# q43: store sales by day of week (CASE pivot)
+QUERIES[43] = """
+SELECT s_store_name, s_store_id,
+       sum(CASE WHEN d_day_name = 'Sunday' THEN ss_sales_price
+                ELSE NULL END) sun_sales,
+       sum(CASE WHEN d_day_name = 'Monday' THEN ss_sales_price
+                ELSE NULL END) mon_sales,
+       sum(CASE WHEN d_day_name = 'Friday' THEN ss_sales_price
+                ELSE NULL END) fri_sales,
+       sum(CASE WHEN d_day_name = 'Saturday' THEN ss_sales_price
+                ELSE NULL END) sat_sales
+FROM date_dim, store_sales, store
+WHERE d_date_sk = ss_sold_date_sk
+  AND s_store_sk = ss_store_sk
+  AND s_gmt_offset = -500
+  AND d_year = 2000
+GROUP BY s_store_name, s_store_id
+ORDER BY s_store_name, s_store_id
+LIMIT 100
+"""
+
+# q46: city mismatch between purchase and residence (like q68 with dow)
+QUERIES[46] = """
+SELECT c_last_name, c_first_name, ca_city,
+       dn.bought_city, dn.ss_ticket_number, amt, profit
+FROM (SELECT ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      FROM store_sales, date_dim, store, household_demographics,
+           customer_address
+      WHERE ss_sold_date_sk = d_date_sk
+        AND ss_store_sk = s_store_sk
+        AND ss_hdemo_sk = hd_demo_sk
+        AND ss_addr_sk = ca_address_sk
+        AND (hd_dep_count = 4 OR hd_vehicle_count = 3)
+        AND d_dow IN (6, 0)
+        AND d_year = 1999
+      GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk,
+               ca_city) dn, customer, customer_address current_addr
+WHERE dn.ss_customer_sk = c_customer_sk
+  AND customer.c_current_addr_sk = current_addr.ca_address_sk
+  AND current_addr.ca_city <> dn.bought_city
+ORDER BY c_last_name, c_first_name, ca_city, dn.bought_city,
+         dn.ss_ticket_number
+LIMIT 100
+"""
+
+# q63: monthly manager sales vs their yearly average (window over agg)
+QUERIES[63] = """
+SELECT i_manager_id, d_moy, sum(ss_sales_price) sum_sales,
+       avg(sum(ss_sales_price))
+           OVER (PARTITION BY i_manager_id) avg_monthly_sales
+FROM item, store_sales, date_dim, store
+WHERE ss_item_sk = i_item_sk
+  AND ss_sold_date_sk = d_date_sk
+  AND ss_store_sk = s_store_sk
+  AND d_year = 2001
+  AND i_category IN ('Books', 'Electronics', 'Sports')
+GROUP BY i_manager_id, d_moy
+ORDER BY i_manager_id, d_moy
+LIMIT 100
+"""
+
+# q82: items with store inventory in a band (store-sales twin of q37)
+QUERIES[82] = """
+SELECT i_item_id, i_current_price
+FROM item, inventory, date_dim, store_sales
+WHERE i_current_price BETWEEN 30 AND 60
+  AND inv_item_sk = i_item_sk
+  AND d_date_sk = inv_date_sk
+  AND d_date BETWEEN DATE '1999-05-01' AND DATE '1999-07-01'
+  AND i_manufact_id IN (50, 150, 250, 350)
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+  AND ss_item_sk = i_item_sk
+GROUP BY i_item_id, i_current_price
+ORDER BY i_item_id
+LIMIT 100
+"""
+
+# q89: weekly category sales vs class average (window over agg)
+QUERIES[89] = """
+SELECT i_category, i_class, s_store_name, d_moy,
+       sum(ss_sales_price) sum_sales,
+       avg(sum(ss_sales_price))
+           OVER (PARTITION BY i_category, i_class,
+                 s_store_name) avg_monthly_sales
+FROM item, store_sales, date_dim, store
+WHERE ss_item_sk = i_item_sk
+  AND ss_sold_date_sk = d_date_sk
+  AND ss_store_sk = s_store_sk
+  AND d_year = 2000
+  AND i_category IN ('Home', 'Music', 'Shoes')
+  AND i_class IN ('accent', 'classical', 'athletic')
+GROUP BY i_category, i_class, s_store_name, d_moy
+ORDER BY i_category, i_class, s_store_name, d_moy
+LIMIT 100
+"""
+
+# q98: item revenue share within class (sum over partition of agg)
+QUERIES[98] = """
+SELECT i_item_id, i_category, i_class, i_current_price,
+       sum(ss_ext_sales_price) itemrevenue,
+       sum(ss_ext_sales_price) * 100 /
+           sum(sum(ss_ext_sales_price))
+               OVER (PARTITION BY i_class) revenueratio
+FROM store_sales, item, date_dim
+WHERE ss_item_sk = i_item_sk
+  AND i_category IN ('Books', 'Jewelry', 'Women')
+  AND ss_sold_date_sk = d_date_sk
+  AND d_date BETWEEN DATE '1999-02-22' AND DATE '1999-03-24'
+GROUP BY i_item_id, i_category, i_class, i_current_price
+ORDER BY i_category, i_class, i_item_id, revenueratio
+LIMIT 100
+"""

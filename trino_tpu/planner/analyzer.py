@@ -187,7 +187,9 @@ class ExpressionLowerer:
     def __init__(self, scope: Scope, planner=None, window_slots=None):
         self.scope = scope
         self.planner = planner
-        self.window_slots = window_slots or {}
+        # keep the caller's dict object: plan_aggregation populates it
+        # after constructing the lowerer
+        self.window_slots = window_slots if window_slots is not None else {}
 
     def lower(self, node: A.Node) -> ir.Expr:
         if isinstance(node, A.WindowFunc):

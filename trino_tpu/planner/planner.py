@@ -1169,60 +1169,45 @@ class Planner:
         post_scope = Scope(post_scope_cols)
 
         window_slots: Dict[A.WindowFunc, ir.Expr] = {}
+        planner_self = self
 
-        def rewrite(node: A.Node) -> ir.Expr:
-            """Lower a select/having/order expression over the agg output."""
-            if isinstance(node, A.WindowFunc):
-                slot = window_slots.get(node)
-                if slot is None:
-                    raise AnalysisError(
-                        f"window function {node.name}() not allowed here")
-                return slot
-            # group-by expression match (syntactic, like Trino)
-            for i, g_ast in enumerate(group_asts):
-                if ast_equal(node, g_ast, q):
-                    c = post_scope.columns[i]
-                    return ir.ColumnRef(c.index, c.dtype, c.name)
-            if isinstance(node, A.FunctionCall) and node.name in AGG_NAMES:
-                kind, s1, s2 = call_slots[node]
-                if kind == "plain":
-                    spec = agg_specs[s1]
-                    return ir.ColumnRef(n_keys + s1, spec.out_dtype)
-                sum_ref = ir.ColumnRef(n_keys + s1, agg_specs[s1].out_dtype)
-                cnt_ref = ir.ColumnRef(n_keys + s2, BIGINT)
-                arg_t = agg_specs[s1].arg.dtype
-                if arg_t.kind is TypeKind.DECIMAL:
-                    return ir.DecimalAvg(sum_ref, cnt_ref, arg_t)
-                return ir.arith("/", ir.Cast(sum_ref, DOUBLE),
-                                ir.Cast(cnt_ref, DOUBLE))
-            if isinstance(node, A.Identifier):
-                # must be a group key (matched above) — else error
-                raise AnalysisError(
-                    f"column {'.'.join(node.parts)} must appear in GROUP BY")
-            if isinstance(node, A.BinaryOp):
-                l, r = rewrite(node.left), rewrite(node.right)
-                if node.op in ("and", "or"):
-                    return ir.Logical(node.op, (l, r))
-                if node.op in ("=", "<>", "<", "<=", ">", ">="):
-                    return ir.Compare(node.op, l, r)
-                return ir.arith(node.op, l, r)
-            if isinstance(node, A.UnaryOp):
-                if node.op == "not":
-                    return ir.Not(rewrite(node.arg))
-                return ir.Negate(rewrite(node.arg),
-                                 rewrite(node.arg).dtype)
-            if isinstance(node, (A.NumberLit, A.StringLit, A.BoolLit,
-                                 A.NullLit, A.DateLit)):
-                return ExpressionLowerer(post_scope).lower(node)
-            if isinstance(node, A.CastExpr):
-                return ir.Cast(rewrite(node.arg),
-                               parse_type(node.type_name))
-            if isinstance(node, A.ScalarSubquery):
-                return ExpressionLowerer(post_scope, planner=self).lower(
-                    node)
-            raise AnalysisError(
-                f"unsupported post-aggregation expression "
-                f"{type(node).__name__}")
+        class _PostAggLowerer(ExpressionLowerer):
+            """Lowers select/having/order expressions over the aggregation
+            output: group-key ASTs match syntactically (like Trino),
+            aggregate calls resolve to their output slots, everything else
+            (BETWEEN, IN, CASE, scalar functions, subqueries, ...) falls
+            through to the full expression lowerer against the post-agg
+            scope."""
+
+            def lower(inner, node: A.Node) -> ir.Expr:
+                for i, g_ast in enumerate(group_asts):
+                    if ast_equal(node, g_ast, q):
+                        c = post_scope.columns[i]
+                        return ir.ColumnRef(c.index, c.dtype, c.name)
+                if isinstance(node, A.FunctionCall) and \
+                        node.name in AGG_NAMES:
+                    kind, s1, s2 = call_slots[node]
+                    if kind == "plain":
+                        spec = agg_specs[s1]
+                        return ir.ColumnRef(n_keys + s1, spec.out_dtype)
+                    sum_ref = ir.ColumnRef(n_keys + s1,
+                                           agg_specs[s1].out_dtype)
+                    cnt_ref = ir.ColumnRef(n_keys + s2, BIGINT)
+                    arg_t = agg_specs[s1].arg.dtype
+                    if arg_t.kind is TypeKind.DECIMAL:
+                        return ir.DecimalAvg(sum_ref, cnt_ref, arg_t)
+                    return ir.arith("/", ir.Cast(sum_ref, DOUBLE),
+                                    ir.Cast(cnt_ref, DOUBLE))
+                if isinstance(node, A.Identifier):
+                    col = post_scope.try_resolve(node.parts)
+                    if col is None:
+                        raise AnalysisError(
+                            f"column {'.'.join(node.parts)} must appear "
+                            f"in GROUP BY")
+                return super().lower(node)
+
+        rewrite = _PostAggLowerer(post_scope, planner=planner_self,
+                                  window_slots=window_slots).lower
 
         items = []
         for item in q.select:
