@@ -1,0 +1,88 @@
+"""CSV connector tests: file layout, inference, NULLs, SQL over files."""
+
+import pytest
+
+from trino_tpu.connectors.csvfile import CsvConnector
+from trino_tpu.exec.session import Session
+
+
+@pytest.fixture()
+def csv_session(tmp_path):
+    d = tmp_path / "default"
+    d.mkdir()
+    (d / "people.csv").write_text(
+        "name,age,height,joined\n"
+        "alice,34,1.7,2020-01-15\n"
+        "bob,28,1.82,2021-06-01\n"
+        "carol,,1.65,2019-11-30\n"
+        "dave,41,,2022-03-10\n")
+    (d / "cities.csv").write_text(
+        "name,city\nalice,berlin\nbob,paris\ncarol,berlin\n")
+    s = Session(default_cat="csv", default_schema="default")
+    s.catalog.register("csv", CsvConnector(str(tmp_path)))
+    return s
+
+
+def test_inference_and_metadata(csv_session):
+    rows = csv_session.execute("DESCRIBE people").rows
+    assert rows == [("name", "varchar"), ("age", "bigint"),
+                    ("height", "double"), ("joined", "date")]
+    tables = [r[0] for r in csv_session.execute("SHOW TABLES").rows]
+    assert tables == ["cities", "people"]
+
+
+def test_select_with_nulls(csv_session):
+    rows = csv_session.execute(
+        "SELECT name, age FROM people ORDER BY name").rows
+    assert rows == [("alice", 34), ("bob", 28), ("carol", None),
+                    ("dave", 41)]
+
+
+def test_aggregate_and_join_over_files(csv_session):
+    rows = csv_session.execute("""
+        SELECT c.city, count(*) AS n, avg(p.age) AS avg_age
+        FROM people p, cities c
+        WHERE p.name = c.name
+        GROUP BY c.city ORDER BY c.city""").rows
+    assert rows[0][0] == "berlin" and rows[0][1] == 2
+    assert rows[1] == ("paris", 1, 28.0)
+
+
+def test_date_filtering(csv_session):
+    rows = csv_session.execute(
+        "SELECT name FROM people WHERE joined >= DATE '2021-01-01' "
+        "ORDER BY name").rows
+    assert rows == [("bob",), ("dave",)]
+
+
+def test_varchar_join_across_different_pools(csv_session, tmp_path):
+    # extras.csv's name pool differs from cities.csv's (zed sorts last,
+    # shifting codes) — the join must align dictionaries, not codes
+    (tmp_path / "default" / "extras.csv").write_text(
+        "name,score\nzed,1\ncarol,2\nalice,3\n")
+    rows = csv_session.execute("""
+        SELECT e.name, c.city, e.score
+        FROM extras e JOIN cities c ON e.name = c.name
+        ORDER BY e.name""").rows
+    assert rows == [("alice", "berlin", 3), ("carol", "berlin", 2)]
+
+
+def test_varchar_equality_across_pools(csv_session, tmp_path):
+    (tmp_path / "default" / "alt.csv").write_text(
+        "name2\nbob\nzed\n")
+    rows = csv_session.execute("""
+        SELECT p.name FROM people p, alt a
+        WHERE p.name = a.name2 ORDER BY p.name""").rows
+    assert rows == [("bob",)]
+
+
+def test_varchar_in_subquery_across_pools(csv_session, tmp_path):
+    (tmp_path / "default" / "vip.csv").write_text("vip\nzed\ndave\n")
+    rows = csv_session.execute("""
+        SELECT name FROM people
+        WHERE name IN (SELECT vip FROM vip) ORDER BY name""").rows
+    assert rows == [("dave",)]
+    rows = csv_session.execute("""
+        SELECT name FROM people
+        WHERE name NOT IN (SELECT vip FROM vip) ORDER BY name""").rows
+    assert rows == [("alice",), ("bob",), ("carol",)]

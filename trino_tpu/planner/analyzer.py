@@ -467,6 +467,9 @@ class ExpressionLowerer:
                     isinstance(right, _StringConst):
                 raise AnalysisError("string comparison requires a varchar "
                                     "column side")
+            if left.dtype.kind is TypeKind.VARCHAR and \
+                    right.dtype.kind is TypeKind.VARCHAR:
+                return self.varchar_compare(op, left, right)
             return ir.Compare(op, left, right)
         if op in ("+", "-"):
             # date +/- interval folds at plan time for literal dates,
@@ -496,6 +499,27 @@ class ExpressionLowerer:
             return self.lower_concat([self.lower(node.left),
                                       self.lower(node.right)])
         raise AnalysisError(f"unsupported operator {op!r}")
+
+    def varchar_compare(self, op: str, left: ir.Expr,
+                        right: ir.Expr) -> ir.Expr:
+        """varchar-vs-varchar comparison: dictionary codes are only
+        comparable within one pool (pools are kept lexicographically
+        sorted, so code order == string order). Differing pools: =/<>
+        compare through a right->left pool remap (-1 = absent, never
+        equal); range comparisons would need a merged ordering — raise."""
+        lpool = self.pool_of(left)
+        rpool = self.pool_of(right)
+        if lpool == rpool:
+            return ir.Compare(op, left, right)
+        if op not in ("=", "<>"):
+            raise AnalysisError(
+                "ordered varchar comparison across different dictionaries "
+                "is unsupported")
+        from ..types import BIGINT as _BIGINT
+        index = {s: j for j, s in enumerate(lpool)}
+        lut = tuple(index.get(s, -1) for s in rpool)
+        return ir.Compare(op, left,
+                          ir.DictValueMap(right, lut, _BIGINT))
 
     def lower_case(self, node: A.CaseExpr) -> ir.Expr:
         whens = []

@@ -523,6 +523,13 @@ class Planner:
             raise AnalysisError(
                 "cross join without equi-condition not yet supported")
 
+        # varchar join keys: dictionary codes only match within one pool;
+        # differing pools get the right side remapped into the left pool
+        # (missing strings -> -1, which matches no valid code) — the
+        # dictionary-aware twin of Trino's type-coerced join clauses
+        right = self.align_varchar_join_keys(left, right, left_keys,
+                                             right_keys)
+
         # orientation: build side should be unique on its keys if provable;
         # LEFT joins pin the preserved side as probe (no freedom)
         right_unique = self.is_unique(right, right_keys)
@@ -545,6 +552,45 @@ class Planner:
             ScopeColumn(c.qualifier, c.name, c.dtype, c.index + n_left,
                         c.field) for c in build.scope.columns]
         return PlannedRelation(node, Scope(cols))
+
+    def align_varchar_join_keys(self, left: PlannedRelation,
+                                right: PlannedRelation,
+                                left_keys: List[int],
+                                right_keys: List[int]) -> PlannedRelation:
+        """Where a key pair is varchar-vs-varchar with different pools,
+        append a remapped BIGINT key column to the right relation and
+        repoint the key at it. Output columns are untouched."""
+        extra: List[ir.Expr] = []
+        extra_cols: List[Tuple[str, DataType]] = []
+        n_right = len(right.node.output)
+        for i, (lk, rk) in enumerate(zip(left_keys, right_keys)):
+            lcol = next((c for c in left.scope.columns if c.index == lk),
+                        None)
+            rcol = next((c for c in right.scope.columns if c.index == rk),
+                        None)
+            if lcol is None or rcol is None:
+                continue
+            if lcol.dtype.kind is not TypeKind.VARCHAR or \
+                    rcol.dtype.kind is not TypeKind.VARCHAR:
+                continue
+            lpool = lcol.field.dictionary if lcol.field else None
+            rpool = rcol.field.dictionary if rcol.field else None
+            if lpool is None or rpool is None or lpool == rpool:
+                continue
+            index = {s: j for j, s in enumerate(lpool)}
+            lut = tuple(index.get(s, -1) for s in rpool)
+            extra.append(ir.DictValueMap(
+                ir.ColumnRef(rk, rcol.dtype), lut, BIGINT))
+            extra_cols.append((f"$jk{len(extra_cols)}", BIGINT))
+            right_keys[i] = n_right + len(extra) - 1
+        if not extra:
+            return right
+        exprs = tuple(
+            [ir.ColumnRef(j, dt) for j, (_, dt)
+             in enumerate(right.node.output)] + extra)
+        output = tuple(right.node.output) + tuple(extra_cols)
+        node = L.ProjectNode(right.node, exprs, output)
+        return PlannedRelation(node, right.scope)
 
     def plan_left_join(self, left: PlannedRelation, right: PlannedRelation,
                        condition: Optional[A.Node]) -> PlannedRelation:
@@ -1453,6 +1499,20 @@ class Planner:
             probe = PlannedRelation(
                 L.ProjectNode(outer.node, tuple(exprs), out), outer.scope)
             key = ir.ColumnRef(len(out) - 1, key.dtype)
+        if key.dtype.kind is TypeKind.VARCHAR:
+            # dictionary alignment (see align_varchar_join_keys)
+            lfld = self.field_for(key, outer.scope)
+            sub_col = sub.scope.columns[0]
+            lpool = lfld.dictionary if lfld else None
+            rpool = sub_col.field.dictionary if sub_col.field else None
+            if lpool is not None and rpool is not None and lpool != rpool:
+                index = {s: j for j, s in enumerate(lpool)}
+                lut = tuple(index.get(s, -1) for s in rpool)
+                build_node = L.ProjectNode(
+                    build_node,
+                    (ir.DictValueMap(ir.ColumnRef(0, sub_col.dtype), lut,
+                                     BIGINT),),
+                    (("$inkey", BIGINT),))
         if c.negated:
             # NULL probe keys can never satisfy NOT IN
             probe = PlannedRelation(
