@@ -86,3 +86,64 @@ def test_varchar_in_subquery_across_pools(csv_session, tmp_path):
         SELECT name FROM people
         WHERE name NOT IN (SELECT vip FROM vip) ORDER BY name""").rows
     assert rows == [("alice",), ("bob",), ("carol",)]
+
+
+def test_exists_across_pools(csv_session, tmp_path):
+    (tmp_path / "default" / "ex.csv").write_text(
+        "name,score\nzed,1\ncarol,2\nalice,3\n")
+    rows = csv_session.execute("""
+        SELECT name FROM people p
+        WHERE EXISTS (SELECT 1 FROM ex e WHERE e.name = p.name)
+        ORDER BY name""").rows
+    assert rows == [("alice",), ("carol",)]
+    rows = csv_session.execute("""
+        SELECT name FROM people p
+        WHERE NOT EXISTS (SELECT 1 FROM ex e WHERE e.name = p.name)
+        ORDER BY name""").rows
+    assert rows == [("bob",), ("dave",)]
+
+
+def test_correlated_scalar_across_pools(csv_session, tmp_path):
+    (tmp_path / "default" / "sc.csv").write_text(
+        "name,score\nzed,100\ncarol,1\nalice,1\n")
+    rows = csv_session.execute("""
+        SELECT name FROM people p
+        WHERE age > (SELECT sum(score) FROM sc e WHERE e.name = p.name)
+        ORDER BY name""").rows
+    # carol's age is NULL (NULL > 1 excludes); a raw-code bug would
+    # wrongly admit bob (his code collides with carol's in sc's pool)
+    assert rows == [("alice",)]
+
+
+def test_computed_varchar_in_key_across_pools(csv_session, tmp_path):
+    (tmp_path / "default" / "vip2.csv").write_text("vip\ncarol\nzed\n")
+    rows = csv_session.execute("""
+        SELECT name FROM people
+        WHERE (CASE WHEN age > 0 THEN name ELSE name END)
+              IN (SELECT vip FROM vip2)
+        ORDER BY name""").rows
+    assert rows == [("carol",)]
+
+
+def test_cross_pool_where_equality(csv_session, tmp_path):
+    (tmp_path / "default" / "pairs.csv").write_text(
+        "a,b\nalice,alice\nbob,zed\ncarol,carol\n")
+    rows = csv_session.execute(
+        "SELECT a FROM pairs WHERE a = b ORDER BY a").rows
+    assert rows == [("alice",), ("carol",)]
+    rows = csv_session.execute(
+        "SELECT a FROM pairs WHERE a <> b ORDER BY a").rows
+    assert rows == [("bob",)]
+
+
+def test_full_join_across_pools(csv_session, tmp_path):
+    (tmp_path / "default" / "fx.csv").write_text(
+        "name,score\nzed,1\ncarol,2\n")
+    rows = csv_session.execute("""
+        SELECT p.name, f.name, f.score
+        FROM people p FULL JOIN fx f ON p.name = f.name
+        ORDER BY p.name NULLS FIRST, f.name NULLS FIRST""").rows
+    assert rows[0] == (None, "zed", 1)
+    assert ("carol", "carol", 2) in rows
+    assert ("bob", None, None) in rows
+    assert len(rows) == 5

@@ -136,3 +136,21 @@ def test_repartition_preserves_all_rows(mesh):
     for s in range(8):
         for k in np.unique(per_shard[s][live_s[s]]):
             assert shard_of.setdefault(int(k), s) == s
+
+
+def test_2d_mesh_distributed_query():
+    """hosts x chips mesh: rows shard over both axes, GSPMD keeps global
+    SQL semantics (the multi-host layout on the virtual device set)."""
+    from trino_tpu.exec.session import Session
+    from trino_tpu.parallel.dist_executor import MeshExecutor
+    from trino_tpu.parallel.mesh import make_mesh_2d
+    mesh = make_mesh_2d(2, 4)
+    assert mesh.axis_names == ("hosts", "chips")
+    s = Session(default_schema="tiny")
+    s.executor = MeshExecutor(s.catalog, mesh)
+    r = s.execute("SELECT n_regionkey, count(*) FROM nation "
+                  "GROUP BY n_regionkey ORDER BY n_regionkey")
+    assert [row[1] for row in r.rows] == [5, 5, 5, 5, 5]
+    r = s.execute("SELECT count(*) FROM lineitem, orders "
+                  "WHERE l_orderkey = o_orderkey AND o_totalprice > 100")
+    assert r.rows[0][0] > 0

@@ -49,7 +49,10 @@ class MeshExecutor(Executor):
         super().__init__(catalog)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_shards = self.mesh.devices.size
-        self._row_sharding = NamedSharding(self.mesh, P(AXIS))
+        # rows shard over every mesh axis (a 2-D hosts x chips mesh keeps
+        # the inner collectives on ICI — see mesh.make_mesh_2d)
+        self._row_sharding = NamedSharding(
+            self.mesh, P(tuple(self.mesh.axis_names)))
 
     def run_scan(self, node: L.ScanNode) -> Batch:
         batch = super().run_scan(node)

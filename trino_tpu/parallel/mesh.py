@@ -33,12 +33,28 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = AXIS) -> Mesh:
     return Mesh(np.array(devs[:n]), (axis,))
 
 
-def shard_rows(batch: Batch, mesh: Mesh, axis: str = AXIS) -> Batch:
+def make_mesh_2d(n_hosts: int, chips_per_host: int,
+                 axes=("hosts", "chips")) -> Mesh:
+    """Two-axis mesh for multi-host topologies: the outer axis spans DCN
+    (hosts), the inner axis ICI (chips within a host). Shardings laid out
+    as P(('hosts','chips')) keep the heavy collectives on the inner axis —
+    the scaling-book layout recipe, and the analog of Trino's node-level
+    vs task-level parallelism split (SURVEY.md §2.8)."""
+    devs = jax.devices()
+    n = n_hosts * chips_per_host
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]).reshape(n_hosts, chips_per_host), axes)
+
+
+def shard_rows(batch: Batch, mesh: Mesh, axis: Optional[str] = None) -> Batch:
     """Place a host-built batch row-sharded across the mesh (the split
     assignment step: SourcePartitionedScheduler.assignSplits:378 analog).
-    Capacity must divide evenly — batch_from_numpy pads to 1024-multiples,
-    so pad_multiple must be a multiple of mesh size * 8."""
-    spec = NamedSharding(mesh, P(axis))
+    Multi-axis meshes shard rows over ALL axes (hosts x chips). Capacity
+    must divide evenly — batch_from_numpy pads to 1024-multiples, so
+    pad_multiple must be a multiple of mesh size * 8."""
+    axes = (axis,) if axis is not None else tuple(mesh.axis_names)
+    spec = NamedSharding(mesh, P(axes))
 
     def put(x):
         return jax.device_put(x, spec)

@@ -515,11 +515,11 @@ class ExpressionLowerer:
             raise AnalysisError(
                 "ordered varchar comparison across different dictionaries "
                 "is unsupported")
-        from ..types import BIGINT as _BIGINT
+        # both sides become BIGINT codes in the LEFT pool's space
         index = {s: j for j, s in enumerate(lpool)}
         lut = tuple(index.get(s, -1) for s in rpool)
-        return ir.Compare(op, left,
-                          ir.DictValueMap(right, lut, _BIGINT))
+        return ir.Compare(op, ir.Cast(left, BIGINT),
+                          ir.DictValueMap(right, lut, BIGINT))
 
     def lower_case(self, node: A.CaseExpr) -> ir.Expr:
         whens = []

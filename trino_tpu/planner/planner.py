@@ -44,6 +44,13 @@ from ..ops.aggregate import MAX_DIRECT_GROUPS  # dense-domain cutoff (64)
 DEFAULT_SORT_GROUPS = 1 << 16    # sort-agg output capacity default
 
 
+def _remap_lut(lpool: tuple, rpool: tuple) -> tuple:
+    """Per-code LUT translating rpool codes into lpool codes; -1 = the
+    string is absent from lpool (matches no valid code)."""
+    index = {s: j for j, s in enumerate(lpool)}
+    return tuple(index.get(s, -1) for s in rpool)
+
+
 @dataclass
 class PlannedRelation:
     node: L.PlanNode
@@ -523,13 +530,6 @@ class Planner:
             raise AnalysisError(
                 "cross join without equi-condition not yet supported")
 
-        # varchar join keys: dictionary codes only match within one pool;
-        # differing pools get the right side remapped into the left pool
-        # (missing strings -> -1, which matches no valid code) — the
-        # dictionary-aware twin of Trino's type-coerced join clauses
-        right = self.align_varchar_join_keys(left, right, left_keys,
-                                             right_keys)
-
         # orientation: build side should be unique on its keys if provable;
         # LEFT joins pin the preserved side as probe (no freedom)
         right_unique = self.is_unique(right, right_keys)
@@ -543,54 +543,77 @@ class Planner:
             probe_keys, build_keys = right_keys, left_keys
             build_unique = left_unique
 
-        output = tuple(probe.node.output) + tuple(build.node.output)
-        node = L.JoinNode(kind, probe.node, build.node,
-                          tuple(probe_keys), tuple(build_keys), None,
-                          build_unique, output)
+        node = self.make_join(
+            kind, probe.node, build.node, probe_keys, build_keys, None,
+            build_unique,
+            probe_fields=[self._scope_field(probe.scope, i)
+                          for i in probe_keys],
+            build_fields=[self._scope_field(build.scope, i)
+                          for i in build_keys])
         n_left = len(probe.node.output)
         cols = list(probe.scope.columns) + [
             ScopeColumn(c.qualifier, c.name, c.dtype, c.index + n_left,
                         c.field) for c in build.scope.columns]
         return PlannedRelation(node, Scope(cols))
 
-    def align_varchar_join_keys(self, left: PlannedRelation,
-                                right: PlannedRelation,
-                                left_keys: List[int],
-                                right_keys: List[int]) -> PlannedRelation:
-        """Where a key pair is varchar-vs-varchar with different pools,
-        append a remapped BIGINT key column to the right relation and
-        repoint the key at it. Output columns are untouched."""
+    @staticmethod
+    def _scope_field(scope: Scope, index: int) -> Optional[Field]:
+        for c in scope.columns:
+            if c.index == index:
+                return c.field
+        return None
+
+    def make_join(self, kind: str, probe_node: L.PlanNode,
+                  build_node: L.PlanNode, probe_keys, build_keys,
+                  residual, build_unique: bool, *,
+                  probe_fields, build_fields,
+                  null_aware: bool = False) -> L.JoinNode:
+        """THE JoinNode constructor: every join-building path funnels
+        through here so varchar keys always get dictionary alignment.
+
+        Codes only match within one pool; where a key pair is
+        varchar-vs-varchar with differing pools, the build side gains an
+        appended BIGINT key column remapping its codes into the probe pool
+        (-1 = absent, matches no valid code) — the dictionary-aware twin
+        of Trino's type-coerced join clauses."""
+        probe_keys = list(probe_keys)
+        build_keys = list(build_keys)
         extra: List[ir.Expr] = []
         extra_cols: List[Tuple[str, DataType]] = []
-        n_right = len(right.node.output)
-        for i, (lk, rk) in enumerate(zip(left_keys, right_keys)):
-            lcol = next((c for c in left.scope.columns if c.index == lk),
-                        None)
-            rcol = next((c for c in right.scope.columns if c.index == rk),
-                        None)
-            if lcol is None or rcol is None:
+        nb = len(build_node.output)
+        for i, (pf, bf) in enumerate(zip(probe_fields, build_fields)):
+            pk, bk0 = probe_keys[i], build_keys[i]
+            p_varchar = probe_node.output[pk][1].kind is TypeKind.VARCHAR
+            b_varchar = build_node.output[bk0][1].kind is TypeKind.VARCHAR
+            if not (p_varchar and b_varchar):
                 continue
-            if lcol.dtype.kind is not TypeKind.VARCHAR or \
-                    rcol.dtype.kind is not TypeKind.VARCHAR:
+            lpool = pf.dictionary if pf is not None else None
+            rpool = bf.dictionary if bf is not None else None
+            if lpool is None or rpool is None:
+                # silent code-matching would be wrong — refuse loudly
+                raise AnalysisError(
+                    "varchar join key lost its dictionary; cannot align "
+                    "pools")
+            if lpool == rpool:
                 continue
-            lpool = lcol.field.dictionary if lcol.field else None
-            rpool = rcol.field.dictionary if rcol.field else None
-            if lpool is None or rpool is None or lpool == rpool:
-                continue
-            index = {s: j for j, s in enumerate(lpool)}
-            lut = tuple(index.get(s, -1) for s in rpool)
-            extra.append(ir.DictValueMap(
-                ir.ColumnRef(rk, rcol.dtype), lut, BIGINT))
+            bk = build_keys[i]
+            dt = build_node.output[bk][1]
+            extra.append(ir.DictValueMap(ir.ColumnRef(bk, dt),
+                                         _remap_lut(lpool, rpool), BIGINT))
             extra_cols.append((f"$jk{len(extra_cols)}", BIGINT))
-            right_keys[i] = n_right + len(extra) - 1
-        if not extra:
-            return right
-        exprs = tuple(
-            [ir.ColumnRef(j, dt) for j, (_, dt)
-             in enumerate(right.node.output)] + extra)
-        output = tuple(right.node.output) + tuple(extra_cols)
-        node = L.ProjectNode(right.node, exprs, output)
-        return PlannedRelation(node, right.scope)
+            build_keys[i] = nb + len(extra) - 1
+        if extra:
+            exprs = tuple(
+                [ir.ColumnRef(j, dt) for j, (_, dt)
+                 in enumerate(build_node.output)] + extra)
+            build_node = L.ProjectNode(
+                build_node, exprs,
+                tuple(build_node.output) + tuple(extra_cols))
+        output = tuple(probe_node.output) + \
+            (tuple(build_node.output) if kind in ("inner", "left") else ())
+        return L.JoinNode(kind, probe_node, build_node,
+                          tuple(probe_keys), tuple(build_keys), residual,
+                          build_unique, output, null_aware=null_aware)
 
     def plan_left_join(self, left: PlannedRelation, right: PlannedRelation,
                        condition: Optional[A.Node]) -> PlannedRelation:
@@ -639,6 +662,16 @@ class Planner:
         lj = self.join_pair(left, right, conjuncts, kind="left")
         if conjuncts:
             raise AnalysisError("non-equi FULL JOIN condition unsupported")
+        # the left-join output may carry appended $jk alignment columns;
+        # project back to the visible (left ++ right) layout for the union
+        n_vis = len(left.node.output) + len(right.node.output)
+        lj_node: L.PlanNode = lj.node
+        if len(lj_node.output) != n_vis:
+            lj_node = L.ProjectNode(
+                lj_node,
+                tuple(ir.ColumnRef(i, dt)
+                      for i, (_, dt) in enumerate(lj_node.output[:n_vis])),
+                tuple(lj_node.output[:n_vis]))
         # right rows with no left match (anti join, probe = right)
         conj2: List[A.Node] = []
         if condition is not None:
@@ -659,17 +692,19 @@ class Planner:
             if rb is not None and la is not None:
                 rk.append(rb.index)
                 lk.append(la.index)
-        anti = L.JoinNode("anti", right.node, left.node, tuple(rk),
-                          tuple(lk), None, False,
-                          tuple(right.node.output))
+        anti = self.make_join(
+            "anti", right.node, left.node, tuple(rk), tuple(lk), None,
+            False,
+            probe_fields=[self._scope_field(right.scope, i) for i in rk],
+            build_fields=[self._scope_field(left.scope, i) for i in lk])
         pad_exprs = tuple(
             [ir.Literal(None, dt) for _, dt in left.node.output] +
             [ir.ColumnRef(i, dt)
              for i, (_, dt) in enumerate(right.node.output)])
-        pad = L.ProjectNode(anti, pad_exprs, lj.node.output)
-        none_maps = (None,) * len(lj.node.output)
-        full = L.SetOpNode("union_all", lj.node, pad, none_maps, none_maps,
-                           lj.node.output)
+        pad = L.ProjectNode(anti, pad_exprs, lj_node.output)
+        none_maps = (None,) * len(lj_node.output)
+        full = L.SetOpNode("union_all", lj_node, pad, none_maps,
+                           none_maps, lj_node.output)
         return PlannedRelation(full, lj.scope)
 
     def is_unique(self, rel: PlannedRelation, key_indices: List[int]) -> bool:
@@ -1083,7 +1118,8 @@ class Planner:
         return current, slots, fields
 
     def field_for(self, e: ir.Expr, scope: Scope):
-        """Propagate dictionary fields through bare column projections."""
+        """Propagate dictionary fields through bare column projections,
+        and through CASE when every branch shares one pool."""
         if isinstance(e, ir.DerivedDict):
             return Field("$derived", e.dtype, dictionary=e.pool)
         if isinstance(e, ir.Literal) and e.dtype is not None and \
@@ -1094,6 +1130,15 @@ class Planner:
             for c in scope.columns:
                 if c.index == e.index and c.dtype.kind is TypeKind.VARCHAR:
                     return c.field
+        if isinstance(e, ir.Case) and e.dtype.kind is TypeKind.VARCHAR:
+            branches = [v for _, v in e.whens]
+            if e.default is not None:
+                branches.append(e.default)
+            fields = [self.field_for(b, scope) for b in branches]
+            pools = {f.dictionary for f in fields if f is not None}
+            if len(fields) == len(branches) and len(pools) == 1 and \
+                    all(f is not None for f in fields):
+                return fields[0]
         return None
 
     # ---- aggregation ------------------------------------------------------
@@ -1464,11 +1509,13 @@ class Planner:
                      for x in residual_asts]
             residual = preds[0] if len(preds) == 1 else ir.Logical(
                 "and", tuple(preds))
-        node = L.JoinNode("anti" if negated else "semi",
-                          outer.node, inner.node,
-                          tuple(o for o, _ in corr),
-                          tuple(c.index for _, c in corr),
-                          residual, False, tuple(outer.node.output))
+        node = self.make_join(
+            "anti" if negated else "semi", outer.node, inner.node,
+            tuple(o for o, _ in corr), tuple(c.index for _, c in corr),
+            residual, False,
+            probe_fields=[self._scope_field(outer.scope, o)
+                          for o, _ in corr],
+            build_fields=[c.field for _, c in corr])
         return PlannedRelation(node, outer.scope)
 
     def plan_in_subquery(self, outer: PlannedRelation,
@@ -1486,11 +1533,9 @@ class Planner:
         lowerer = ExpressionLowerer(outer.scope, planner=self)
         key = lowerer.lower(c.arg)
         probe = outer
-        if isinstance(key, ir.DerivedDict):
-            # derived codes are private to this column's pool; matching
-            # them against another relation's codes would be meaningless
-            raise AnalysisError(
-                "IN subquery on a string expression is unsupported")
+        # capture the key's dictionary BEFORE any probe extension: a
+        # computed key's field is derivable only from the expression
+        key_field = self.field_for(key, outer.scope)
         if not isinstance(key, ir.ColumnRef):
             # extend the probe with a computed key column (hidden)
             exprs = [ir.ColumnRef(i, t, n) for i, (n, t)
@@ -1499,30 +1544,17 @@ class Planner:
             probe = PlannedRelation(
                 L.ProjectNode(outer.node, tuple(exprs), out), outer.scope)
             key = ir.ColumnRef(len(out) - 1, key.dtype)
-        if key.dtype.kind is TypeKind.VARCHAR:
-            # dictionary alignment (see align_varchar_join_keys)
-            lfld = self.field_for(key, outer.scope)
-            sub_col = sub.scope.columns[0]
-            lpool = lfld.dictionary if lfld else None
-            rpool = sub_col.field.dictionary if sub_col.field else None
-            if lpool is not None and rpool is not None and lpool != rpool:
-                index = {s: j for j, s in enumerate(lpool)}
-                lut = tuple(index.get(s, -1) for s in rpool)
-                build_node = L.ProjectNode(
-                    build_node,
-                    (ir.DictValueMap(ir.ColumnRef(0, sub_col.dtype), lut,
-                                     BIGINT),),
-                    (("$inkey", BIGINT),))
         if c.negated:
             # NULL probe keys can never satisfy NOT IN
             probe = PlannedRelation(
                 L.FilterNode(probe.node, ir.IsNull(key, negated=True),
                              probe.node.output), probe.scope)
-        node = L.JoinNode("anti" if c.negated else "semi",
-                          probe.node, build_node,
-                          (key.index,), (0,), None, False,
-                          tuple(probe.node.output),
-                          null_aware=c.negated)
+        node = self.make_join(
+            "anti" if c.negated else "semi", probe.node, build_node,
+            (key.index,), (0,), None, False,
+            probe_fields=[key_field],
+            build_fields=[sub.scope.columns[0].field],
+            null_aware=c.negated)
         return PlannedRelation(node, outer.scope)
 
     def plan_correlated_scalar(self, outer: PlannedRelation, op: str,
@@ -1559,10 +1591,14 @@ class Planner:
         agg_rel, _, _ = self.plan_aggregation(synth, inner)
 
         k = len(corr)
-        out = tuple(outer.node.output) + tuple(agg_rel.node.output)
-        join = L.JoinNode("inner", outer.node, agg_rel.node,
-                          tuple(o for o, _ in corr), tuple(range(k)),
-                          None, True, out)
+        join = self.make_join(
+            "inner", outer.node, agg_rel.node,
+            tuple(o for o, _ in corr), tuple(range(k)), None, True,
+            probe_fields=[self._scope_field(outer.scope, o)
+                          for o, _ in corr],
+            build_fields=[agg_rel.scope.columns[i].field
+                          for i in range(k)])
+        out = join.output
         n_outer = len(outer.node.output)
         val_name, val_t = agg_rel.node.output[k]
         val_ref = ir.ColumnRef(n_outer + k, val_t, val_name)
