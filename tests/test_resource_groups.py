@@ -1,0 +1,81 @@
+"""Resource group admission tests (InternalResourceGroup semantics)."""
+
+import threading
+import time
+
+import pytest
+
+from trino_tpu.server.resourcegroups import (QueryQueueFullError,
+                                             ResourceGroupConfig,
+                                             ResourceGroupManager,
+                                             Selector)
+
+
+def test_concurrency_limit_and_queue():
+    rgm = ResourceGroupManager(
+        ResourceGroupConfig("root", hard_concurrency_limit=1,
+                            max_queued=10))
+    order = []
+    release = threading.Event()
+
+    def slow():
+        order.append("first-started")
+
+    def queued():
+        order.append("second-started")
+
+    rgm.submit("u", slow)          # runs immediately, holds the slot
+    rgm.submit("u", queued)        # must queue
+    assert order == ["first-started"]
+    assert rgm.info()[0]["queued"] == 1
+    nxt = rgm.finished("root")
+    assert nxt is not None
+    nxt()
+    assert order == ["first-started", "second-started"]
+
+
+def test_queue_full_rejects():
+    rgm = ResourceGroupManager(
+        ResourceGroupConfig("root", hard_concurrency_limit=1,
+                            max_queued=1))
+    rgm.submit("u", lambda: None)       # occupies the slot
+    rgm.submit("u", lambda: None)       # queues
+    with pytest.raises(QueryQueueFullError):
+        rgm.submit("u", lambda: None)
+
+
+def test_selectors_and_subgroups():
+    rgm = ResourceGroupManager(
+        ResourceGroupConfig("root", hard_concurrency_limit=10,
+                            sub_groups=(
+                                ResourceGroupConfig(
+                                    "etl", hard_concurrency_limit=1),
+                                ResourceGroupConfig(
+                                    "adhoc", hard_concurrency_limit=2))),
+        selectors=[Selector("etl_.*", "root.etl"),
+                   Selector(".*", "root.adhoc")])
+    assert rgm.select("etl_nightly").path == "root.etl"
+    assert rgm.select("alice").path == "root.adhoc"
+    # parent accounting: etl admission consumes root headroom too
+    rgm.submit("etl_nightly", lambda: None)
+    info = {g["group"]: g for g in rgm.info()}
+    assert info["root.etl"]["running"] == 1
+    assert info["root"]["running"] == 1
+
+
+def test_coordinator_resource_group_endpoint():
+    from trino_tpu.client.client import Client
+    from trino_tpu.exec.session import Session
+    from trino_tpu.server.coordinator import CoordinatorServer
+    coord = CoordinatorServer(Session(default_schema="tiny")).start()
+    try:
+        client = Client(coord.uri, user="rg")
+        client.execute("SELECT 1")
+        import json
+        from urllib.request import urlopen
+        with urlopen(f"{coord.uri}/v1/resourceGroup", timeout=5) as r:
+            info = json.loads(r.read())
+        assert info[0]["group"] == "root"
+        assert info[0]["totalAdmitted"] >= 1
+    finally:
+        coord.stop()

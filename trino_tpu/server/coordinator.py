@@ -76,6 +76,11 @@ class Dispatcher:
         self.max_retries = max_retries
         from ..events import EventListenerManager
         self.event_listeners = EventListenerManager()
+        from .resourcegroups import (ResourceGroupConfig,
+                                     ResourceGroupManager)
+        self.resource_groups = ResourceGroupManager(
+            ResourceGroupConfig("root",
+                                hard_concurrency_limit=max_concurrency))
 
     def submit(self, sql: str, user: str) -> TrackedQuery:
         qid = self.tracker.next_query_id()
@@ -85,8 +90,23 @@ class Dispatcher:
         tq.state_machine.add_listener(
             lambda state: self.event_listeners.query_completed(tq)
             if state in ("FINISHED", "FAILED", "CANCELED") else None)
-        self.pool.submit(self._run, tq)
+        from .resourcegroups import QueryQueueFullError
+        try:
+            self.resource_groups.submit(
+                tq.session_user,
+                lambda: self.pool.submit(self._run_admitted, tq))
+        except QueryQueueFullError as e:
+            tq.state_machine.fail(str(e))
         return tq
+
+    def _run_admitted(self, tq: TrackedQuery) -> None:
+        group_path = self.resource_groups.select(tq.session_user).path
+        try:
+            self._run(tq)
+        finally:
+            nxt = self.resource_groups.finished(group_path)
+            if nxt is not None:
+                nxt()
 
     def _run(self, tq: TrackedQuery) -> None:
         sm = tq.state_machine
@@ -269,6 +289,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if path == "/v1/status":
             self._send(200, {"nodeId": "coordinator", "state": "ACTIVE"})
+            return
+        if path == "/v1/resourceGroup":
+            self._send(200, self.state.dispatcher.resource_groups.info())
             return
         if path == "/v1/node":
             nodes = [{"nodeId": n.node_id, "uri": n.uri, "state": n.state}

@@ -1,0 +1,145 @@
+"""Hierarchical resource groups — admission control.
+
+Reference: execution/resourcegroups/InternalResourceGroup.java:76 —
+groups form a tree; each group has a hard concurrency limit and a queue
+bound; selectors route queries to groups by user; FIFO within a group.
+Config is pluggable in the reference (file/DB managers,
+plugin/trino-resource-group-managers) — here a plain dataclass tree.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+class QueryQueueFullError(RuntimeError):
+    pass
+
+
+@dataclass
+class ResourceGroupConfig:
+    name: str
+    hard_concurrency_limit: int = 4
+    max_queued: int = 100
+    sub_groups: tuple = ()
+
+
+@dataclass
+class Selector:
+    user_pattern: str             # regex over the session user
+    group: str                    # dot path, e.g. "root.adhoc"
+
+
+class ResourceGroup:
+    def __init__(self, config: ResourceGroupConfig,
+                 parent: Optional["ResourceGroup"] = None):
+        self.config = config
+        self.parent = parent
+        self.running = 0
+        self.queue: deque = deque()
+        self.sub_groups: Dict[str, ResourceGroup] = {
+            sub.name: ResourceGroup(sub, self)
+            for sub in config.sub_groups}
+        self.stats_total_admitted = 0
+        self.stats_peak_queued = 0
+
+    @property
+    def path(self) -> str:
+        return self.config.name if self.parent is None else \
+            f"{self.parent.path}.{self.config.name}"
+
+    def can_run(self) -> bool:
+        """A query may start when every group up the chain has headroom
+        (the reference's canRunMore walk)."""
+        g: Optional[ResourceGroup] = self
+        while g is not None:
+            if g.running >= g.config.hard_concurrency_limit:
+                return False
+            g = g.parent
+        return True
+
+    def acquire(self) -> None:
+        g: Optional[ResourceGroup] = self
+        while g is not None:
+            g.running += 1
+            g = g.parent
+        self.stats_total_admitted += 1
+
+    def release(self) -> None:
+        g: Optional[ResourceGroup] = self
+        while g is not None:
+            g.running = max(0, g.running - 1)
+            g = g.parent
+
+
+class ResourceGroupManager:
+    """Routes queries to leaf groups and gates execution: run now, queue,
+    or reject (Too many queued queries)."""
+
+    def __init__(self, root: ResourceGroupConfig,
+                 selectors: Optional[List[Selector]] = None):
+        self.root = ResourceGroup(root)
+        self.selectors = selectors or []
+        self._lock = threading.Lock()
+
+    def _find(self, path: str) -> ResourceGroup:
+        parts = path.split(".")
+        g = self.root
+        assert parts[0] == self.root.config.name, path
+        for p in parts[1:]:
+            g = g.sub_groups[p]
+        return g
+
+    def select(self, user: str) -> ResourceGroup:
+        for sel in self.selectors:
+            if re.fullmatch(sel.user_pattern, user):
+                return self._find(sel.group)
+        return self.root
+
+    def submit(self, user: str, run: Callable[[], None]) -> str:
+        """Admit or queue `run`; returns the chosen group path. Raises
+        QueryQueueFullError past the queue bound."""
+        with self._lock:
+            group = self.select(user)
+            if group.can_run():
+                group.acquire()
+                to_run = run
+            elif len(group.queue) < group.config.max_queued:
+                group.queue.append(run)
+                group.stats_peak_queued = max(group.stats_peak_queued,
+                                              len(group.queue))
+                return group.path
+            else:
+                raise QueryQueueFullError(
+                    f"Too many queued queries for {group.path!r}")
+        to_run()
+        return group.path
+
+    def finished(self, group_path: str) -> Optional[Callable[[], None]]:
+        """Release a slot; returns the next queued query to start (the
+        caller runs it outside the lock), if any."""
+        with self._lock:
+            group = self._find(group_path)
+            group.release()
+            if group.queue and group.can_run():
+                group.acquire()
+                return group.queue.popleft()
+        return None
+
+    def info(self) -> List[dict]:
+        out = []
+
+        def walk(g: ResourceGroup):
+            out.append({"group": g.path, "running": g.running,
+                        "queued": len(g.queue),
+                        "hardConcurrencyLimit":
+                            g.config.hard_concurrency_limit,
+                        "totalAdmitted": g.stats_total_admitted})
+            for sub in g.sub_groups.values():
+                walk(sub)
+        walk(self.root)
+        return out
