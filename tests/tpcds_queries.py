@@ -433,3 +433,169 @@ GROUP BY i_item_id, i_category, i_class, i_current_price
 ORDER BY i_category, i_class, i_item_id, revenueratio
 LIMIT 100
 """
+
+# q33: manufacturer revenue across all three channels for one category
+QUERIES[33] = """
+WITH ss AS (
+  SELECT i_manufact_id, sum(ss_ext_sales_price) total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category = 'Electronics')
+    AND ss_item_sk = i_item_sk
+    AND ss_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 5
+    AND ss_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -600
+  GROUP BY i_manufact_id),
+cs AS (
+  SELECT i_manufact_id, sum(cs_ext_sales_price) total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category = 'Electronics')
+    AND cs_item_sk = i_item_sk
+    AND cs_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 5
+    AND cs_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -600
+  GROUP BY i_manufact_id),
+ws AS (
+  SELECT i_manufact_id, sum(ws_ext_sales_price) total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category = 'Electronics')
+    AND ws_item_sk = i_item_sk
+    AND ws_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 5
+    AND ws_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -600
+  GROUP BY i_manufact_id)
+SELECT i_manufact_id, sum(total_sales) total_sales
+FROM (SELECT * FROM ss UNION ALL
+      SELECT * FROM cs UNION ALL
+      SELECT * FROM ws) tmp1
+GROUP BY i_manufact_id
+ORDER BY total_sales, i_manufact_id
+LIMIT 100
+"""
+
+# q48: quantity sold under demographic/address OR-band predicates
+QUERIES[48] = """
+SELECT sum(ss_quantity) q
+FROM store_sales, store, customer_demographics, customer_address,
+     date_dim
+WHERE s_store_sk = ss_store_sk
+  AND ss_sold_date_sk = d_date_sk
+  AND d_year = 2000
+  AND ((cd_demo_sk = ss_cdemo_sk
+        AND cd_marital_status = 'M'
+        AND cd_education_status = '4 yr Degree'
+        AND ss_sales_price BETWEEN 100.00 AND 150.00)
+    OR (cd_demo_sk = ss_cdemo_sk
+        AND cd_marital_status = 'D'
+        AND cd_education_status = '2 yr Degree'
+        AND ss_sales_price BETWEEN 50.00 AND 100.00)
+    OR (cd_demo_sk = ss_cdemo_sk
+        AND cd_marital_status = 'S'
+        AND cd_education_status = 'College'
+        AND ss_sales_price BETWEEN 150.00 AND 200.00))
+  AND ((ss_addr_sk = ca_address_sk
+        AND ca_country = 'United States'
+        AND ca_state IN ('TX', 'OH', 'KS')
+        AND ss_net_profit BETWEEN 0 AND 2000)
+    OR (ss_addr_sk = ca_address_sk
+        AND ca_country = 'United States'
+        AND ca_state IN ('CA', 'NY', 'WA')
+        AND ss_net_profit BETWEEN 150 AND 3000)
+    OR (ss_addr_sk = ca_address_sk
+        AND ca_country = 'United States'
+        AND ca_state IN ('GA', 'MN', 'NC')
+        AND ss_net_profit BETWEEN 50 AND 25000))
+"""
+
+# q56: color-item revenue across the three channels
+QUERIES[56] = """
+WITH ss AS (
+  SELECT i_item_id, sum(ss_ext_sales_price) total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('azure', 'burlywood', 'chiffon'))
+    AND ss_item_sk = i_item_sk
+    AND ss_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 2
+    AND ss_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -600
+  GROUP BY i_item_id),
+cs AS (
+  SELECT i_item_id, sum(cs_ext_sales_price) total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('azure', 'burlywood', 'chiffon'))
+    AND cs_item_sk = i_item_sk
+    AND cs_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 2
+    AND cs_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -600
+  GROUP BY i_item_id),
+ws AS (
+  SELECT i_item_id, sum(ws_ext_sales_price) total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('azure', 'burlywood', 'chiffon'))
+    AND ws_item_sk = i_item_sk
+    AND ws_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 2
+    AND ws_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -600
+  GROUP BY i_item_id)
+SELECT i_item_id, sum(total_sales) total_sales
+FROM (SELECT * FROM ss UNION ALL
+      SELECT * FROM cs UNION ALL
+      SELECT * FROM ws) tmp1
+GROUP BY i_item_id
+ORDER BY total_sales, i_item_id
+LIMIT 100
+"""
+
+# q60: category-item revenue across the three channels
+QUERIES[60] = """
+WITH ss AS (
+  SELECT i_item_id, sum(ss_ext_sales_price) total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category = 'Music')
+    AND ss_item_sk = i_item_sk
+    AND ss_sold_date_sk = d_date_sk
+    AND d_year = 2000 AND d_moy = 9
+    AND ss_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -600
+  GROUP BY i_item_id),
+cs AS (
+  SELECT i_item_id, sum(cs_ext_sales_price) total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category = 'Music')
+    AND cs_item_sk = i_item_sk
+    AND cs_sold_date_sk = d_date_sk
+    AND d_year = 2000 AND d_moy = 9
+    AND cs_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -600
+  GROUP BY i_item_id),
+ws AS (
+  SELECT i_item_id, sum(ws_ext_sales_price) total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category = 'Music')
+    AND ws_item_sk = i_item_sk
+    AND ws_sold_date_sk = d_date_sk
+    AND d_year = 2000 AND d_moy = 9
+    AND ws_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -600
+  GROUP BY i_item_id)
+SELECT i_item_id, sum(total_sales) total_sales
+FROM (SELECT * FROM ss UNION ALL
+      SELECT * FROM cs UNION ALL
+      SELECT * FROM ws) tmp1
+GROUP BY i_item_id
+ORDER BY total_sales, i_item_id
+LIMIT 100
+"""

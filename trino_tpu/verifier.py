@@ -142,6 +142,13 @@ def _load_sqlite(datasets) -> sqlite3.Connection:
         rows = list(zip(*[c.tolist() for c in host_cols]))
         ph = ", ".join("?" * len(t.schema))
         conn.executemany(f"INSERT INTO {t.name} VALUES ({ph})", rows)
+        # surrogate-key indexes keep sqlite's nested-loop plans tractable
+        # on star-join benchmark queries
+        for f in t.schema:
+            if f.name.endswith("_sk") or f.name.endswith("key"):
+                conn.execute(f"CREATE INDEX IF NOT EXISTS "
+                             f"idx_{t.name}_{f.name} ON {t.name}({f.name})")
+    conn.execute("ANALYZE")
     conn.commit()
     return conn
 
@@ -182,7 +189,15 @@ def main(argv=None) -> int:
     ap.add_argument("--suite", choices=["tpch", "tpcds"])
     ap.add_argument("--execute", "-e", help="verify one statement")
     ap.add_argument("--schema", default="tiny")
+    ap.add_argument("--platform", choices=["cpu", "tpu"],
+                    help="force a JAX platform (env vars are overridden "
+                         "by accelerator tunnels; the config API wins)")
     args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms",
+                          "cpu" if args.platform == "cpu" else None)
 
     if args.suite == "tpcds":
         from .connectors.tpcds.connector import TABLE_NAMES
