@@ -1,0 +1,59 @@
+"""TIMESTAMP type tests (micros since epoch; Trino timestamp(6) layout)."""
+
+import pytest
+
+from trino_tpu.exec.session import Session
+
+
+@pytest.fixture()
+def session():
+    return Session(default_cat="memory", default_schema="default")
+
+
+def test_timestamp_ddl_literals_compare(session):
+    session.execute("CREATE TABLE ev (name varchar, at timestamp)")
+    session.execute("""
+        INSERT INTO ev VALUES
+          ('a', TIMESTAMP '2024-01-15 08:30:00'),
+          ('b', TIMESTAMP '2024-01-15 19:45:30'),
+          ('c', TIMESTAMP '2024-02-01 00:00:00'),
+          ('d', NULL)""")
+    rows = session.execute(
+        "SELECT name, at FROM ev "
+        "WHERE at >= TIMESTAMP '2024-01-15 12:00:00' ORDER BY at").rows
+    assert [r[0] for r in rows] == ["b", "c"]
+    assert rows[0][1] == "2024-01-15 19:45:30"
+
+
+def test_timestamp_extract_and_functions(session):
+    session.execute("CREATE TABLE t2 (at timestamp)")
+    session.execute(
+        "INSERT INTO t2 VALUES (TIMESTAMP '2023-07-04 13:05:59')")
+    rows = session.execute("""
+        SELECT EXTRACT(YEAR FROM at), EXTRACT(MONTH FROM at),
+               EXTRACT(DAY FROM at), EXTRACT(HOUR FROM at),
+               minute(at), second(at), CAST(at AS date)
+        FROM t2""").rows
+    assert rows == [(2023, 7, 4, 13, 5, 59, "2023-07-04")]
+
+
+def test_date_to_timestamp_comparison(session):
+    session.execute("CREATE TABLE t3 (d date, at timestamp)")
+    session.execute("INSERT INTO t3 VALUES "
+                    "(DATE '2024-03-01', TIMESTAMP '2024-03-01 10:00:00')")
+    rows = session.execute(
+        "SELECT count(*) FROM t3 WHERE at > d").rows
+    assert rows == [(1,)]
+
+
+def test_timestamp_aggregates_and_sort(session):
+    session.execute("CREATE TABLE t4 (g bigint, at timestamp)")
+    session.execute("""
+        INSERT INTO t4 VALUES
+          (1, TIMESTAMP '2024-01-01 01:00:00'),
+          (1, TIMESTAMP '2024-01-02 02:00:00'),
+          (2, TIMESTAMP '2024-01-03 03:00:00')""")
+    rows = session.execute(
+        "SELECT g, min(at), max(at) FROM t4 GROUP BY g ORDER BY g").rows
+    assert rows[0] == (1, "2024-01-01 01:00:00", "2024-01-02 02:00:00")
+    assert rows[1][0] == 2

@@ -192,6 +192,10 @@ def decode_column(field: Field, data: np.ndarray, valid: np.ndarray) -> list:
             out.append(bool(x))
         elif kind is TypeKind.DATE:
             out.append((epoch + datetime.timedelta(days=int(x))).isoformat())
+        elif kind is TypeKind.TIMESTAMP:
+            base = datetime.datetime(1970, 1, 1)
+            out.append((base + datetime.timedelta(
+                microseconds=int(x))).isoformat(sep=" "))
         else:
             out.append(int(x))
     return out

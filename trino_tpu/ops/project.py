@@ -53,6 +53,8 @@ def _to_comparable(expr: ir.Expr, data: jax.Array, target) -> jax.Array:
         if t.kind is TypeKind.DECIMAL:
             return data.astype(jnp.float64) / (10 ** t.scale)
         return data.astype(jnp.float64)
+    if target.kind is TypeKind.TIMESTAMP and t.kind is TypeKind.DATE:
+        return data.astype(jnp.int64) * 86_400_000_000
     return data
 
 
@@ -263,7 +265,13 @@ def eval_expr(expr: ir.Expr, batch: Batch):
                 return rescale(d, src.scale, 0).astype(dst.np_dtype), v
             return d.astype(dst.np_dtype), v
         if dst.kind is TypeKind.DATE:
+            if src.kind is TypeKind.TIMESTAMP:
+                return (d // 86_400_000_000).astype(jnp.int32), v
             return d.astype(jnp.int32), v
+        if dst.kind is TypeKind.TIMESTAMP:
+            if src.kind is TypeKind.DATE:
+                return d.astype(jnp.int64) * 86_400_000_000, v
+            return d.astype(jnp.int64), v
         raise NotImplementedError(f"cast {src} -> {dst}")
 
     if isinstance(expr, ir.DerivedDict):
@@ -287,6 +295,17 @@ def eval_expr(expr: ir.Expr, batch: Batch):
 
     if isinstance(expr, ir.ExtractField):
         d, v = eval_expr(expr.arg, batch)
+        if expr.arg.dtype.kind is TypeKind.TIMESTAMP:
+            micros_in_day = 86_400_000_000
+            days = d // micros_in_day
+            rem = d - days * micros_in_day
+            if expr.part == 'hour':
+                return rem // 3_600_000_000, v
+            if expr.part == 'minute':
+                return (rem // 60_000_000) % 60, v
+            if expr.part == 'second':
+                return (rem // 1_000_000) % 60, v
+            d = days
         year, month, day = civil_from_days(d)
         res = {'year': year, 'month': month, 'day': day}[expr.part]
         return res.astype(jnp.int64), v

@@ -131,6 +131,14 @@ def date_literal(iso: str) -> ir.Literal:
     return ir.Literal((d - EPOCH).days, DATE)
 
 
+def timestamp_literal(text: str) -> ir.Literal:
+    from ..types import TIMESTAMP
+    dt = datetime.datetime.fromisoformat(text)
+    epoch = datetime.datetime(1970, 1, 1)
+    micros = int((dt - epoch).total_seconds() * 1_000_000)
+    return ir.Literal(micros, TIMESTAMP)
+
+
 def add_months(d: datetime.date, n: int) -> datetime.date:
     y, m0 = divmod(d.year * 12 + d.month - 1 + n, 12)
     last = [31, 29 if y % 4 == 0 and (y % 100 != 0 or y % 400 == 0) else 28,
@@ -214,6 +222,8 @@ class ExpressionLowerer:
             return ir.Literal(None, BIGINT)
         if isinstance(node, A.DateLit):
             return date_literal(node.value)
+        if isinstance(node, A.TimestampLit):
+            return timestamp_literal(node.value)
         if isinstance(node, A.IntervalLit):
             raise AnalysisError(
                 "INTERVAL literal only supported in date +/- INTERVAL")
@@ -295,8 +305,13 @@ class ExpressionLowerer:
 
         if isinstance(node, A.ExtractExpr):
             arg = self.lower(node.arg)
-            if arg.dtype.kind is not TypeKind.DATE:
-                raise AnalysisError("EXTRACT requires a date argument")
+            if arg.dtype.kind not in (TypeKind.DATE, TypeKind.TIMESTAMP):
+                raise AnalysisError(
+                    "EXTRACT requires a date or timestamp argument")
+            if node.part in ("hour", "minute", "second") and \
+                    arg.dtype.kind is not TypeKind.TIMESTAMP:
+                raise AnalysisError(
+                    f"EXTRACT({node.part}) requires a timestamp")
             return ir.ExtractField(node.part, arg)
 
         if isinstance(node, A.FunctionCall):
@@ -362,8 +377,14 @@ class ExpressionLowerer:
         if name == "concat":
             return self.lower_concat(args)
         if name in ("year", "month", "day"):
-            if len(args) != 1 or args[0].dtype.kind is not TypeKind.DATE:
+            if len(args) != 1 or args[0].dtype.kind not in (
+                    TypeKind.DATE, TypeKind.TIMESTAMP):
                 raise AnalysisError(f"{name} requires a date argument")
+            return ir.ExtractField(name, args[0])
+        if name in ("hour", "minute", "second"):
+            if len(args) != 1 or \
+                    args[0].dtype.kind is not TypeKind.TIMESTAMP:
+                raise AnalysisError(f"{name} requires a timestamp")
             return ir.ExtractField(name, args[0])
 
         # -- numeric / conditional ----------------------------------------
@@ -634,6 +655,9 @@ def parse_type(name: str) -> DataType:
         return BOOLEAN
     if name == "date":
         return DATE
+    if name == "timestamp":
+        from ..types import TIMESTAMP
+        return TIMESTAMP
     m = re.fullmatch(r"decimal\((\d+),(\d+)\)", name)
     if m:
         return decimal(int(m.group(1)), int(m.group(2)))

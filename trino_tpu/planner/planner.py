@@ -128,6 +128,9 @@ class Planner:
             return ir.Literal(None, None)
         if isinstance(node, A.DateLit):
             return date_literal(node.value)
+        if isinstance(node, A.TimestampLit):
+            from .analyzer import timestamp_literal
+            return timestamp_literal(node.value)
         if isinstance(node, A.UnaryOp) and node.op == "-":
             lit = self.eval_const_ast(node.arg)
             if lit.value is None:

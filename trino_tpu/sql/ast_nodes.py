@@ -48,6 +48,11 @@ class DateLit(Node):
 
 
 @dataclass(frozen=True)
+class TimestampLit(Node):
+    value: str                      # ISO yyyy-mm-dd hh:mm:ss
+
+
+@dataclass(frozen=True)
 class IntervalLit(Node):
     value: int
     unit: str                       # 'year' | 'month' | 'day'

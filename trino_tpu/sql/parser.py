@@ -626,6 +626,11 @@ class Parser:
             if s.kind != "string":
                 self.fail("DATE expects a string literal")
             return A.DateLit(s.text)
+        if self.accept_kw("TIMESTAMP"):
+            s = self.advance()
+            if s.kind != "string":
+                self.fail("TIMESTAMP expects a string literal")
+            return A.TimestampLit(s.text)
         if self.accept_kw("INTERVAL"):
             neg = False
             if self.accept_op("-"):
@@ -651,7 +656,8 @@ class Parser:
             self.expect_op("(")
             part_t = self.advance()
             part = part_t.text.lower()
-            if part not in ("year", "month", "day"):
+            if part not in ("year", "month", "day", "hour", "minute",
+                            "second"):
                 self.fail(f"unsupported EXTRACT part {part_t.raw!r}")
             self.expect_kw("FROM")
             e = self.parse_expr()
@@ -771,7 +777,7 @@ class Parser:
             self.fail("expected type name")
         name = t.text.lower()
         if name in ("double", "bigint", "integer", "int", "boolean", "date",
-                    "varchar", "real", "smallint", "tinyint"):
+                    "timestamp", "varchar", "real", "smallint", "tinyint"):
             if name == "double" and self.accept_kw("PRECISION"):
                 pass
             return "double" if name == "real" else name

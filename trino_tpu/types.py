@@ -39,6 +39,7 @@ class TypeKind(enum.Enum):
     DOUBLE = "double"
     BOOLEAN = "boolean"
     DATE = "date"
+    TIMESTAMP = "timestamp"
     DECIMAL = "decimal"
     VARCHAR = "varchar"
 
@@ -66,6 +67,7 @@ class DataType:
             TypeKind.DOUBLE: np.dtype(np.float64),
             TypeKind.BOOLEAN: np.dtype(np.bool_),
             TypeKind.DATE: np.dtype(np.int32),
+            TypeKind.TIMESTAMP: np.dtype(np.int64),   # micros since epoch
             TypeKind.DECIMAL: np.dtype(np.int64),
             TypeKind.VARCHAR: np.dtype(np.int32),  # dictionary codes
         }[self.kind]
@@ -77,7 +79,8 @@ class DataType:
     @property
     def is_integerlike(self) -> bool:
         return self.kind in (TypeKind.BIGINT, TypeKind.INTEGER, TypeKind.DATE,
-                             TypeKind.DECIMAL, TypeKind.VARCHAR)
+                             TypeKind.TIMESTAMP, TypeKind.DECIMAL,
+                             TypeKind.VARCHAR)
 
     def __repr__(self) -> str:
         if self.kind is TypeKind.DECIMAL:
@@ -90,6 +93,7 @@ INTEGER = DataType(TypeKind.INTEGER)
 DOUBLE = DataType(TypeKind.DOUBLE)
 BOOLEAN = DataType(TypeKind.BOOLEAN)
 DATE = DataType(TypeKind.DATE)
+TIMESTAMP = DataType(TypeKind.TIMESTAMP)
 VARCHAR = DataType(TypeKind.VARCHAR)
 
 
@@ -119,4 +123,9 @@ def common_super_type(a: DataType, b: DataType) -> DataType:
         return BIGINT
     if TypeKind.DATE in kinds and kinds & {TypeKind.BIGINT, TypeKind.INTEGER}:
         return DATE  # date +/- integer days
+    if kinds == {TypeKind.TIMESTAMP, TypeKind.DATE}:
+        return TIMESTAMP
+    if TypeKind.TIMESTAMP in kinds and \
+            kinds & {TypeKind.BIGINT, TypeKind.INTEGER}:
+        return TIMESTAMP
     raise TypeError(f"no common type for {a} and {b}")
