@@ -127,3 +127,19 @@ def test_cli_render_and_local_backend(capsys):
     render_table(columns, rows)
     out = capsys.readouterr().out
     assert "ALGERIA" in out and "(2 rows)" in out
+
+
+def test_spooled_result_protocol(cluster):
+    """Spooled protocol: big results arrive as fetch/ack segments
+    (spi/spool + spooling-filesystem role)."""
+    coord, _, _ = cluster
+    spooled = Client(coord.uri, user="spool", spooled=True)
+    r = spooled.execute("SELECT o_orderkey FROM orders")
+    assert len(r.rows) == 15000
+    assert coord.state.spooling.segments_written >= 3
+    # acked segments are deleted from the spool directory
+    import os
+    assert os.listdir(coord.state.spooling.directory) == []
+    # small results stay inline even for spooled clients
+    r2 = spooled.execute("SELECT 1")
+    assert r2.rows == [[1]] or r2.rows == [(1,)]

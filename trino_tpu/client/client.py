@@ -32,17 +32,21 @@ class ClientResult:
 
 class Client:
     def __init__(self, uri: str, user: str = "anonymous",
-                 poll_interval_s: float = 0.05, timeout_s: float = 300.0):
+                 poll_interval_s: float = 0.05, timeout_s: float = 300.0,
+                 spooled: bool = False):
         self.uri = uri.rstrip("/")
         self.user = user
         self.poll_interval_s = poll_interval_s
         self.timeout_s = timeout_s
+        self.spooled = spooled     # opt into the spooled result protocol
 
     def _request(self, method: str, url: str,
                  body: Optional[bytes] = None) -> dict:
-        req = Request(url, data=body, method=method,
-                      headers={"X-Trino-User": self.user,
-                               "Content-Type": "text/plain"})
+        headers = {"X-Trino-User": self.user,
+                   "Content-Type": "text/plain"}
+        if self.spooled:
+            headers["X-Trino-Spooled"] = "true"
+        req = Request(url, data=body, method=method, headers=headers)
         with urlopen(req, timeout=30) as resp:
             payload = resp.read()
         return json.loads(payload) if payload else {}
@@ -63,6 +67,11 @@ class Client:
                 columns = [c["name"] for c in doc["columns"]]
             if "data" in doc:
                 rows.extend(doc["data"])
+            for seg in doc.get("segments", ()):
+                # spooled protocol: fetch each segment, then acknowledge
+                sdoc = self._request("GET", seg["uri"])
+                rows.extend(sdoc["data"])
+                self._request("DELETE", seg["uri"])
             next_uri = doc.get("nextUri")
             if next_uri is None:
                 return ClientResult(

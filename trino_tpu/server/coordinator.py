@@ -156,6 +156,8 @@ class CoordinatorState:
         self.nodes: Dict[str, RegisteredNode] = {}
         self.nodes_lock = threading.Lock()
         self.started_at = time.time()
+        from .spooling import SpoolingManager
+        self.spooling = SpoolingManager()
         # system.runtime.{queries,nodes} backed by this coordinator's state
         from .system_connector import SystemConnector
         session.catalog.register("system", SystemConnector(self))
@@ -249,6 +251,14 @@ class _Handler(BaseHTTPRequestHandler):
             return payload
         result = tq.result
         payload["columns"] = _column_json(result)
+        # spooled protocol: opted-in clients get segment descriptors for
+        # large results instead of inline pages (spi/spool/ role)
+        if self.headers.get("X-Trino-Spooled") == "true" and \
+                len(result.rows) > PAGE_ROWS and token == 0:
+            segments = self.state.spooling.spool(_rows_json(result.rows))
+            payload["segments"] = [
+                {**s, "uri": f"{base}{s['uri']}"} for s in segments]
+            return payload
         start = token * PAGE_ROWS
         chunk = result.rows[start:start + PAGE_ROWS]
         payload["data"] = _rows_json(chunk)
@@ -289,6 +299,13 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if path == "/v1/status":
             self._send(200, {"nodeId": "coordinator", "state": "ACTIVE"})
+            return
+        if len(parts) == 4 and parts[:3] == ["v1", "spooled", "segments"]:
+            data = self.state.spooling.read(parts[3])
+            if data is None:
+                self._send(404, {"error": {"message": "unknown segment"}})
+                return
+            self._send(200, {"data": data})
             return
         if path == "/v1/resourceGroup":
             self._send(200, self.state.dispatcher.resource_groups.info())
@@ -335,6 +352,10 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         path = urlparse(self.path).path
         parts = [p for p in path.split("/") if p]
+        if len(parts) == 4 and parts[:3] == ["v1", "spooled", "segments"]:
+            self.state.spooling.ack(parts[3])
+            self._send(204, {})
+            return
         if len(parts) >= 4 and parts[:3] == ["v1", "statement", "executing"]:
             tq = self.state.tracker.get(parts[3])
             if tq is not None:
