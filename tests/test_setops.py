@@ -152,3 +152,30 @@ def test_values_join_table(session):
     base = rows(session,
                 "SELECT count(*) FROM lineitem WHERE l_returnflag = 'A'")
     assert got == base
+
+
+def test_in_subquery_inside_or(session):
+    """IN-subquery in a disjunction folds to InList (non-conjunct
+    position; conjunct-position IN still decorrelates to semi joins)."""
+    got = rows(session, """
+        SELECT n_name FROM nation
+        WHERE n_regionkey = 0
+           OR n_nationkey IN (SELECT r_regionkey FROM region
+                              WHERE r_name = 'ASIA')
+        ORDER BY n_name""")
+    # region-0 nations plus nationkey 2 (= ASIA's regionkey) -> BRAZIL
+    assert got == [("ALGERIA",), ("BRAZIL",), ("ETHIOPIA",), ("KENYA",),
+                   ("MOROCCO",), ("MOZAMBIQUE",)]
+
+
+def test_not_in_subquery_inside_or(session):
+    # the subquery covers every nationkey, so NOT IN is always false and
+    # only the regionkey=4 branch contributes
+    got = rows(session, """
+        SELECT n_name FROM nation
+        WHERE n_regionkey = 4
+           OR n_nationkey NOT IN (SELECT n_nationkey FROM nation
+                                  WHERE n_regionkey <> 9)
+        ORDER BY n_name""")
+    assert got == [("EGYPT",), ("IRAN",), ("IRAQ",), ("JORDAN",),
+                   ("SAUDI ARABIA",)]

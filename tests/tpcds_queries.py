@@ -599,3 +599,85 @@ GROUP BY i_item_id
 ORDER BY total_sales, i_item_id
 LIMIT 100
 """
+
+# q13: average store-sales metrics under demographic/address OR bands
+QUERIES[13] = """
+SELECT avg(ss_quantity) a1, avg(ss_ext_sales_price) a2,
+       avg(ss_ext_wholesale_cost) a3, sum(ss_ext_wholesale_cost) a4
+FROM store_sales, store, customer_demographics,
+     household_demographics, customer_address, date_dim
+WHERE s_store_sk = ss_store_sk
+  AND ss_sold_date_sk = d_date_sk
+  AND d_year = 2001
+  AND ((ss_hdemo_sk = hd_demo_sk
+        AND cd_demo_sk = ss_cdemo_sk
+        AND cd_marital_status = 'M'
+        AND cd_education_status = 'Advanced Degree'
+        AND ss_sales_price BETWEEN 100.00 AND 150.00
+        AND hd_dep_count = 3)
+    OR (ss_hdemo_sk = hd_demo_sk
+        AND cd_demo_sk = ss_cdemo_sk
+        AND cd_marital_status = 'S'
+        AND cd_education_status = 'College'
+        AND ss_sales_price BETWEEN 50.00 AND 100.00
+        AND hd_dep_count = 1)
+    OR (ss_hdemo_sk = hd_demo_sk
+        AND cd_demo_sk = ss_cdemo_sk
+        AND cd_marital_status = 'W'
+        AND cd_education_status = '2 yr Degree'
+        AND ss_sales_price BETWEEN 150.00 AND 200.00
+        AND hd_dep_count = 1))
+  AND ((ss_addr_sk = ca_address_sk
+        AND ca_country = 'United States'
+        AND ca_state IN ('TX', 'OH', 'KS')
+        AND ss_net_profit BETWEEN 100 AND 200)
+    OR (ss_addr_sk = ca_address_sk
+        AND ca_country = 'United States'
+        AND ca_state IN ('CA', 'NY', 'WA')
+        AND ss_net_profit BETWEEN 150 AND 300)
+    OR (ss_addr_sk = ca_address_sk
+        AND ca_country = 'United States'
+        AND ca_state IN ('GA', 'MN', 'NC')
+        AND ss_net_profit BETWEEN 50 AND 250))
+"""
+
+# q45: web sales by zip prefix or flagged items
+QUERIES[45] = """
+SELECT ca_zip, ca_city, sum(ws_sales_price) total
+FROM web_sales, customer, customer_address, date_dim, item
+WHERE ws_bill_customer_sk = c_customer_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND ws_item_sk = i_item_sk
+  AND (substr(ca_zip, 1, 5) IN
+         ('85669', '86197', '88274', '83405', '86475')
+    OR i_item_id IN (SELECT i_item_id FROM item
+                     WHERE i_item_sk IN (2, 3, 5, 7, 11, 13, 17, 19, 23)))
+  AND ws_sold_date_sk = d_date_sk
+  AND d_qoy = 2 AND d_year = 2001
+GROUP BY ca_zip, ca_city
+ORDER BY ca_zip, ca_city
+LIMIT 100
+"""
+
+# q69: demographic profile of store customers absent from other channels
+QUERIES[69] = """
+SELECT cd_gender, cd_marital_status, cd_education_status,
+       count(*) cnt1, cd_purchase_estimate, count(*) cnt2
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND ca_state IN ('KY', 'GA', 'NM')
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT 1 FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk
+                AND d_year = 2001 AND d_moy BETWEEN 4 AND 6)
+  AND (NOT EXISTS (SELECT 1 FROM web_sales, date_dim
+                   WHERE c.c_customer_sk = ws_bill_customer_sk
+                     AND ws_sold_date_sk = d_date_sk
+                     AND d_year = 2001 AND d_moy BETWEEN 4 AND 6))
+GROUP BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate
+ORDER BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate
+LIMIT 100
+"""

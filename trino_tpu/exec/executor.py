@@ -372,12 +372,13 @@ class Executor:
     # ---- uncorrelated scalar subqueries (fold to constants) ----------
 
     def fold_scalars(self, expr):
-        """Replace ScalarSubqueryRef with its computed Literal before
-        tracing (Trino runs uncorrelated subqueries as separate stages;
-        here the subplan executes eagerly and memoized)."""
+        """Replace ScalarSubqueryRef / InSubqueryRef with computed
+        constants before tracing (Trino runs uncorrelated subqueries as
+        separate stages; here the subplan executes eagerly and memoized)."""
         if expr is None:
             return None
-        has_sub = any(isinstance(e, ir.ScalarSubqueryRef)
+        has_sub = any(isinstance(e, (ir.ScalarSubqueryRef,
+                                     ir.InSubqueryRef))
                       for e in ir.walk(expr))
         if not has_sub:
             return expr
@@ -385,8 +386,45 @@ class Executor:
         def fn(e):
             if isinstance(e, ir.ScalarSubqueryRef):
                 return ir.Literal(self.scalar_value(e), e.dtype)
+            if isinstance(e, ir.InSubqueryRef):
+                return self.fold_in_subquery(e)
             return None
         return ir.transform(expr, fn)
+
+    def fold_in_subquery(self, ref: ir.InSubqueryRef) -> ir.Expr:
+        """Execute the subquery and fold x IN (...) to an InList, mapping
+        varchar values into the probe's dictionary and injecting Kleene
+        NULL when the subquery produced one (x IN S is NULL for unmatched
+        x when S contains NULL)."""
+        if ref not in self._scalar_cache:
+            batch = self.run(ref.plan)
+            arrays, valids = batch_to_numpy(batch)
+            vals, has_null = [], False
+            arg_t = ref.arg.dtype
+            from ..types import TypeKind as TK
+            for v, ok in zip(arrays[0], valids[0]):
+                if not ok:
+                    has_null = True
+                    continue
+                v = v.item() if hasattr(v, "item") else v
+                if arg_t.kind is TK.VARCHAR:
+                    # translate through pools: sub code -> string -> probe
+                    s = ref.sub_field.dictionary[int(v)]
+                    pool = ref.arg_field.dictionary if ref.arg_field \
+                        else None
+                    if pool is None or s not in pool:
+                        continue            # absent: can never match
+                    v = pool.index(s)
+                vals.append(v)
+            self._scalar_cache[ref] = (tuple(sorted(set(vals))), has_null)
+        vals, has_null = self._scalar_cache[ref]
+        folded: ir.Expr = ir.InList(
+            ref.arg, tuple(ir.Literal(v, ref.arg.dtype) for v in vals))
+        if has_null:
+            from ..types import BOOLEAN
+            folded = ir.Logical("or", (folded,
+                                       ir.Literal(None, BOOLEAN)))
+        return folded
 
     def fold_scalars_tuple(self, exprs):
         return tuple(self.fold_scalars(e) for e in exprs)

@@ -153,6 +153,31 @@ class DerivedDict(Expr):
     dtype: DataType     # VARCHAR
 
 
+@dataclass(frozen=True, eq=False)
+class InSubqueryRef(Expr):
+    """x IN (uncorrelated subquery) in a non-conjunct position (inside OR,
+    select items). The executor folds it to InList over the executed
+    subquery's values, with Kleene NULL injection when the subquery
+    contains NULLs (x IN S is NULL when unmatched and S has NULL).
+    Top-level conjuncts never reach this node — they decorrelate to
+    semi/anti joins first. Hashes by identity (carries a plan)."""
+    arg: "Expr"
+    plan: object                 # logical plan of the subquery
+    arg_field: object            # Optional[Field] — probe dictionary
+    sub_field: object            # Optional[Field] — subquery dictionary
+
+    @property
+    def dtype(self):
+        from .types import BOOLEAN
+        return BOOLEAN
+
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other
+
+
 @dataclass(frozen=True)
 class ScalarFunc(Expr):
     """Generic elementwise scalar function (abs/round/mod/coalesce/...).
@@ -231,6 +256,8 @@ def walk(expr: Expr):
         children = (expr.arg,)
     elif isinstance(expr, ScalarFunc):
         children = expr.args
+    elif isinstance(expr, InSubqueryRef):
+        children = (expr.arg,)
     elif isinstance(expr, IsNull):
         children = (expr.arg,)
     elif isinstance(expr, Compare):
@@ -310,6 +337,9 @@ def remap_columns(expr: Expr, mapping) -> Expr:
                             expr.dtype)
     if isinstance(expr, ScalarSubqueryRef):
         return expr          # no column refs into the enclosing batch
+    if isinstance(expr, InSubqueryRef):
+        return InSubqueryRef(remap_columns(expr.arg, mapping), expr.plan,
+                             expr.arg_field, expr.sub_field)
     raise NotImplementedError(type(expr).__name__)
 
 

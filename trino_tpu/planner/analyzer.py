@@ -322,6 +322,23 @@ class ExpressionLowerer:
                 return self.lower_substring(node)
             return self.lower_scalar_func(node)
 
+        if isinstance(node, A.InSubquery):
+            # non-conjunct position (inside OR / select item): plan the
+            # uncorrelated subquery now, fold to InList at execution
+            # (conjunct-position IN decorrelates to semi/anti joins before
+            # lowering ever sees it)
+            if self.planner is None:
+                raise AnalysisError(
+                    "IN subquery not allowed in this context")
+            arg = self.lower(node.arg)
+            sub = self.planner.plan_query(node.query)  # raises if correlated
+            if len(sub.scope.columns) != 1:
+                raise AnalysisError("IN subquery must return one column")
+            arg_field = self.planner.field_for(arg, self.scope)
+            ref = ir.InSubqueryRef(arg, sub.node, arg_field,
+                                   sub.scope.columns[0].field)
+            return ir.Not(ref) if node.negated else ref
+
         if isinstance(node, A.ScalarSubquery):
             if self.planner is None:
                 raise AnalysisError(
