@@ -111,3 +111,17 @@ def test_scalar_func_nulls(session):
     rows = session.execute(
         "SELECT coalesce(NULL, 7), nullif(3, 3)").rows
     assert rows == [(7, None)]
+
+
+def test_replace_starts_with_strpos(session, oracle):
+    got = session.execute("""
+        SELECT replace(n_name, 'A', '@'), strpos(n_name, 'AN')
+        FROM nation ORDER BY n_nationkey LIMIT 5""").rows
+    want = oracle_query(oracle, """
+        SELECT replace(n_name, 'A', '@'), instr(n_name, 'AN')
+        FROM nation ORDER BY n_nationkey LIMIT 5""")
+    assert_rows_match(got, want, rel_tol=1e-9, abs_tol=0)
+    got = session.execute(
+        "SELECT n_name FROM nation WHERE starts_with(n_name, 'I') "
+        "ORDER BY n_name").rows
+    assert got == [("INDIA",), ("INDONESIA",), ("IRAN",), ("IRAQ",)]

@@ -393,6 +393,29 @@ class ExpressionLowerer:
                                    tuple(len(s) for s in pool), BIGINT)
         if name == "concat":
             return self.lower_concat(args)
+        if name == "replace":
+            if len(args) != 3 or not isinstance(args[1], _StringConst) \
+                    or not isinstance(args[2], _StringConst):
+                raise AnalysisError(
+                    "replace(col, 'from', 'to') with literal patterns")
+            a, b = args[1].value, args[2].value
+            return self.dict_transform(args[0],
+                                       lambda s: s.replace(a, b))
+        if name == "starts_with":
+            if len(args) != 2 or not isinstance(args[1], _StringConst):
+                raise AnalysisError(
+                    "starts_with(col, 'prefix') with a literal prefix")
+            prefix = args[1].value
+            return self.dict_lut(args[0],
+                                 lambda s: s.startswith(prefix))
+        if name in ("strpos", "position"):
+            if len(args) != 2 or not isinstance(args[1], _StringConst):
+                raise AnalysisError(
+                    f"{name}(col, 'needle') with a literal needle")
+            needle = args[1].value
+            pool = self.pool_of(args[0])
+            return ir.DictValueMap(
+                args[0], tuple(s.find(needle) + 1 for s in pool), BIGINT)
         if name in ("year", "month", "day"):
             if len(args) != 1 or args[0].dtype.kind not in (
                     TypeKind.DATE, TypeKind.TIMESTAMP):
