@@ -173,3 +173,47 @@ def test_cast_double_to_decimal_half_up():
     e = ir.Cast(col(0, DOUBLE), decimal(4, 0))
     d, _ = evaluate(e, batch, n=3)
     np.testing.assert_array_equal(d, [3, -3, 2])
+
+
+def test_decimal_compare_no_int64_overflow():
+    # TPC-H q11's HAVING: decimal(p,2) sums compared against a scale-12
+    # threshold.  Upscaling the column by 1e10 wraps int64 for values
+    # >= ~9.2e8 scaled; the split (hi, lo) comparison must stay exact.
+    # threshold = 800000.000000123456 at scale 12 (8.0e17 scaled);
+    # column at scale 2: 2e9 scaled (= 2e7) would wrap to 2e19 if upscaled
+    big = np.array([2_000_000_000, 90_000_000, 70_000_000],
+                   dtype=np.int64)
+    batch = batch_from_numpy([big], pad_multiple=4)
+    threshold = 800_000 * 10 ** 12 + 123_456    # scale-12 scaled int
+    e = ir.Compare('>', col(0, decimal(12, 2)),
+                   lit(threshold, decimal(18, 12)))
+    d, v = evaluate(e, batch, n=3)
+    np.testing.assert_array_equal(d, [True, True, False])
+    assert v.all()
+    # flipped orientation and the remaining operators
+    for op, want in [('<', [False, False, True]), ('=', [False] * 3),
+                     ('<>', [True] * 3), ('>=', [True, True, False]),
+                     ('<=', [False, False, True])]:
+        d, _ = evaluate(ir.Compare(op, col(0, decimal(12, 2)),
+                                   lit(threshold, decimal(18, 12))),
+                        batch, n=3)
+        np.testing.assert_array_equal(d, want, err_msg=op)
+        # flipped operand order must agree
+        d2, _ = evaluate(ir.Compare(op, lit(threshold, decimal(18, 12)),
+                                    col(0, decimal(12, 2))), batch, n=3)
+        flip = {'<': '>', '>': '<', '<=': '>=', '>=': '<=',
+                '=': '=', '<>': '<>'}[op]
+        d3, _ = evaluate(ir.Compare(flip, col(0, decimal(12, 2)),
+                                    lit(threshold, decimal(18, 12))),
+                         batch, n=3)
+        np.testing.assert_array_equal(d2, d3, err_msg=f"flip {op}")
+    # exact equality across scales (lo == 0), both orientations
+    exact = 900_000 * 10 ** 12                  # 900000.000000000000
+    eq = np.array([90_000_000], dtype=np.int64)  # 900000.00 at scale 2
+    b2 = batch_from_numpy([eq], pad_multiple=4)
+    d, _ = evaluate(ir.Compare('=', col(0, decimal(12, 2)),
+                               lit(exact, decimal(18, 12))), b2, n=1)
+    assert d[0]
+    d, _ = evaluate(ir.Compare('=', lit(exact, decimal(18, 12)),
+                               col(0, decimal(12, 2))), b2, n=1)
+    assert d[0]

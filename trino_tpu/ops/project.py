@@ -42,13 +42,59 @@ def rescale(data: jax.Array, from_scale: int, to_scale: int) -> jax.Array:
     return jnp.where(data >= 0, pos, neg)
 
 
+_FLIPPED_CMP = {'<': '>', '<=': '>=', '>': '<', '>=': '<=',
+                '=': '=', '<>': '<>'}
+
+
+def _decimal_compare(a: jax.Array, sa: int, b: jax.Array, sb: int,
+                     op: str) -> jax.Array:
+    """Exact comparison of scaled-int64 decimals at different scales.
+
+    Never multiplies either operand: the larger-scale side is split into
+    (hi, lo) by floor division, and ``a <op> b/10^k`` is decided from
+    ``a`` vs ``hi`` plus the sign of ``lo`` — int64-overflow-free where
+    ``a * 10^k`` would wrap (Trino compares on Int128, Decimals.java)."""
+    if sa == sb:
+        return _apply_cmp(op, a, b)
+    if sa > sb:
+        return _decimal_compare(b, sb, a, sa, _FLIPPED_CMP[op])
+    d = 10 ** (sb - sa)
+    hi = b // d                      # floor div: lo is always in [0, d)
+    lo = b - hi * d
+    eq0 = lo == 0
+    if op == '=':
+        return (a == hi) & eq0
+    if op == '<>':
+        return (a != hi) | ~eq0
+    if op == '>':                    # a > hi + lo/d  <=>  a > hi
+        return a > hi
+    if op == '>=':
+        return (a > hi) | ((a == hi) & eq0)
+    if op == '<':
+        return (a < hi) | ((a == hi) & ~eq0)
+    return a <= hi                   # '<='
+
+
+def _apply_cmp(op: str, l: jax.Array, r: jax.Array) -> jax.Array:
+    if op == '=':
+        return l == r
+    if op == '<>':
+        return l != r
+    if op == '<':
+        return l < r
+    if op == '<=':
+        return l <= r
+    if op == '>':
+        return l > r
+    return l >= r
+
+
 def _to_comparable(expr: ir.Expr, data: jax.Array, target) -> jax.Array:
     """Rescale/convert one comparison operand to the common type."""
     t = expr.dtype
-    if target.kind is TypeKind.DECIMAL:
-        if t.kind is TypeKind.DECIMAL:
-            return rescale(data, t.scale, target.scale)
-        return data.astype(jnp.int64) * (10 ** target.scale)
+    # DECIMAL comparison targets never reach here: eval_expr routes them
+    # through _decimal_compare (upscaling to a common scale wraps int64)
+    assert target.kind is not TypeKind.DECIMAL
     if target.kind is TypeKind.DOUBLE:
         if t.kind is TypeKind.DECIMAL:
             return data.astype(jnp.float64) / (10 ** t.scale)
@@ -166,22 +212,21 @@ def eval_expr(expr: ir.Expr, batch: Batch):
         target = ir.comparable(expr.left, expr.right)
         ld, lv = eval_expr(expr.left, batch)
         rd, rv = eval_expr(expr.right, batch)
+        op = expr.op
+        if target.kind is TypeKind.DECIMAL:
+            # exact scaled-int comparison without upscaling either side
+            # (rescaling a decimal(p,2) column to scale 12 multiplies by
+            # 1e10 and silently wraps int64 — TPC-H q11's HAVING)
+            sa = expr.left.dtype.scale \
+                if expr.left.dtype.kind is TypeKind.DECIMAL else 0
+            sb = expr.right.dtype.scale \
+                if expr.right.dtype.kind is TypeKind.DECIMAL else 0
+            res = _decimal_compare(ld.astype(jnp.int64), sa,
+                                   rd.astype(jnp.int64), sb, op)
+            return res, lv & rv
         l = _to_comparable(expr.left, ld, target)
         r = _to_comparable(expr.right, rd, target)
-        op = expr.op
-        if op == '=':
-            res = l == r
-        elif op == '<>':
-            res = l != r
-        elif op == '<':
-            res = l < r
-        elif op == '<=':
-            res = l <= r
-        elif op == '>':
-            res = l > r
-        else:
-            res = l >= r
-        return res, lv & rv
+        return _apply_cmp(op, l, r), lv & rv
 
     if isinstance(expr, ir.Logical):
         parts = [eval_expr(a, batch) for a in expr.args]
