@@ -60,6 +60,9 @@ class Executor:
         from .memory import MemoryPool
         self.pool = MemoryPool(64 << 30)         # query memory limit
         self._node_bytes: Dict[int, int] = {}
+        # chunked-mode substitutions: id(plan node) -> precomputed Batch
+        # (streamed scan chunk, pinned build side, or merged partials)
+        self._subst: Dict[int, Batch] = {}
         # bounded-memory aggregation: process scan chains in chunks of this
         # many rows (the spill-to-host analog; None = off)
         self.spill_chunk_rows: Optional[int] = None
@@ -74,9 +77,18 @@ class Executor:
         for b in self._node_bytes.values():
             self.pool.free(b)
         self._node_bytes.clear()
+        self._subst.clear()
+        if self.spill_chunk_rows:
+            from .chunked import execute_chunked
+            out = execute_chunked(self, root)
+            if out is not None:
+                return out
         return self.run(root.child)
 
     def run(self, node: L.PlanNode) -> Batch:
+        sub = self._subst.get(id(node))
+        if sub is not None:
+            return sub
         if self.profile:
             import time
             t0 = time.monotonic()
@@ -96,8 +108,19 @@ class Executor:
         self.pool.reserve(b)
         self._node_bytes[id(node)] = b
         for c in L.children(node):
+            if id(c) in self._subst:
+                continue    # pinned (chunked-mode build/merge): lives on
             self.pool.free(self._node_bytes.pop(id(c), 0))
         return out
+
+    def release_path_reservations(self, node: L.PlanNode, keep) -> None:
+        """Free reservations of `node`'s subtree (chunked mode: the
+        per-chunk pipeline recomputes these next iteration). Nodes in
+        `keep` (pinned substitutions) stay reserved."""
+        if id(node) not in keep:
+            self.pool.free(self._node_bytes.pop(id(node), 0))
+            for c in L.children(node):
+                self.release_path_reservations(c, keep)
 
     def dispatch(self, node: L.PlanNode) -> Batch:
         if isinstance(node, L.ScanNode):
@@ -254,10 +277,6 @@ class Executor:
             a.arg.index if a.arg is not None else None,
             a.distinct)
             for a in node.aggs)
-        if self.spill_chunk_rows:
-            out = self.try_chunked_aggregate(node, aggs)
-            if out is not None:
-                return out
         child = self.run(node.child)
         return self.aggregate_batch(node, child, aggs)
 
@@ -279,73 +298,6 @@ class Executor:
                     child.columns[a.arg_index].data.dtype, jnp.integer):
                 return False
         return True
-
-    # ---- bounded-memory (chunked) aggregation ------------------------
-
-    MERGE_FUNC = {"sum": "sum", "count": "sum", "count_star": "sum",
-                  "min": "min", "max": "max"}
-
-    def linear_chain(self, node: L.PlanNode):
-        """[outermost .. ScanNode] if the subtree is a Filter/Project
-        chain over a scan, else None."""
-        chain = []
-        while isinstance(node, (L.FilterNode, L.ProjectNode)):
-            chain.append(node)
-            node = node.child
-        if isinstance(node, L.ScanNode):
-            chain.append(node)
-            return chain
-        return None
-
-    def try_chunked_aggregate(self, node: L.AggregateNode, aggs):
-        """Bounded-memory aggregation: stream the scan in chunks, keep
-        only partial aggregate states, merge at the end — the role of
-        SpillableHashAggregationBuilder + MergingHashAggregationBuilder
-        (operator/aggregation/builder/), with host RAM as the spill tier
-        and partial states as the only device-resident state."""
-        if any(a.distinct for a in aggs):
-            return None                 # distinct needs global dedup
-        chain = self.linear_chain(node.child)
-        if chain is None:
-            return None
-        scan = chain[-1]
-        data = self.catalog.get_table(scan.catalog, scan.schema_name,
-                                      scan.table)
-        chunk = self.spill_chunk_rows
-        if data.num_rows <= chunk:
-            return None
-        partials: List[Batch] = []
-        for start in range(0, data.num_rows, chunk):
-            arrays = [np.asarray(data.columns[i])[start:start + chunk]
-                      for i in scan.column_indices]
-            valids = None
-            if data.valids is not None:
-                valids = [None if data.valids[i] is None else
-                          np.asarray(data.valids[i])[start:start + chunk]
-                          for i in scan.column_indices]
-            batch = batch_from_numpy(arrays, valids=valids)
-            for nd in reversed(chain[:-1]):
-                if isinstance(nd, L.FilterNode):
-                    batch = apply_filter(
-                        batch, self.fold_scalars(nd.predicate))
-                else:
-                    batch = filter_project(
-                        batch, None, self.fold_scalars_tuple(nd.exprs))
-            partials.append(self.aggregate_batch(node, batch, aggs))
-            self.stats.agg_spill_chunks += 1
-        merged = partials[0]
-        for p in partials[1:]:
-            merged = concat_batches(merged, p)
-        n_keys = len(node.group_keys)
-        merge_aggs = tuple(
-            AggSpec(self.MERGE_FUNC[a.func], n_keys + j)
-            for j, a in enumerate(aggs))
-        if node.strategy == "global":
-            return global_aggregate(merged, merge_aggs)
-        capacity = max(node.out_capacity, pad_capacity(
-            int(np.asarray(merged.live).sum())))
-        return sort_group_aggregate(merged, tuple(range(n_keys)),
-                                    merge_aggs, capacity)
 
     def aggregate_batch(self, node: L.AggregateNode, child: Batch, aggs):
         """One partial aggregation (the PARTIAL step)."""
