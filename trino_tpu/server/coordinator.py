@@ -74,6 +74,7 @@ class Dispatcher:
         # (DeduplicatingDirectExchangeBuffer.java:87's role)
         self.retry_policy = retry_policy  # NONE | QUERY
         self.max_retries = max_retries
+        self.scheduler = None             # StageScheduler (cluster mode)
         from ..events import EventListenerManager
         self.event_listeners = EventListenerManager()
         from .resourcegroups import (ResourceGroupConfig,
@@ -131,7 +132,19 @@ class Dispatcher:
                         self.failure_injector.maybe_fail("EXECUTION",
                                                          tq.sql)
                     t0 = time.monotonic()
-                    result = self.session.execute(tq.sql)
+                    result = None
+                    if self.scheduler is not None:
+                        # cluster path: fragment + dispatch to workers;
+                        # None = not eligible / no workers (coordinator
+                        # executes locally, Trino's coordinator-only path)
+                        from .scheduler import TaskFailedError
+                        try:
+                            result = self.scheduler.execute(tq.sql)
+                        except TaskFailedError:
+                            result = None   # degrade to local execution
+                        tq.distributed = result is not None
+                    if result is None:
+                        result = self.session.execute(tq.sql)
                     tq.elapsed_s = time.monotonic() - t0
                 tq.result = result
                 tq.rows_returned = len(result.rows)
@@ -156,6 +169,9 @@ class CoordinatorState:
         self.nodes: Dict[str, RegisteredNode] = {}
         self.nodes_lock = threading.Lock()
         self.started_at = time.time()
+        from .scheduler import StageScheduler
+        self.scheduler = StageScheduler(self, session)
+        self.dispatcher.scheduler = self.scheduler
         from .spooling import SpoolingManager
         self.spooling = SpoolingManager()
         # system.runtime.{queries,nodes} backed by this coordinator's state

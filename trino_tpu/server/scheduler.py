@@ -1,0 +1,273 @@
+"""Coordinator-side stage scheduling: split assignment, remote tasks,
+task-level retry.
+
+Reference: the pipelined scheduler stack — PipelinedQueryScheduler.java:164
+creates stages, SourcePartitionedScheduler.java:228 pulls split batches and
+places them via UniformNodeSelector.java:55, HttpRemoteTask.java:135
+(sendUpdate:730) POSTs fragments+splits to workers and polls status, and
+the FTE scheduler retries failed tasks on other nodes
+(EventDrivenFaultTolerantQueryScheduler.java:206).
+
+TPU shape: one SOURCE stage (the fragmenter's per-split partial program,
+executed worker-side over row-range splits) and one FINAL stage (merge +
+remainder of the plan, executed on the coordinator's devices). Workers that
+fail mid-query get their unfinished splits reassigned to surviving workers
+— task retry with the deterministic-input property Trino gets from durable
+exchange (§5.4): a split is a pure row-range of a deterministic connector
+table, so any worker can recompute it identically.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+from urllib.error import HTTPError, URLError
+from urllib.request import Request, urlopen
+
+import numpy as np
+
+from ..exec.chunked import ChunkAnalysis, analyze, merge_partials
+from ..planner import logical as L
+from ..planner.optimizer import prune_plan
+from ..sql import ast_nodes as A
+from ..sql.parser import parse
+from .tasks import Split, decode_columns, encode_fragment
+
+
+class TaskFailedError(RuntimeError):
+    pass
+
+
+class RemoteTask:
+    """Coordinator's proxy of one worker task (HttpRemoteTask.java:135)."""
+
+    def __init__(self, node, task_id: str, fragment_blob: str,
+                 splits: List[Split], http_timeout_s: float = 30.0):
+        self.node = node
+        self.task_id = task_id
+        self.fragment_blob = fragment_blob
+        self.splits = splits
+        self.http_timeout_s = http_timeout_s
+        self.pages: List[dict] = []
+        self.done = False
+
+    def _url(self, suffix: str = "") -> str:
+        return f"{self.node.uri}/v1/task/{self.task_id}{suffix}"
+
+    def _request(self, url: str, data: Optional[bytes] = None,
+                 method: str = "GET") -> dict:
+        req = Request(url, data=data, method=method,
+                      headers={"Content-Type": "application/json"})
+        with urlopen(req, timeout=self.http_timeout_s) as resp:
+            body = resp.read().decode()
+            return json.loads(body) if body else {}
+
+    def start(self) -> None:
+        body = json.dumps({
+            "fragment": self.fragment_blob,
+            "splits": [vars(s) for s in self.splits],
+        }).encode()
+        self._request(self._url(), data=body, method="POST")
+
+    def drain(self, deadline: float) -> List[dict]:
+        """Pull result pages token by token until the buffer completes
+        (HttpPageBufferClient.sendGetResults:355's loop)."""
+        token = 0
+        while time.time() < deadline:
+            out = self._request(self._url(f"/results/{token}"))
+            if out.get("page") is not None:
+                self.pages.append(out["page"])
+                token += 1
+                continue
+            if out.get("state") == "FAILED":
+                raise TaskFailedError(
+                    f"task {self.task_id} on {self.node.node_id}: "
+                    f"{out.get('error', '')}")
+            if out.get("complete"):
+                self.done = True
+                return self.pages
+            time.sleep(0.02)
+        raise TaskFailedError(f"task {self.task_id} timed out")
+
+    def cancel(self) -> None:
+        try:
+            self._request(self._url(), method="DELETE")
+        except Exception:        # noqa: BLE001 — best-effort abort
+            pass
+
+
+class StageScheduler:
+    """Schedules eligible queries across announced workers; falls back to
+    local execution by returning None (the caller keeps the single-node
+    path — Trino's coordinator-only queries take the same shortcut)."""
+
+    def __init__(self, coordinator_state, session, split_rows: int = 250_000,
+                 max_task_retries: int = 2, task_timeout_s: float = 300.0):
+        self.state = coordinator_state
+        self.session = session
+        self.split_rows = split_rows
+        self.max_task_retries = max_task_retries
+        self.task_timeout_s = task_timeout_s
+        self._seq = 0
+        self._lock = threading.Lock()
+        self.stats: Dict[str, int] = {"queries": 0, "tasks": 0,
+                                      "task_retries": 0}
+
+    # -- eligibility + planning -------------------------------------------
+
+    def plan(self, sql: str):
+        stmt = parse(sql)
+        if not isinstance(stmt, A.Query):
+            return None
+        rel = self.session.planner().plan_query(stmt)
+        root = prune_plan(rel.node)
+        analysis = analyze(root, self.session.catalog, self.split_rows)
+        if analysis is None:
+            return None
+        return rel, root, analysis
+
+    def execute(self, sql: str):
+        """Distributed execution; returns QueryResult or None (fall back
+        to local)."""
+        t0 = time.monotonic()
+        workers = self.state.active_nodes()
+        if not workers:
+            return None
+        planned = self.plan(sql)
+        if planned is None:
+            return None
+        rel, root, analysis = planned
+        partial_pages = self._run_source_stage(workers, analysis, root)
+        result = self._run_final_stage(rel, root, analysis, partial_pages)
+        result.elapsed_s = time.monotonic() - t0
+        self.stats["queries"] += 1
+        return result
+
+    # -- source stage ------------------------------------------------------
+
+    def _make_splits(self, analysis: ChunkAnalysis) -> List[Split]:
+        d = analysis.driver
+        return [Split(d.catalog, d.schema_name, d.table, start,
+                      min(self.split_rows, analysis.driver_rows - start))
+                for start in range(0, analysis.driver_rows,
+                                   self.split_rows)]
+
+    def _run_source_stage(self, workers, analysis: ChunkAnalysis,
+                          root: L.OutputNode) -> List[dict]:
+        # agg mode: workers compute PARTIAL aggregates; concat mode: they
+        # run everything below the output node and the coordinator concats
+        fragment_root = analysis.merge_agg if analysis.merge_agg \
+            is not None else root.child
+        blob = encode_fragment({"root": fragment_root,
+                                "driver": analysis.driver})
+        splits = self._make_splits(analysis)
+        # uniform assignment (UniformNodeSelector's round-robin core)
+        assignment: Dict[str, List[Split]] = {w.node_id: [] for w in workers}
+        by_id = {w.node_id: w for w in workers}
+        for i, s in enumerate(splits):
+            assignment[workers[i % len(workers)].node_id].append(s)
+
+        pages: List[dict] = []
+        pending = {nid: sp for nid, sp in assignment.items() if sp}
+        retries = 0
+        while pending:
+            tasks: List[RemoteTask] = []
+            failed: Dict[str, List[Split]] = {}
+            for nid, sp in pending.items():
+                with self._lock:
+                    self._seq += 1
+                    tid = f"t{self._seq}"
+                task = RemoteTask(by_id[nid], tid, blob, sp)
+                try:
+                    task.start()
+                    tasks.append(task)
+                    self.stats["tasks"] += 1
+                except (URLError, HTTPError, OSError) as e:
+                    self._mark_failed(nid, e)
+                    failed[nid] = sp
+            deadline = time.time() + self.task_timeout_s
+            for task in tasks:
+                try:
+                    pages.extend(task.drain(deadline))
+                except (TaskFailedError, URLError, HTTPError, OSError) as e:
+                    self._mark_failed(task.node.node_id, e)
+                    failed[task.node.node_id] = task.splits
+                    task.cancel()
+            if not failed:
+                break
+            # task retry: reassign failed nodes' splits to survivors
+            # (EventDrivenFaultTolerantQueryScheduler's per-task retry)
+            retries += 1
+            self.stats["task_retries"] += 1
+            if retries > self.max_task_retries:
+                raise TaskFailedError(
+                    "task retries exhausted: " +
+                    ", ".join(sorted(failed)))
+            survivors = [w for w in self.state.active_nodes()
+                         if w.node_id not in failed]
+            if not survivors:
+                raise TaskFailedError("no active workers left")
+            workers = survivors
+            by_id = {w.node_id: w for w in workers}
+            redo: Dict[str, List[Split]] = {w.node_id: [] for w in workers}
+            flat = [s for sp in failed.values() for s in sp]
+            for i, s in enumerate(flat):
+                redo[workers[i % len(workers)].node_id].append(s)
+            pending = {nid: sp for nid, sp in redo.items() if sp}
+        return pages
+
+    def _mark_failed(self, node_id: str, err: Exception) -> None:
+        with self.state.nodes_lock:
+            n = self.state.nodes.get(node_id)
+            if n is not None:
+                n.state = "FAILED"
+
+    # -- final stage -------------------------------------------------------
+
+    def _run_final_stage(self, rel, root: L.OutputNode,
+                         analysis: ChunkAnalysis, pages: List[dict]):
+        from ..batch import batch_from_numpy
+        from ..exec.session import QueryResult
+        ex = self.session.executor
+        ex._subst.clear()
+        try:
+            if analysis.merge_agg is not None:
+                partials = []
+                for p in pages:
+                    arrs, vals = decode_columns(p)
+                    if p["rows"] == 0:
+                        continue
+                    partials.append(batch_from_numpy(arrs, valids=vals))
+                if partials:
+                    merged = merge_partials(ex, analysis.merge_agg,
+                                            partials)
+                else:    # all splits filtered out: empty partial
+                    merged = self._empty_like(analysis.merge_agg)
+                ex._subst[id(analysis.merge_agg)] = merged
+            else:
+                cols = None
+                for p in pages:
+                    arrs, vals = decode_columns(p)
+                    if cols is None:
+                        cols = [[a] for a in arrs], [[v] for v in vals]
+                    else:
+                        for j, a in enumerate(arrs):
+                            cols[0][j].append(a)
+                            cols[1][j].append(vals[j])
+                arrs = [np.concatenate(c) for c in cols[0]]
+                vals = [np.concatenate(c) for c in cols[1]]
+                ex._subst[id(root.child)] = batch_from_numpy(
+                    arrs, valids=vals)
+            batch = ex.run(root)
+            names, arrays, valids = ex.result_to_host(root, batch)
+            rows = self.session.decode_rows(rel, arrays, valids)
+            return QueryResult(names, rows, 0.0, ex.stats)
+        finally:
+            ex._subst.clear()
+
+    def _empty_like(self, agg: L.AggregateNode):
+        from ..batch import batch_from_numpy
+        arrs = [np.zeros(0, dtype=dt.np_dtype) for _, dt in agg.output]
+        return batch_from_numpy(arrs)

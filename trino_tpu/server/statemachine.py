@@ -96,6 +96,7 @@ class TrackedQuery:
     cpu_time_s: float = 0.0
     elapsed_s: float = 0.0
     retries: int = 0
+    distributed: bool = False             # ran via the stage scheduler
 
     @property
     def state(self) -> str:

@@ -39,6 +39,7 @@ class _WorkerHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         path = urlparse(self.path).path
+        parts = [p for p in path.split("/") if p]
         if path == "/v1/status":
             if self.worker.fail_status:      # fault injection hook
                 self._send(500, {"error": "injected failure"})
@@ -50,6 +51,72 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         if path == "/v1/info":
             self._send(200, {"nodeVersion": {"version": "trino-tpu-0.1"},
                              "coordinator": False})
+            return
+        # GET /v1/task/{id} — TaskStatus long-poll target
+        # (server/remotetask/ContinuousTaskStatusFetcher's endpoint)
+        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+            task = self._task_or_404(parts[2])
+            if task is not None:
+                self._send(200, self.worker.task_manager.status_json(task))
+            return
+        # GET /v1/task/{id}/results/{token} — output-buffer pull
+        # (server/TaskResource.java:332)
+        if len(parts) == 5 and parts[:2] == ["v1", "task"] and \
+                parts[3] == "results":
+            task = self._task_or_404(parts[2])
+            if task is None:
+                return
+            if self.worker.fail_results:     # fault injection hook
+                self._send(500, {"error": "injected results failure"})
+                return
+            token = int(parts[4])
+            with task.lock:
+                if token < len(task.pages):
+                    self._send(200, {"token": token, "complete": False,
+                                     "page": task.pages[token]})
+                    return
+                done = task.state in ("FINISHED", "FAILED", "CANCELED")
+                self._send(200, {"token": token,
+                                 "complete": done and
+                                 token >= len(task.pages),
+                                 "state": task.state, "error": task.error,
+                                 "page": None})
+            return
+        self._send(404, {"error": f"no route {path}"})
+
+    def _task_or_404(self, task_id: str):
+        task = self.worker.task_manager.get(task_id)
+        if task is None:
+            self._send(404, {"error": f"unknown task {task_id}"})
+        return task
+
+    def do_POST(self):
+        path = urlparse(self.path).path
+        parts = [p for p in path.split("/") if p]
+        # POST /v1/task/{id} — create/update with fragment + splits
+        # (server/TaskResource.java:146 createOrUpdateTask)
+        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+            if self.worker.fail_tasks:       # fault injection hook
+                self._send(500, {"error": "injected task failure"})
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n).decode())
+            from .tasks import Split
+            splits = [Split(**s) for s in body.get("splits", [])]
+            task = self.worker.task_manager.create_or_update(
+                parts[2], body["fragment"], splits)
+            self._send(200, self.worker.task_manager.status_json(task))
+            return
+        self._send(404, {"error": f"no route {path}"})
+
+    def do_DELETE(self):
+        path = urlparse(self.path).path
+        parts = [p for p in path.split("/") if p]
+        # DELETE /v1/task/{id} — cancel/abort (TaskResource.java:319's
+        # fail route collapsed with delete)
+        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+            self.worker.task_manager.cancel(parts[2])
+            self._send(204, {})
             return
         self._send(404, {"error": f"no route {path}"})
 
@@ -68,12 +135,18 @@ class WorkerServer:
     """One worker process stand-in: HTTP status endpoint + announcer loop."""
 
     def __init__(self, node_id: str, coordinator_uri: str, port: int = 0,
-                 announce_interval_s: float = 1.0):
+                 announce_interval_s: float = 1.0, catalog=None):
         self.node_id = node_id
         self.coordinator_uri = coordinator_uri
         self.state = "ACTIVE"
         self.fail_status = False
+        self.fail_tasks = False          # inject: task creation fails
+        self.fail_results = False        # inject: result fetch fails
         self.started_at = time.time()
+        from ..catalog import default_catalog
+        from .tasks import TaskManager
+        self.catalog = catalog if catalog is not None else default_catalog()
+        self.task_manager = TaskManager(self.catalog)
         handler = type("BoundWorkerHandler", (_WorkerHandler,),
                        {"worker": self})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)

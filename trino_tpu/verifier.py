@@ -96,9 +96,15 @@ class Verifier:
                     return f"row {i} col {j}: {a!r} != {b!r}"
         return None
 
-    def run_suite(self, queries: Dict[object, str]) -> List[VerifyResult]:
-        return [self.verify(str(k), sql) for k, sql in
-                sorted(queries.items(), key=lambda kv: str(kv[0]))]
+    def run_suite(self, queries: Dict[object, str],
+                  on_result=None) -> List[VerifyResult]:
+        out = []
+        for k, sql in sorted(queries.items(), key=lambda kv: str(kv[0])):
+            r = self.verify(str(k), sql)
+            if on_result is not None:
+                on_result(r)
+            out.append(r)
+        return out
 
 
 # -- sqlite loading / dialect translation (shared with tests/oracle.py) ----
@@ -227,14 +233,14 @@ def main(argv=None) -> int:
             from tpcds_queries import QUERIES as queries  # type: ignore
         except ImportError:
             pass
-    results = verifier.run_suite(queries)
-    fails = 0
-    for r in results:
+    def show(r):
         mark = "OK " if r.status == "MATCH" else "FAIL"
         print(f"{mark} {r.name:>6}  {r.status:14} test={r.test_ms:8.1f}ms "
               f"control={r.control_ms:8.1f}ms rows={r.test_rows}"
-              + (f"  {r.detail}" if r.detail else ""))
-        fails += r.status != "MATCH"
+              + (f"  {r.detail}" if r.detail else ""), flush=True)
+
+    results = verifier.run_suite(queries, on_result=show)
+    fails = sum(r.status != "MATCH" for r in results)
     print(f"{len(results) - fails}/{len(results)} queries verified"
           " identical")
     return 1 if fails else 0
