@@ -62,8 +62,56 @@ def translate(sql: str) -> str:
     return sql
 
 
+class _Var:
+    """Welford variance aggregate for sqlite (it ships none)."""
+    samp = True
+    sqrt = False
+
+    def __init__(self):
+        self.n = 0
+        self.mean = 0.0
+        self.m2 = 0.0
+
+    def step(self, x):
+        if x is None:
+            return
+        self.n += 1
+        d = x - self.mean
+        self.mean += d / self.n
+        self.m2 += d * (x - self.mean)
+
+    def finalize(self):
+        denom = (self.n - 1) if self.samp else self.n
+        if denom <= 0:
+            return None
+        v = self.m2 / denom
+        return v ** 0.5 if self.sqrt else v
+
+
+class _VarPop(_Var):
+    samp = False
+
+
+class _Stddev(_Var):
+    sqrt = True
+
+
+class _StddevPop(_Var):
+    samp = False
+    sqrt = True
+
+
+def register_stats_functions(conn: sqlite3.Connection) -> None:
+    for name, cls in [("var_samp", _Var), ("variance", _Var),
+                      ("var_pop", _VarPop), ("stddev", _Stddev),
+                      ("stddev_samp", _Stddev),
+                      ("stddev_pop", _StddevPop)]:
+        conn.create_aggregate(name, 1, cls)
+
+
 def load_oracle(tables: Iterable[TableData]) -> sqlite3.Connection:
     conn = sqlite3.connect(":memory:")
+    register_stats_functions(conn)
     for t in tables:
         cols = []
         for f in t.schema:

@@ -103,12 +103,31 @@ PRIMARY_KEYS = {
     "warehouse": ("w_warehouse_sk",),
     "reason": ("r_reason_sk",),
     "web_site": ("web_site_sk",),
+    "call_center": ("cc_call_center_sk",),
+    "catalog_page": ("cp_catalog_page_sk",),
+    "web_page": ("wp_web_page_sk",),
+    "income_band": ("ib_income_band_sk",),
+    "ship_mode": ("sm_ship_mode_sk",),
     "store_sales": ("ss_item_sk", "ss_ticket_number"),
     "store_returns": ("sr_item_sk", "sr_ticket_number"),
     "catalog_sales": ("cs_item_sk", "cs_order_number"),
+    "catalog_returns": ("cr_item_sk", "cr_order_number"),
     "web_sales": ("ws_item_sk", "ws_order_number"),
+    "web_returns": ("wr_item_sk", "wr_order_number"),
     "inventory": ("inv_date_sk", "inv_item_sk", "inv_warehouse_sk"),
 }
+
+SHIP_MODE_TYPES = ["EXPRESS", "LIBRARY", "NEXT DAY", "OVERNIGHT",
+                   "REGULAR", "TWO DAY"]
+CARRIERS = sorted(["AIRBORNE", "ALLIANCE", "BARIAN", "BOXBUNDLES", "DHL",
+                   "FEDEX", "GERMA", "GREAT EASTERN", "HARMSTORF", "LATVIAN",
+                   "MSC", "ORIENTAL", "PRIVATECARRIER", "RUPEKSA", "TBS",
+                   "UPS", "USPS", "ZHOU", "ZOUROS", "DIAMOND"])
+SALUTATIONS = sorted(["Dr.", "Miss", "Mr.", "Mrs.", "Ms.", "Sir"])
+COUNTRIES = sorted(["UNITED STATES", "CANADA", "MEXICO", "GERMANY",
+                    "FRANCE", "JAPAN", "BRAZIL", "INDIA", "CHINA",
+                    "AUSTRALIA", "ITALY", "SPAIN", "NIGERIA", "KENYA",
+                    "EGYPT", "PERU"])
 
 
 def _pick(rng, pool: List[str], n: int) -> np.ndarray:
@@ -145,12 +164,19 @@ def generate(scale: float, seed: int = 19980101) -> Dict[str, TableData]:
     d_dow = np.array([(d.weekday() + 1) % 7 for d in dates], dtype=np.int32)
     d_day_name = _codes_for([WEEKDAYS[int(w)] for w in d_dow],
                             DAY_NAMES)
+    # spec-like sequences: d_week_seq continuous over weeks, d_month_seq
+    # over months (q2/q59's 53-week self-joins, q6/q54's month windows)
+    d_week_seq = ((d_date - int(d_date[0]) + int(d_dow[0])) // 7 +
+                  5270).astype(np.int32)
+    d_month_seq = ((d_year - 1998) * 12 + d_moy - 1 + 1176).astype(np.int32)
     table("date_dim",
           [Field("d_date_sk", BIGINT), Field("d_date", DATE),
            Field("d_year", INTEGER), Field("d_moy", INTEGER),
            Field("d_dom", INTEGER), Field("d_qoy", INTEGER),
-           Field("d_dow", INTEGER), _dict_field("d_day_name", DAY_NAMES)],
-          [d_sk, d_date, d_year, d_moy, d_dom, d_qoy, d_dow, d_day_name])
+           Field("d_dow", INTEGER), _dict_field("d_day_name", DAY_NAMES),
+           Field("d_week_seq", INTEGER), Field("d_month_seq", INTEGER)],
+          [d_sk, d_date, d_year, d_moy, d_dom, d_qoy, d_dow, d_day_name,
+           d_week_seq, d_month_seq])
 
     # ---- time_dim -------------------------------------------------------
     n_times = 86400 // 60            # per-minute grain (spec is per-second)
@@ -180,6 +206,17 @@ def generate(scale: float, seed: int = 19980101) -> Dict[str, TableData]:
     manufact_pool = sorted(set(manufact_strings))
     i_current_price = rng.integers(10, 9900, n_item).astype(np.int64)
     i_manager_id = rng.integers(1, 101, n_item).astype(np.int64)
+    i_wholesale_cost = rng.integers(5, 7000, n_item).astype(np.int64)
+    # bounded pools for desc/product_name (dsdgen text, pool-capped like
+    # the tpch comment columns)
+    desc_pool = sorted({f"{COLORS_DS[a]} {COLORS_DS[b]} {CLASSES[c]}"
+                        for a in range(len(COLORS_DS))
+                        for b in range(0, len(COLORS_DS), 5)
+                        for c in range(0, len(CLASSES), 3)})
+    i_desc = rng.integers(0, len(desc_pool), n_item).astype(np.int32)
+    prod_pool = sorted({f"{BRAND_BASES[a]}{BRAND_BASES[b]}"
+                        for a in range(10) for b in range(10)})
+    i_prod = rng.integers(0, len(prod_pool), n_item).astype(np.int32)
     table("item",
           [Field("i_item_sk", BIGINT),
            Field("i_item_id", VARCHAR, dictionary=tuple(i_id_pool)),
@@ -192,13 +229,17 @@ def generate(scale: float, seed: int = 19980101) -> Dict[str, TableData]:
            Field("i_manufact", VARCHAR, dictionary=tuple(manufact_pool)),
            Field("i_current_price", D72),
            _dict_field("i_color", COLORS_DS), _dict_field("i_size", SIZES),
-           _dict_field("i_units", UNITS), Field("i_manager_id", BIGINT)],
+           _dict_field("i_units", UNITS), Field("i_manager_id", BIGINT),
+           Field("i_wholesale_cost", D72),
+           _dict_field("i_item_desc", desc_pool),
+           _dict_field("i_product_name", prod_pool)],
           [i_sk, i_id_codes, i_category_id - 1, i_category_id,
            i_class_id - 1, i_class_id, i_brand_id,
            _codes_for(brand_strings, brand_pool), i_manufact_id,
            _codes_for(manufact_strings, manufact_pool), i_current_price,
            _pick(rng, COLORS_DS, n_item), _pick(rng, SIZES, n_item),
-           _pick(rng, UNITS, n_item), i_manager_id])
+           _pick(rng, UNITS, n_item), i_manager_id,
+           i_wholesale_cost, i_desc, i_prod])
 
     # ---- customer_demographics (cross product, spec: 1,920,800 rows;
     #      shrunk grid with same fields) --------------------------------
@@ -274,14 +315,19 @@ def generate(scale: float, seed: int = 19980101) -> Dict[str, TableData]:
            _dict_field("c_first_name", FIRST_NAMES),
            _dict_field("c_last_name", LAST_NAMES),
            Field("c_birth_year", INTEGER),
-           Field("c_birth_month", INTEGER)],
+           Field("c_birth_month", INTEGER),
+           _dict_field("c_preferred_cust_flag", YN),
+           _dict_field("c_salutation", SALUTATIONS),
+           _dict_field("c_birth_country", COUNTRIES)],
           [c_sk, np.arange(n_cust, dtype=np.int32),
            rng.integers(1, n_cd + 1, n_cust).astype(np.int64),
            rng.integers(1, n_hd + 1, n_cust).astype(np.int64),
            rng.integers(1, n_ca + 1, n_cust).astype(np.int64),
            _pick(rng, FIRST_NAMES, n_cust), _pick(rng, LAST_NAMES, n_cust),
            rng.integers(1924, 1993, n_cust).astype(np.int32),
-           rng.integers(1, 13, n_cust).astype(np.int32)])
+           rng.integers(1, 13, n_cust).astype(np.int32),
+           _pick(rng, YN, n_cust), _pick(rng, SALUTATIONS, n_cust),
+           _pick(rng, COUNTRIES, n_cust)])
 
     # ---- store ----------------------------------------------------------
     n_store = max(12, int(12 * max(scale, 0.01) ** 0.5 * 10))
@@ -352,6 +398,62 @@ def generate(scale: float, seed: int = 19980101) -> Dict[str, TableData]:
            Field("web_name", VARCHAR, dictionary=tuple(web_names))],
           [1 + np.arange(n_web, dtype=np.int64),
            np.arange(n_web, dtype=np.int32)])
+
+    # ---- call_center / catalog_page / web_page / income_band /
+    #      ship_mode (the remaining spec dimensions) ---------------------
+    n_cc = 6
+    cc_names = sorted(["NY Metro", "Mid Atlantic", "North Midwest",
+                       "Pacific Northwest", "California", "Hawaii/Alaska"])
+    cc_mgrs = sorted(["Bob Belcher", "Felipe Perkins", "Mark Hightower",
+                      "Larry Mccray", "Julius Durham", "Terry Askew"])
+    table("call_center",
+          [Field("cc_call_center_sk", BIGINT),
+           _dict_field("cc_name", cc_names),
+           _dict_field("cc_manager", cc_mgrs),
+           _dict_field("cc_county", COUNTIES)],
+          [1 + np.arange(n_cc, dtype=np.int64),
+           np.arange(n_cc, dtype=np.int32),
+           _pick(rng, cc_mgrs, n_cc), _pick(rng, COUNTIES, n_cc)])
+
+    n_cp = max(100, int(11718 * min(scale, 1.0) ** 0.5))
+    _, cp_id_pool = _id_strings("AAAAAAAA",
+                                1 + np.arange(n_cp, dtype=np.int64))
+    table("catalog_page",
+          [Field("cp_catalog_page_sk", BIGINT),
+           Field("cp_catalog_page_id", VARCHAR,
+                 dictionary=tuple(cp_id_pool))],
+          [1 + np.arange(n_cp, dtype=np.int64),
+           np.arange(n_cp, dtype=np.int32)])
+
+    n_wp = max(60, int(60 * min(scale, 1.0) ** 0.5))
+    table("web_page",
+          [Field("wp_web_page_sk", BIGINT),
+           Field("wp_char_count", INTEGER)],
+          [1 + np.arange(n_wp, dtype=np.int64),
+           rng.integers(100, 8000, n_wp).astype(np.int32)])
+
+    n_ib = 20
+    ib_sk = 1 + np.arange(n_ib, dtype=np.int64)
+    table("income_band",
+          [Field("ib_income_band_sk", BIGINT),
+           Field("ib_lower_bound", INTEGER),
+           Field("ib_upper_bound", INTEGER)],
+          [ib_sk, ((ib_sk - 1) * 10000).astype(np.int32),
+           (ib_sk * 10000).astype(np.int32)])
+
+    n_sm = 20
+    sm_types = [SHIP_MODE_TYPES[i % len(SHIP_MODE_TYPES)]
+                for i in range(n_sm)]
+    sm_codes = sorted(["AIR", "SURFACE", "SEA"])
+    table("ship_mode",
+          [Field("sm_ship_mode_sk", BIGINT),
+           _dict_field("sm_type", sorted(SHIP_MODE_TYPES)),
+           _dict_field("sm_code", sm_codes),
+           _dict_field("sm_carrier", CARRIERS)],
+          [1 + np.arange(n_sm, dtype=np.int64),
+           _codes_for(sm_types, sorted(SHIP_MODE_TYPES)),
+           _pick(rng, sm_codes, n_sm),
+           np.arange(n_sm, dtype=np.int32)])
 
     # ---- fact helper ----------------------------------------------------
     def fk(n, hi, null_frac=0.04):
@@ -470,6 +572,19 @@ def generate(scale: float, seed: int = 19980101) -> Dict[str, TableData]:
     cs_ext_discount = (cs_list - cs_sales_price) * cs_qty
     cs_net_paid = cs_ext_sales
     cs_net_profit = cs_net_paid - cs_wholesale * cs_qty
+    cs_cc, cs_cc_v = fk(n_cs, n_cc)
+    cs_cp, cs_cp_v = fk(n_cs, n_cp)
+    cs_sm, cs_sm_v = fk(n_cs, n_sm)
+    cs_ship_cust, cs_ship_cust_v = fk(n_cs, n_cust)
+    cs_ship_addr, cs_ship_addr_v = fk(n_cs, n_ca)
+    cs_ext_list = cs_list * cs_qty
+    cs_ext_wholesale = cs_wholesale * cs_qty
+    cs_ext_tax = cs_ext_sales * rng.integers(0, 9, n_cs) // 100
+    cs_coupon = np.where(rng.random(n_cs) < 0.1,
+                         cs_ext_sales * rng.integers(0, 50, n_cs) // 100,
+                         0).astype(np.int64)
+    cs_ext_ship = money(n_cs, 0, 5000) * cs_qty // 10
+    cs_net_paid_tax = cs_net_paid + cs_ext_tax
     table("catalog_sales",
           [Field("cs_sold_date_sk", BIGINT),
            Field("cs_ship_date_sk", BIGINT), Field("cs_item_sk", BIGINT),
@@ -482,13 +597,61 @@ def generate(scale: float, seed: int = 19980101) -> Dict[str, TableData]:
            Field("cs_wholesale_cost", D72), Field("cs_list_price", D72),
            Field("cs_sales_price", D72), Field("cs_ext_discount_amt", D72),
            Field("cs_ext_sales_price", D72), Field("cs_net_paid", D72),
-           Field("cs_net_profit", D72)],
+           Field("cs_net_profit", D72),
+           Field("cs_call_center_sk", BIGINT),
+           Field("cs_catalog_page_sk", BIGINT),
+           Field("cs_ship_mode_sk", BIGINT),
+           Field("cs_ship_customer_sk", BIGINT),
+           Field("cs_ship_addr_sk", BIGINT),
+           Field("cs_ext_list_price", D72),
+           Field("cs_ext_wholesale_cost", D72),
+           Field("cs_ext_tax", D72), Field("cs_coupon_amt", D72),
+           Field("cs_ext_ship_cost", D72),
+           Field("cs_net_paid_inc_tax", D72)],
           [cs_sold_date, cs_ship_date, cs_item, cs_cust, cs_cdemo,
            cs_hdemo, cs_addr, cs_wh, cs_promo, cs_order, cs_qty,
            cs_wholesale, cs_list, cs_sales_price, cs_ext_discount,
-           cs_ext_sales, cs_net_paid, cs_net_profit],
+           cs_ext_sales, cs_net_paid, cs_net_profit,
+           cs_cc, cs_cp, cs_sm, cs_ship_cust, cs_ship_addr, cs_ext_list,
+           cs_ext_wholesale, cs_ext_tax, cs_coupon, cs_ext_ship,
+           cs_net_paid_tax],
           valids=[cs_date_v, None, None, cs_cust_v, cs_cdemo_v, cs_hdemo_v,
-                  cs_addr_v, cs_wh_v, cs_promo_v] + [None] * 9)
+                  cs_addr_v, cs_wh_v, cs_promo_v] + [None] * 9 +
+                 [cs_cc_v, cs_cp_v, cs_sm_v, cs_ship_cust_v,
+                  cs_ship_addr_v] + [None] * 6)
+
+    # ---- catalog_returns (~10% of catalog sales) -----------------------
+    n_cr = n_cs // 10
+    cridx = rng.choice(n_cs, n_cr, replace=False)
+    cr_returned_date = np.minimum(cs_sold_date[cridx] +
+                                  rng.integers(1, 60, n_cr),
+                                  FIRST_SK + n_dates - 1).astype(np.int64)
+    cr_qty = np.maximum(1, cs_qty[cridx] // 2).astype(np.int64)
+    cr_amt = cs_sales_price[cridx] * cr_qty
+    cr_reason, cr_reason_v = fk(n_cr, n_reason)
+    table("catalog_returns",
+          [Field("cr_returned_date_sk", BIGINT),
+           Field("cr_item_sk", BIGINT), Field("cr_order_number", BIGINT),
+           Field("cr_returning_customer_sk", BIGINT),
+           Field("cr_returning_addr_sk", BIGINT),
+           Field("cr_call_center_sk", BIGINT),
+           Field("cr_catalog_page_sk", BIGINT),
+           Field("cr_warehouse_sk", BIGINT),
+           Field("cr_reason_sk", BIGINT),
+           Field("cr_return_quantity", BIGINT),
+           Field("cr_return_amount", D72),
+           Field("cr_return_amt_inc_tax", D72),
+           Field("cr_refunded_cash", D72),
+           Field("cr_net_loss", D72)],
+          [cr_returned_date, cs_item[cridx], cs_order[cridx],
+           cs_cust[cridx], cs_addr[cridx], cs_cc[cridx], cs_cp[cridx],
+           cs_wh[cridx], cr_reason, cr_qty, cr_amt,
+           cr_amt + cr_amt * 8 // 100,
+           cr_amt * rng.integers(50, 101, n_cr) // 100,
+           cr_amt // 10 + money(n_cr, 50, 1000)],
+          valids=[None, None, None, cs_cust_v[cridx], cs_addr_v[cridx],
+                  cs_cc_v[cridx], cs_cp_v[cridx], cs_wh_v[cridx],
+                  cr_reason_v] + [None] * 5)
 
     # ---- web_sales ------------------------------------------------------
     n_ws = n_ss // 4
@@ -502,10 +665,31 @@ def generate(scale: float, seed: int = 19980101) -> Dict[str, TableData]:
     ws_site, ws_site_v = fk(n_ws, n_web)
     ws_promo, ws_promo_v = fk(n_ws, n_promo)
     ws_qty = rng.integers(1, 101, n_ws).astype(np.int64)
-    ws_sales_price = money(n_ws, 100, 30000)
+    ws_wholesale = money(n_ws, 100, 10000)
+    ws_list = (ws_wholesale * (100 + rng.integers(0, 100, n_ws)) //
+               100).astype(np.int64)
+    ws_sales_price = (ws_list * rng.integers(20, 101, n_ws) //
+                      100).astype(np.int64)
     ws_ext_sales = ws_sales_price * ws_qty
     ws_net_paid = ws_ext_sales
-    ws_net_profit = ws_net_paid - money(n_ws, 50, 20000) * ws_qty
+    ws_net_profit = ws_net_paid - ws_wholesale * ws_qty
+    ws_ship_date = np.minimum(ws_sold_date + rng.integers(2, 90, n_ws),
+                              FIRST_SK + n_dates - 1).astype(np.int64)
+    ws_time = rng.integers(0, n_times, n_ws).astype(np.int64)
+    ws_wh, ws_wh_v = fk(n_ws, n_wh)
+    ws_sm, ws_sm_v = fk(n_ws, n_sm)
+    ws_wp, ws_wp_v = fk(n_ws, n_wp)
+    ws_ship_cust, ws_ship_cust_v = fk(n_ws, n_cust)
+    ws_ship_addr, ws_ship_addr_v = fk(n_ws, n_ca)
+    ws_ext_list = ws_list * ws_qty
+    ws_ext_wholesale = ws_wholesale * ws_qty
+    ws_ext_discount = ws_ext_list - ws_ext_sales
+    ws_ext_tax = ws_ext_sales * rng.integers(0, 9, n_ws) // 100
+    ws_coupon = np.where(rng.random(n_ws) < 0.1,
+                         ws_ext_sales * rng.integers(0, 50, n_ws) // 100,
+                         0).astype(np.int64)
+    ws_ext_ship = money(n_ws, 0, 5000) * ws_qty // 10
+    ws_net_paid_tax = ws_net_paid + ws_ext_tax
     table("web_sales",
           [Field("ws_sold_date_sk", BIGINT), Field("ws_item_sk", BIGINT),
            Field("ws_bill_customer_sk", BIGINT),
@@ -513,12 +697,61 @@ def generate(scale: float, seed: int = 19980101) -> Dict[str, TableData]:
            Field("ws_web_site_sk", BIGINT), Field("ws_promo_sk", BIGINT),
            Field("ws_order_number", BIGINT), Field("ws_quantity", BIGINT),
            Field("ws_sales_price", D72), Field("ws_ext_sales_price", D72),
-           Field("ws_net_paid", D72), Field("ws_net_profit", D72)],
+           Field("ws_net_paid", D72), Field("ws_net_profit", D72),
+           Field("ws_ship_date_sk", BIGINT),
+           Field("ws_sold_time_sk", BIGINT),
+           Field("ws_warehouse_sk", BIGINT),
+           Field("ws_ship_mode_sk", BIGINT),
+           Field("ws_web_page_sk", BIGINT),
+           Field("ws_ship_customer_sk", BIGINT),
+           Field("ws_ship_addr_sk", BIGINT),
+           Field("ws_wholesale_cost", D72), Field("ws_list_price", D72),
+           Field("ws_ext_list_price", D72),
+           Field("ws_ext_wholesale_cost", D72),
+           Field("ws_ext_discount_amt", D72), Field("ws_ext_tax", D72),
+           Field("ws_coupon_amt", D72), Field("ws_ext_ship_cost", D72),
+           Field("ws_net_paid_inc_tax", D72)],
           [ws_sold_date, ws_item, ws_cust, ws_addr, ws_site, ws_promo,
            ws_order, ws_qty, ws_sales_price, ws_ext_sales, ws_net_paid,
-           ws_net_profit],
+           ws_net_profit,
+           ws_ship_date, ws_time, ws_wh, ws_sm, ws_wp, ws_ship_cust,
+           ws_ship_addr, ws_wholesale, ws_list, ws_ext_list,
+           ws_ext_wholesale, ws_ext_discount, ws_ext_tax, ws_coupon,
+           ws_ext_ship, ws_net_paid_tax],
           valids=[ws_date_v, None, ws_cust_v, ws_addr_v, ws_site_v,
-                  ws_promo_v] + [None] * 6)
+                  ws_promo_v] + [None] * 6 +
+                 [None, None, ws_wh_v, ws_sm_v, ws_wp_v, ws_ship_cust_v,
+                  ws_ship_addr_v] + [None] * 9)
+
+    # ---- web_returns (~10% of web sales) -------------------------------
+    n_wr = n_ws // 10
+    wridx = rng.choice(n_ws, n_wr, replace=False)
+    wr_returned_date = np.minimum(ws_sold_date[wridx] +
+                                  rng.integers(1, 60, n_wr),
+                                  FIRST_SK + n_dates - 1).astype(np.int64)
+    wr_qty = np.maximum(1, ws_qty[wridx] // 2).astype(np.int64)
+    wr_amt = ws_sales_price[wridx] * wr_qty
+    wr_reason, wr_reason_v = fk(n_wr, n_reason)
+    table("web_returns",
+          [Field("wr_returned_date_sk", BIGINT),
+           Field("wr_item_sk", BIGINT), Field("wr_order_number", BIGINT),
+           Field("wr_returning_customer_sk", BIGINT),
+           Field("wr_returning_addr_sk", BIGINT),
+           Field("wr_refunded_customer_sk", BIGINT),
+           Field("wr_web_page_sk", BIGINT),
+           Field("wr_reason_sk", BIGINT),
+           Field("wr_return_quantity", BIGINT),
+           Field("wr_return_amt", D72),
+           Field("wr_refunded_cash", D72),
+           Field("wr_net_loss", D72)],
+          [wr_returned_date, ws_item[wridx], ws_order[wridx],
+           ws_cust[wridx], ws_addr[wridx], ws_cust[wridx], ws_wp[wridx],
+           wr_reason, wr_qty, wr_amt,
+           wr_amt * rng.integers(50, 101, n_wr) // 100,
+           wr_amt // 10 + money(n_wr, 50, 1000)],
+          valids=[None, None, None, ws_cust_v[wridx], ws_addr_v[wridx],
+                  ws_cust_v[wridx], ws_wp_v[wridx], wr_reason_v] +
+                 [None] * 4)
 
     # ---- inventory ------------------------------------------------------
     # weekly grain: every ~7th date x item sample x warehouse

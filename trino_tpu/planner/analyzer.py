@@ -63,7 +63,15 @@ class Scope:
             return None
 
 
-AGG_NAMES = {"sum", "avg", "count", "min", "max"}
+AGG_NAMES = {"sum", "avg", "count", "min", "max",
+             # variance family decomposes to sum/sum-of-squares/count with
+             # a post-aggregation finalizer (AccumulatorCompiler's
+             # VarianceState, operator/aggregation/VarianceAggregation)
+             "stddev", "stddev_samp", "stddev_pop",
+             "variance", "var_samp", "var_pop"}
+
+VARIANCE_AGGS = {"stddev", "stddev_samp", "stddev_pop",
+                 "variance", "var_samp", "var_pop"}
 
 
 def contains_aggregate(node: A.Node) -> bool:

@@ -34,10 +34,10 @@ from ..sql import ast_nodes as A
 from ..types import (BIGINT, BOOLEAN, DOUBLE, VARCHAR, DataType, TypeKind,
                      common_super_type)
 from . import logical as L
-from .analyzer import (AGG_NAMES, AnalysisError, ExpressionLowerer, Scope,
-                       ScopeColumn, ast_children, contains_aggregate,
-                       date_literal, flip, materialize_string,
-                       number_literal, parse_type)
+from .analyzer import (AGG_NAMES, VARIANCE_AGGS, AnalysisError,
+                       ExpressionLowerer, Scope, ScopeColumn, ast_children,
+                       contains_aggregate, date_literal, flip,
+                       materialize_string, number_literal, parse_type)
 
 from ..ops.aggregate import MAX_DIRECT_GROUPS  # dense-domain cutoff (64)
 
@@ -1234,6 +1234,25 @@ class Planner:
                     slot, t), "avg_cnt", BIGINT))
                 call_slots[call] = ("avg", len(agg_specs) - 2,
                                     len(agg_specs) - 1)
+            elif call.name in VARIANCE_AGGS:
+                # decompose to (sum x², sum x, count x) in DOUBLE; the
+                # finalizer divides/sqrt's post-aggregation (Trino's
+                # VarianceState accumulators)
+                x = ir.Cast(arg, DOUBLE) \
+                    if t.kind is not TypeKind.DOUBLE else arg
+                x_slot = add_arg(x)
+                sq_slot = add_arg(ir.arith("*", x, x))
+                agg_specs.append(L.AggSpecNode(
+                    "sum", ir.ColumnRef(sq_slot, DOUBLE), "var_sq",
+                    DOUBLE))
+                agg_specs.append(L.AggSpecNode(
+                    "sum", ir.ColumnRef(x_slot, DOUBLE), "var_sum",
+                    DOUBLE))
+                agg_specs.append(L.AggSpecNode(
+                    "count", ir.ColumnRef(x_slot, DOUBLE), "var_cnt",
+                    BIGINT))
+                call_slots[call] = ("var", len(agg_specs) - 3,
+                                    len(agg_specs) - 2)
 
         pre_node = L.ProjectNode(rel.node, tuple(pre_exprs),
                                  tuple(pre_cols))
@@ -1284,6 +1303,33 @@ class Planner:
                     if kind == "plain":
                         spec = agg_specs[s1]
                         return ir.ColumnRef(n_keys + s1, spec.out_dtype)
+                    if kind == "var":
+                        # finalize variance family from (Σx², Σx, n):
+                        # m2 = Σx² - (Σx)²/n; var_pop = m2/n,
+                        # var_samp = m2/(n-1); n-1 = 0 divides to NULL
+                        sq = ir.ColumnRef(n_keys + s1, DOUBLE)
+                        sm = ir.ColumnRef(n_keys + s2, DOUBLE)
+                        n_ref = ir.Cast(ir.ColumnRef(n_keys + s2 + 1,
+                                                     BIGINT), DOUBLE)
+                        m2_raw = ir.arith("-", sq, ir.arith(
+                            "/", ir.arith("*", sm, sm), n_ref))
+                        # clamp tiny negative fp residue so sqrt stays
+                        # defined (Trino's accumulators never go negative)
+                        zero = ir.Literal(0.0, DOUBLE)
+                        m2 = ir.Case(
+                            ((ir.Compare('<', m2_raw, zero), zero),),
+                            m2_raw, DOUBLE)
+                        name = node.name
+                        if name in ("variance", "var_samp", "stddev",
+                                    "stddev_samp"):
+                            denom = ir.arith("-", n_ref,
+                                             ir.Literal(1.0, DOUBLE))
+                        else:
+                            denom = n_ref
+                        var = ir.arith("/", m2, denom)
+                        if name.startswith("stddev"):
+                            return ir.ScalarFunc("sqrt", (var,), DOUBLE)
+                        return var
                     sum_ref = ir.ColumnRef(n_keys + s1,
                                            agg_specs[s1].out_dtype)
                     cnt_ref = ir.ColumnRef(n_keys + s2, BIGINT)

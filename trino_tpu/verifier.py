@@ -109,11 +109,43 @@ class Verifier:
 
 # -- sqlite loading / dialect translation (shared with tests/oracle.py) ----
 
+class _SqliteVar:
+    """Welford variance aggregate for the sqlite control (it ships none)."""
+    samp = True
+    sqrt = False
+
+    def __init__(self):
+        self.n, self.mean, self.m2 = 0, 0.0, 0.0
+
+    def step(self, x):
+        if x is None:
+            return
+        self.n += 1
+        d = x - self.mean
+        self.mean += d / self.n
+        self.m2 += d * (x - self.mean)
+
+    def finalize(self):
+        denom = (self.n - 1) if self.samp else self.n
+        if denom <= 0:
+            return None
+        v = self.m2 / denom
+        return v ** 0.5 if self.sqrt else v
+
+
 def _load_sqlite(datasets) -> sqlite3.Connection:
     import numpy as np
 
     from .types import TypeKind
     conn = sqlite3.connect(":memory:")
+    for name, samp, sq in [("var_samp", True, False),
+                           ("variance", True, False),
+                           ("var_pop", False, False),
+                           ("stddev", True, True),
+                           ("stddev_samp", True, True),
+                           ("stddev_pop", False, True)]:
+        cls = type(name, (_SqliteVar,), {"samp": samp, "sqrt": sq})
+        conn.create_aggregate(name, 1, cls)
     for t in datasets:
         cols = []
         for f in t.schema:
