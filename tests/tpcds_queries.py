@@ -681,3 +681,835 @@ ORDER BY cd_gender, cd_marital_status, cd_education_status,
          cd_purchase_estimate
 LIMIT 100
 """
+
+
+# ---- round 2 expansion: 31 additional spec queries ----
+
+# Batch A: single-fact aggregations, case buckets, channel unions
+# (written from the TPC-DS spec query definitions; adapted where noted)
+
+# q9: CASE bucket picks between avg columns by count thresholds
+QUERIES[9] = """
+SELECT CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20) > 2000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20) END bucket1,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40) > 3000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40) END bucket2,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60) > 1000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60) END bucket3
+FROM reason
+WHERE r_reason_sk = 1
+"""
+
+# q15: catalog sales by zip for qualifying zips/states/prices
+QUERIES[15] = """
+SELECT ca_zip, sum(cs_sales_price) total
+FROM catalog_sales, customer, customer_address, date_dim
+WHERE cs_bill_customer_sk = c_customer_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND (substr(ca_zip, 1, 5) IN ('85669', '86197', '88274', '83405',
+                                '86475', '85392', '85460', '80348',
+                                '81792')
+       OR ca_state IN ('CA', 'WA', 'GA')
+       OR cs_sales_price > 500)
+  AND cs_sold_date_sk = d_date_sk
+  AND d_qoy = 2 AND d_year = 2001
+GROUP BY ca_zip
+ORDER BY ca_zip
+LIMIT 100
+"""
+
+# q21: inventory before/after a date, ratio-bounded
+QUERIES[21] = """
+SELECT w_warehouse_name, i_item_id,
+       sum(CASE WHEN d_date < DATE '2000-03-11'
+                THEN inv_quantity_on_hand ELSE 0 END) AS inv_before,
+       sum(CASE WHEN d_date >= DATE '2000-03-11'
+                THEN inv_quantity_on_hand ELSE 0 END) AS inv_after
+FROM inventory, warehouse, item, date_dim
+WHERE i_item_sk = inv_item_sk
+  AND inv_warehouse_sk = w_warehouse_sk
+  AND inv_date_sk = d_date_sk
+  AND i_current_price BETWEEN 0.99 AND 1.49
+  AND d_date BETWEEN DATE '2000-02-10' AND DATE '2000-04-10'
+GROUP BY w_warehouse_name, i_item_id
+HAVING sum(CASE WHEN d_date < DATE '2000-03-11'
+                THEN inv_quantity_on_hand ELSE 0 END) > 0
+   AND sum(CASE WHEN d_date >= DATE '2000-03-11'
+                THEN inv_quantity_on_hand ELSE 0 END) * 3 >=
+       sum(CASE WHEN d_date < DATE '2000-03-11'
+                THEN inv_quantity_on_hand ELSE 0 END) * 2
+   AND sum(CASE WHEN d_date < DATE '2000-03-11'
+                THEN inv_quantity_on_hand ELSE 0 END) * 3 >=
+       sum(CASE WHEN d_date >= DATE '2000-03-11'
+                THEN inv_quantity_on_hand ELSE 0 END) * 2
+ORDER BY w_warehouse_name, i_item_id
+LIMIT 100
+"""
+
+# q25: store/returns/catalog profit by item and store
+QUERIES[25] = """
+SELECT i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_net_profit) AS store_sales_profit,
+       sum(sr_net_loss) AS store_returns_loss,
+       sum(cs_net_profit) AS catalog_sales_profit
+FROM store_sales, store_returns, catalog_sales, date_dim d1,
+     date_dim d2, date_dim d3, store, item
+WHERE d1.d_year = 2001
+  AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk
+  AND s_store_sk = ss_store_sk
+  AND ss_customer_sk = sr_customer_sk
+  AND ss_item_sk = sr_item_sk
+  AND ss_ticket_number = sr_ticket_number
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND d2.d_moy BETWEEN 4 AND 10 AND d2.d_year = 2001
+  AND sr_customer_sk = cs_bill_customer_sk
+  AND sr_item_sk = cs_item_sk
+  AND cs_sold_date_sk = d3.d_date_sk
+  AND d3.d_moy BETWEEN 4 AND 10 AND d3.d_year = 2001
+GROUP BY i_item_id, i_item_desc, s_store_id, s_store_name
+ORDER BY i_item_id, i_item_desc, s_store_id, s_store_name
+LIMIT 100
+"""
+
+# q29: same join shape, quantities
+QUERIES[29] = """
+SELECT i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_quantity) AS store_sales_quantity,
+       sum(sr_return_quantity) AS store_returns_quantity,
+       sum(cs_quantity) AS catalog_sales_quantity
+FROM store_sales, store_returns, catalog_sales, date_dim d1,
+     date_dim d2, date_dim d3, store, item
+WHERE d1.d_year = 1999
+  AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk
+  AND s_store_sk = ss_store_sk
+  AND ss_customer_sk = sr_customer_sk
+  AND ss_item_sk = sr_item_sk
+  AND ss_ticket_number = sr_ticket_number
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND d2.d_moy BETWEEN 9 AND 12 AND d2.d_year = 1999
+  AND sr_customer_sk = cs_bill_customer_sk
+  AND sr_item_sk = cs_item_sk
+  AND cs_sold_date_sk = d3.d_date_sk
+  AND d3.d_year IN (1999, 2000, 2001)
+GROUP BY i_item_id, i_item_desc, s_store_id, s_store_name
+ORDER BY i_item_id, i_item_desc, s_store_id, s_store_name
+LIMIT 100
+"""
+
+# q28: six price buckets (global distinct counts), cross-joined
+QUERIES[28] = """
+SELECT *
+FROM (SELECT avg(ss_list_price) b1_lp, count(ss_list_price) b1_cnt,
+             count(DISTINCT ss_list_price) b1_cntd
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 0 AND 5
+        AND (ss_list_price BETWEEN 8 AND 8 + 10
+             OR ss_coupon_amt BETWEEN 459 AND 459 + 1000
+             OR ss_wholesale_cost BETWEEN 57 AND 57 + 20)) b1,
+     (SELECT avg(ss_list_price) b2_lp, count(ss_list_price) b2_cnt,
+             count(DISTINCT ss_list_price) b2_cntd
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 6 AND 10
+        AND (ss_list_price BETWEEN 90 AND 90 + 10
+             OR ss_coupon_amt BETWEEN 2323 AND 2323 + 1000
+             OR ss_wholesale_cost BETWEEN 31 AND 31 + 20)) b2,
+     (SELECT avg(ss_list_price) b3_lp, count(ss_list_price) b3_cnt,
+             count(DISTINCT ss_list_price) b3_cntd
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 11 AND 15
+        AND (ss_list_price BETWEEN 142 AND 142 + 10
+             OR ss_coupon_amt BETWEEN 12214 AND 12214 + 1000
+             OR ss_wholesale_cost BETWEEN 79 AND 79 + 20)) b3,
+     (SELECT avg(ss_list_price) b4_lp, count(ss_list_price) b4_cnt,
+             count(DISTINCT ss_list_price) b4_cntd
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 16 AND 20
+        AND (ss_list_price BETWEEN 135 AND 135 + 10
+             OR ss_coupon_amt BETWEEN 6071 AND 6071 + 1000
+             OR ss_wholesale_cost BETWEEN 38 AND 38 + 20)) b4
+LIMIT 100
+"""
+
+# q76: null-FK sales by channel (UNION ALL with literal channel tags)
+QUERIES[76] = """
+SELECT channel, col_name, d_year, d_qoy, i_category,
+       count(*) sales_cnt, sum(ext_sales_price) sales_amt
+FROM (
+    SELECT 'store' AS channel, 'ss_customer_sk' col_name, d_year, d_qoy,
+           i_category, ss_ext_sales_price ext_sales_price
+    FROM store_sales, item, date_dim
+    WHERE ss_customer_sk IS NULL
+      AND ss_sold_date_sk = d_date_sk
+      AND ss_item_sk = i_item_sk
+    UNION ALL
+    SELECT 'web' AS channel, 'ws_promo_sk' col_name, d_year, d_qoy,
+           i_category, ws_ext_sales_price ext_sales_price
+    FROM web_sales, item, date_dim
+    WHERE ws_promo_sk IS NULL
+      AND ws_sold_date_sk = d_date_sk
+      AND ws_item_sk = i_item_sk
+    UNION ALL
+    SELECT 'catalog' AS channel, 'cs_bill_customer_sk' col_name, d_year,
+           d_qoy, i_category, cs_ext_sales_price ext_sales_price
+    FROM catalog_sales, item, date_dim
+    WHERE cs_bill_customer_sk IS NULL
+      AND cs_sold_date_sk = d_date_sk
+      AND cs_item_sk = i_item_sk) foo
+GROUP BY channel, col_name, d_year, d_qoy, i_category
+ORDER BY channel, col_name, d_year, d_qoy, i_category
+LIMIT 100
+"""
+
+# q88: store time-bucket counts, 4 cross-joined single-row subqueries
+# (spec has 8; 4 keeps the text shorter with the same shape)
+QUERIES[88] = """
+SELECT *
+FROM (SELECT count(*) h8_30_to_9
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = t_time_sk
+        AND ss_hdemo_sk = hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND t_hour = 8 AND t_minute >= 30
+        AND ((hd_dep_count = 4 AND hd_vehicle_count <= 6)
+             OR (hd_dep_count = 2 AND hd_vehicle_count <= 4)
+             OR (hd_dep_count = 0 AND hd_vehicle_count <= 2))
+        AND s_store_name = 'ese') s1,
+     (SELECT count(*) h9_to_9_30
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = t_time_sk
+        AND ss_hdemo_sk = hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND t_hour = 9 AND t_minute < 30
+        AND ((hd_dep_count = 4 AND hd_vehicle_count <= 6)
+             OR (hd_dep_count = 2 AND hd_vehicle_count <= 4)
+             OR (hd_dep_count = 0 AND hd_vehicle_count <= 2))
+        AND s_store_name = 'ese') s2,
+     (SELECT count(*) h9_30_to_10
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = t_time_sk
+        AND ss_hdemo_sk = hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND t_hour = 9 AND t_minute >= 30
+        AND ((hd_dep_count = 4 AND hd_vehicle_count <= 6)
+             OR (hd_dep_count = 2 AND hd_vehicle_count <= 4)
+             OR (hd_dep_count = 0 AND hd_vehicle_count <= 2))
+        AND s_store_name = 'ese') s3,
+     (SELECT count(*) h10_to_10_30
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = t_time_sk
+        AND ss_hdemo_sk = hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND t_hour = 10 AND t_minute < 30
+        AND ((hd_dep_count = 4 AND hd_vehicle_count <= 6)
+             OR (hd_dep_count = 2 AND hd_vehicle_count <= 4)
+             OR (hd_dep_count = 0 AND hd_vehicle_count <= 2))
+        AND s_store_name = 'ese') s4
+"""
+
+# q62: web shipping day-buckets by warehouse/ship mode/site
+QUERIES[62] = """
+SELECT substr(w_warehouse_name, 1, 20) wh, sm_type, web_name,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk <= 30
+                THEN 1 ELSE 0 END) AS d30,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 30
+                 AND ws_ship_date_sk - ws_sold_date_sk <= 60
+                THEN 1 ELSE 0 END) AS d60,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 60
+                 AND ws_ship_date_sk - ws_sold_date_sk <= 90
+                THEN 1 ELSE 0 END) AS d90,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 90
+                THEN 1 ELSE 0 END) AS dmore
+FROM web_sales, warehouse, ship_mode, web_site, date_dim
+WHERE d_month_seq BETWEEN 1200 AND 1211
+  AND ws_ship_date_sk = d_date_sk
+  AND ws_warehouse_sk = w_warehouse_sk
+  AND ws_ship_mode_sk = sm_ship_mode_sk
+  AND ws_web_site_sk = web_site_sk
+GROUP BY substr(w_warehouse_name, 1, 20), sm_type, web_name
+ORDER BY wh, sm_type, web_name
+LIMIT 100
+"""
+
+# q99: catalog shipping day-buckets by warehouse/ship mode/call center
+QUERIES[99] = """
+SELECT substr(w_warehouse_name, 1, 20) wh, sm_type, cc_name,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk <= 30
+                THEN 1 ELSE 0 END) AS d30,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 30
+                 AND cs_ship_date_sk - cs_sold_date_sk <= 60
+                THEN 1 ELSE 0 END) AS d60,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 60
+                 AND cs_ship_date_sk - cs_sold_date_sk <= 90
+                THEN 1 ELSE 0 END) AS d90,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 90
+                THEN 1 ELSE 0 END) AS dmore
+FROM catalog_sales, warehouse, ship_mode, call_center, date_dim
+WHERE d_month_seq BETWEEN 1200 AND 1211
+  AND cs_ship_date_sk = d_date_sk
+  AND cs_warehouse_sk = w_warehouse_sk
+  AND cs_ship_mode_sk = sm_ship_mode_sk
+  AND cs_call_center_sk = cc_call_center_sk
+GROUP BY substr(w_warehouse_name, 1, 20), sm_type, cc_name
+ORDER BY wh, sm_type, cc_name
+LIMIT 100
+"""
+
+# Batch B: correlated subqueries, CTE self-joins, intersect/except
+
+# q32: catalog excess discount (correlated avg over same item+dates)
+QUERIES[32] = """
+SELECT sum(cs_ext_discount_amt) AS excess_discount
+FROM catalog_sales, item, date_dim
+WHERE i_manufact_id = 269
+  AND i_item_sk = cs_item_sk
+  AND d_date BETWEEN DATE '1998-03-18' AND DATE '1998-03-18' + INTERVAL '90' DAY
+  AND d_date_sk = cs_sold_date_sk
+  AND cs_ext_discount_amt > (
+        SELECT 1.3 * avg(cs_ext_discount_amt)
+        FROM catalog_sales, date_dim
+        WHERE cs_item_sk = i_item_sk
+          AND d_date BETWEEN DATE '1998-03-18'
+                         AND DATE '1998-03-18' + INTERVAL '90' DAY
+          AND d_date_sk = cs_sold_date_sk)
+"""
+
+# q92: web excess discount (same shape on web_sales)
+QUERIES[92] = """
+SELECT sum(ws_ext_discount_amt) AS excess_discount
+FROM web_sales, item, date_dim
+WHERE i_manufact_id = 269
+  AND i_item_sk = ws_item_sk
+  AND d_date BETWEEN DATE '1998-03-18' AND DATE '1998-03-18' + INTERVAL '90' DAY
+  AND d_date_sk = ws_sold_date_sk
+  AND ws_ext_discount_amt > (
+        SELECT 1.3 * avg(ws_ext_discount_amt)
+        FROM web_sales, date_dim
+        WHERE ws_item_sk = i_item_sk
+          AND d_date BETWEEN DATE '1998-03-18'
+                         AND DATE '1998-03-18' + INTERVAL '90' DAY
+          AND d_date_sk = ws_sold_date_sk)
+"""
+
+# q38: customers active in all three channels in a month window
+QUERIES[38] = """
+SELECT count(*)
+FROM (
+    SELECT DISTINCT c_last_name, c_first_name, d_date
+    FROM store_sales, date_dim, customer
+    WHERE ss_sold_date_sk = d_date_sk
+      AND ss_customer_sk = c_customer_sk
+      AND d_month_seq BETWEEN 1200 AND 1200 + 11
+    INTERSECT
+    SELECT DISTINCT c_last_name, c_first_name, d_date
+    FROM catalog_sales, date_dim, customer
+    WHERE cs_sold_date_sk = d_date_sk
+      AND cs_bill_customer_sk = c_customer_sk
+      AND d_month_seq BETWEEN 1200 AND 1200 + 11
+    INTERSECT
+    SELECT DISTINCT c_last_name, c_first_name, d_date
+    FROM web_sales, date_dim, customer
+    WHERE ws_sold_date_sk = d_date_sk
+      AND ws_bill_customer_sk = c_customer_sk
+      AND d_month_seq BETWEEN 1200 AND 1200 + 11) hot_cust
+LIMIT 100
+"""
+
+# q87: customers in store but not catalog/web (EXCEPT chain)
+QUERIES[87] = """
+SELECT count(*)
+FROM (SELECT DISTINCT c_last_name, c_first_name, d_date
+      FROM store_sales, date_dim, customer
+      WHERE ss_sold_date_sk = d_date_sk
+        AND ss_customer_sk = c_customer_sk
+        AND d_month_seq BETWEEN 1200 AND 1200 + 11
+      EXCEPT
+      SELECT DISTINCT c_last_name, c_first_name, d_date
+      FROM catalog_sales, date_dim, customer
+      WHERE cs_sold_date_sk = d_date_sk
+        AND cs_bill_customer_sk = c_customer_sk
+        AND d_month_seq BETWEEN 1200 AND 1200 + 11
+      EXCEPT
+      SELECT DISTINCT c_last_name, c_first_name, d_date
+      FROM web_sales, date_dim, customer
+      WHERE ws_sold_date_sk = d_date_sk
+        AND ws_bill_customer_sk = c_customer_sk
+        AND d_month_seq BETWEEN 1200 AND 1200 + 11) cool_cust
+"""
+
+# q31: county quarter-over-quarter growth, store vs web (CTE self-joins)
+QUERIES[31] = """
+WITH ss AS (
+    SELECT ca_county, d_qoy, d_year, sum(ss_ext_sales_price) store_sales
+    FROM store_sales, date_dim, customer_address
+    WHERE ss_sold_date_sk = d_date_sk AND ss_addr_sk = ca_address_sk
+    GROUP BY ca_county, d_qoy, d_year),
+ws AS (
+    SELECT ca_county, d_qoy, d_year, sum(ws_ext_sales_price) web_sales
+    FROM web_sales, date_dim, customer_address
+    WHERE ws_sold_date_sk = d_date_sk AND ws_bill_addr_sk = ca_address_sk
+    GROUP BY ca_county, d_qoy, d_year)
+SELECT ss1.ca_county, ss1.d_year,
+       ws2.web_sales / ws1.web_sales web_q1_q2_increase,
+       ss2.store_sales / ss1.store_sales store_q1_q2_increase
+FROM ss ss1, ss ss2, ws ws1, ws ws2
+WHERE ss1.d_qoy = 1 AND ss1.d_year = 2000
+  AND ss1.ca_county = ss2.ca_county
+  AND ss2.d_qoy = 2 AND ss2.d_year = 2000
+  AND ss2.ca_county = ws1.ca_county
+  AND ws1.d_qoy = 1 AND ws1.d_year = 2000
+  AND ws1.ca_county = ws2.ca_county
+  AND ws2.d_qoy = 2 AND ws2.d_year = 2000
+  AND CASE WHEN ws1.web_sales > 0
+           THEN ws2.web_sales / ws1.web_sales ELSE NULL END >
+      CASE WHEN ss1.store_sales > 0
+           THEN ss2.store_sales / ss1.store_sales ELSE NULL END
+ORDER BY ss1.ca_county
+"""
+
+# q16: catalog orders shipped from one warehouse with no returns
+# (adapted: cc_county list reduced to one value)
+QUERIES[16] = """
+SELECT count(DISTINCT cs_order_number) AS order_count,
+       sum(cs_ext_ship_cost) AS total_shipping_cost,
+       sum(cs_net_profit) AS total_net_profit
+FROM catalog_sales cs1, date_dim, customer_address, call_center
+WHERE d_date BETWEEN DATE '2002-02-01' AND DATE '2002-02-01' + INTERVAL '60' DAY
+  AND cs1.cs_ship_date_sk = d_date_sk
+  AND cs1.cs_ship_addr_sk = ca_address_sk
+  AND ca_state = 'GA'
+  AND cs1.cs_call_center_sk = cc_call_center_sk
+  AND cc_county = 'Williamson County'
+  AND EXISTS (SELECT * FROM catalog_sales cs2
+              WHERE cs1.cs_order_number = cs2.cs_order_number
+                AND cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
+  AND NOT EXISTS (SELECT * FROM catalog_returns cr1
+                  WHERE cs1.cs_order_number = cr1.cr_order_number)
+"""
+
+# q94: web orders shipped from one site with no returns
+QUERIES[94] = """
+SELECT count(DISTINCT ws_order_number) AS order_count,
+       sum(ws_ext_ship_cost) AS total_shipping_cost,
+       sum(ws_net_profit) AS total_net_profit
+FROM web_sales ws1, date_dim, customer_address, web_site
+WHERE d_date BETWEEN DATE '1999-02-01' AND DATE '1999-02-01' + INTERVAL '60' DAY
+  AND ws1.ws_ship_date_sk = d_date_sk
+  AND ws1.ws_ship_addr_sk = ca_address_sk
+  AND ca_state = 'IL'
+  AND ws1.ws_web_site_sk = web_site_sk
+  AND EXISTS (SELECT * FROM web_sales ws2
+              WHERE ws1.ws_order_number = ws2.ws_order_number
+                AND ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+  AND NOT EXISTS (SELECT * FROM web_returns wr1
+                  WHERE ws1.ws_order_number = wr1.wr_order_number)
+"""
+
+# q61: promotional vs all sales ratio (two cross-joined aggregates)
+QUERIES[61] = """
+SELECT promotions, total,
+       cast(promotions AS double) / cast(total AS double) * 100 AS pct
+FROM (SELECT sum(ss_ext_sales_price) promotions
+      FROM store_sales, store, promotion, date_dim, customer,
+           customer_address, item
+      WHERE ss_sold_date_sk = d_date_sk
+        AND ss_store_sk = s_store_sk
+        AND ss_promo_sk = p_promo_sk
+        AND ss_customer_sk = c_customer_sk
+        AND ca_address_sk = c_current_addr_sk
+        AND ss_item_sk = i_item_sk
+        AND ca_gmt_offset = -5
+        AND i_category = 'Jewelry'
+        AND (p_channel_dmail = 'Y' OR p_channel_email = 'Y'
+             OR p_channel_tv = 'Y')
+        AND s_gmt_offset = -5
+        AND d_year = 1998
+        AND d_moy = 11) promotional_sales,
+     (SELECT sum(ss_ext_sales_price) total
+      FROM store_sales, store, date_dim, customer, customer_address, item
+      WHERE ss_sold_date_sk = d_date_sk
+        AND ss_store_sk = s_store_sk
+        AND ss_customer_sk = c_customer_sk
+        AND ca_address_sk = c_current_addr_sk
+        AND ss_item_sk = i_item_sk
+        AND ca_gmt_offset = -5
+        AND i_category = 'Jewelry'
+        AND s_gmt_offset = -5
+        AND d_year = 1998
+        AND d_moy = 11) all_sales
+ORDER BY promotions, total
+LIMIT 100
+"""
+
+# q90: web am/pm sales count ratio
+QUERIES[90] = """
+SELECT cast(amc AS double) / cast(pmc AS double) AS am_pm_ratio
+FROM (SELECT count(*) amc
+      FROM web_sales, household_demographics, time_dim, web_page
+      WHERE ws_sold_time_sk = t_time_sk
+        AND ws_ship_hdemo_sk = hd_demo_sk
+        AND ws_web_page_sk = wp_web_page_sk
+        AND t_hour BETWEEN 8 AND 9
+        AND hd_dep_count = 6
+        AND wp_char_count BETWEEN 5000 AND 5200) at_shift,
+     (SELECT count(*) pmc
+      FROM web_sales, household_demographics, time_dim, web_page
+      WHERE ws_sold_time_sk = t_time_sk
+        AND ws_ship_hdemo_sk = hd_demo_sk
+        AND ws_web_page_sk = wp_web_page_sk
+        AND t_hour BETWEEN 19 AND 20
+        AND hd_dep_count = 6
+        AND wp_char_count BETWEEN 5000 AND 5200) pm_shift
+ORDER BY am_pm_ratio
+LIMIT 100
+"""
+
+# q92 uses ws_ext_discount_amt; q90 needs ws_ship_hdemo_sk — adapted to
+# available columns below if the original is missing.
+
+# Batch C: EXISTS demographics, window ratios/ranks, returns analytics,
+# weekly/yearly self-joins
+
+# q10: county customers active in any channel, demographic counts
+QUERIES[10] = """
+SELECT cd_gender, cd_marital_status, cd_education_status, count(*) cnt1,
+       cd_purchase_estimate, count(*) cnt2, cd_credit_rating, count(*) cnt3
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND ca_county IN ('Walker County', 'Richland County', 'Franklin Parish')
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT * FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk
+                AND d_year = 2002 AND d_moy BETWEEN 1 AND 4)
+  AND (EXISTS (SELECT * FROM web_sales, date_dim
+               WHERE c.c_customer_sk = ws_bill_customer_sk
+                 AND ws_sold_date_sk = d_date_sk
+                 AND d_year = 2002 AND d_moy BETWEEN 1 AND 4)
+       OR EXISTS (SELECT * FROM catalog_sales, date_dim
+                  WHERE c.c_customer_sk = cs_ship_customer_sk
+                    AND cs_sold_date_sk = d_date_sk
+                    AND d_year = 2002 AND d_moy BETWEEN 1 AND 4))
+GROUP BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+ORDER BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+LIMIT 100
+"""
+
+# q35: like q10 with aggregate triples per demographic
+QUERIES[35] = """
+SELECT ca_state, cd_gender, cd_marital_status, cd_dep_count,
+       count(*) cnt1, avg(cd_dep_count) a1, max(cd_dep_count) m1,
+       sum(cd_dep_count) s1
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT * FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk
+                AND d_year = 2002 AND d_qoy < 4)
+  AND (EXISTS (SELECT * FROM web_sales, date_dim
+               WHERE c.c_customer_sk = ws_bill_customer_sk
+                 AND ws_sold_date_sk = d_date_sk
+                 AND d_year = 2002 AND d_qoy < 4)
+       OR EXISTS (SELECT * FROM catalog_sales, date_dim
+                  WHERE c.c_customer_sk = cs_ship_customer_sk
+                    AND cs_sold_date_sk = d_date_sk
+                    AND d_year = 2002 AND d_qoy < 4))
+GROUP BY ca_state, cd_gender, cd_marital_status, cd_dep_count
+ORDER BY ca_state, cd_gender, cd_marital_status, cd_dep_count
+LIMIT 100
+"""
+
+# q12: web revenue share within class (window ratio)
+QUERIES[12] = """
+SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ws_ext_sales_price) AS itemrevenue,
+       sum(ws_ext_sales_price) * 100 /
+       sum(sum(ws_ext_sales_price)) OVER (PARTITION BY i_class)
+       AS revenueratio
+FROM web_sales, item, date_dim
+WHERE ws_item_sk = i_item_sk
+  AND i_category IN ('Sports', 'Books', 'Home')
+  AND ws_sold_date_sk = d_date_sk
+  AND d_date BETWEEN DATE '1999-02-22' AND DATE '1999-02-22' + INTERVAL '30' DAY
+GROUP BY i_item_id, i_item_desc, i_category, i_class, i_current_price
+ORDER BY i_category, i_class, i_item_id, i_item_desc, revenueratio
+LIMIT 100
+"""
+
+# q20: catalog revenue share within class (window ratio)
+QUERIES[20] = """
+SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(cs_ext_sales_price) AS itemrevenue,
+       sum(cs_ext_sales_price) * 100 /
+       sum(sum(cs_ext_sales_price)) OVER (PARTITION BY i_class)
+       AS revenueratio
+FROM catalog_sales, item, date_dim
+WHERE cs_item_sk = i_item_sk
+  AND i_category IN ('Sports', 'Books', 'Home')
+  AND cs_sold_date_sk = d_date_sk
+  AND d_date BETWEEN DATE '1999-02-22' AND DATE '1999-02-22' + INTERVAL '30' DAY
+GROUP BY i_item_id, i_item_desc, i_category, i_class, i_current_price
+ORDER BY i_category, i_class, i_item_id, i_item_desc, revenueratio
+LIMIT 100
+"""
+
+# q30: web returns per customer vs 1.2x state average (CTE reuse)
+QUERIES[30] = """
+WITH customer_total_return AS (
+    SELECT wr_returning_customer_sk AS ctr_customer_sk,
+           ca_state AS ctr_state,
+           sum(wr_return_amt) AS ctr_total_return
+    FROM web_returns, date_dim, customer_address
+    WHERE wr_returned_date_sk = d_date_sk
+      AND d_year = 2002
+      AND wr_returning_addr_sk = ca_address_sk
+    GROUP BY wr_returning_customer_sk, ca_state)
+SELECT c_customer_id, c_salutation, c_first_name, c_last_name,
+       c_preferred_cust_flag, c_birth_year, ctr_total_return
+FROM customer_total_return ctr1, customer_address, customer
+WHERE ctr1.ctr_total_return > (
+        SELECT avg(ctr_total_return) * 1.2
+        FROM customer_total_return ctr2
+        WHERE ctr1.ctr_state = ctr2.ctr_state)
+  AND ca_address_sk = c_current_addr_sk
+  AND ca_state = 'GA'
+  AND ctr1.ctr_customer_sk = c_customer_sk
+ORDER BY c_customer_id, c_salutation, c_first_name, c_last_name,
+         c_preferred_cust_flag, c_birth_year, ctr_total_return
+LIMIT 100
+"""
+
+# q81: catalog returns per customer vs 1.2x state average
+QUERIES[81] = """
+WITH customer_total_return AS (
+    SELECT cr_returning_customer_sk AS ctr_customer_sk,
+           ca_state AS ctr_state,
+           sum(cr_return_amount) AS ctr_total_return
+    FROM catalog_returns, date_dim, customer_address
+    WHERE cr_returned_date_sk = d_date_sk
+      AND d_year = 2000
+      AND cr_returning_addr_sk = ca_address_sk
+    GROUP BY cr_returning_customer_sk, ca_state)
+SELECT c_customer_id, c_salutation, c_first_name, c_last_name,
+       ca_city, ca_zip, ctr_total_return
+FROM customer_total_return ctr1, customer_address, customer
+WHERE ctr1.ctr_total_return > (
+        SELECT avg(ctr_total_return) * 1.2
+        FROM customer_total_return ctr2
+        WHERE ctr1.ctr_state = ctr2.ctr_state)
+  AND ca_address_sk = c_current_addr_sk
+  AND ca_state = 'GA'
+  AND ctr1.ctr_customer_sk = c_customer_sk
+ORDER BY c_customer_id, c_salutation, c_first_name, c_last_name,
+         ca_city, ca_zip, ctr_total_return
+LIMIT 100
+"""
+
+# q91: call center returns by demographic slice
+QUERIES[91] = """
+SELECT cc_name AS call_center, cc_manager AS manager,
+       sum(cr_net_loss) AS returns_loss
+FROM call_center, catalog_returns, date_dim, customer,
+     customer_demographics, household_demographics
+WHERE cr_call_center_sk = cc_call_center_sk
+  AND cr_returned_date_sk = d_date_sk
+  AND cr_returning_customer_sk = c_customer_sk
+  AND cd_demo_sk = c_current_cdemo_sk
+  AND hd_demo_sk = c_current_hdemo_sk
+  AND d_year = 1998
+  AND ((cd_marital_status = 'M' AND cd_education_status = 'Unknown')
+       OR (cd_marital_status = 'W'
+           AND cd_education_status = 'Advanced Degree'))
+  AND hd_buy_potential LIKE 'Unknown%'
+GROUP BY cc_name, cc_manager, cd_marital_status, cd_education_status
+ORDER BY returns_loss DESC, call_center, manager
+"""
+
+# q40: catalog sales +/- returns around a date by warehouse state
+QUERIES[40] = """
+SELECT w_state, i_item_id,
+       sum(CASE WHEN d_date < DATE '2000-03-11'
+                THEN cs_sales_price - coalesce(cr_refunded_cash, 0)
+                ELSE 0 END) AS sales_before,
+       sum(CASE WHEN d_date >= DATE '2000-03-11'
+                THEN cs_sales_price - coalesce(cr_refunded_cash, 0)
+                ELSE 0 END) AS sales_after
+FROM catalog_sales
+LEFT OUTER JOIN catalog_returns
+  ON (cs_order_number = cr_order_number AND cs_item_sk = cr_item_sk)
+, warehouse, item, date_dim
+WHERE i_current_price BETWEEN 0.99 AND 1.49
+  AND i_item_sk = cs_item_sk
+  AND cs_warehouse_sk = w_warehouse_sk
+  AND cs_sold_date_sk = d_date_sk
+  AND d_date BETWEEN DATE '2000-02-10' AND DATE '2000-04-10'
+GROUP BY w_state, i_item_id
+ORDER BY w_state, i_item_id
+LIMIT 100
+"""
+
+# q50: store returns latency buckets by store
+QUERIES[50] = """
+SELECT s_store_name, s_market_id,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk <= 30
+                THEN 1 ELSE 0 END) AS d30,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk > 30
+                 AND sr_returned_date_sk - ss_sold_date_sk <= 60
+                THEN 1 ELSE 0 END) AS d60,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk > 60
+                THEN 1 ELSE 0 END) AS dmore
+FROM store_sales, store_returns, store, date_dim d1, date_dim d2
+WHERE d2.d_year = 2001 AND d2.d_moy = 8
+  AND ss_ticket_number = sr_ticket_number
+  AND ss_item_sk = sr_item_sk
+  AND ss_sold_date_sk = d1.d_date_sk
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND ss_customer_sk = sr_customer_sk
+  AND ss_store_sk = s_store_sk
+GROUP BY s_store_name, s_market_id
+ORDER BY s_store_name, s_market_id
+LIMIT 100
+"""
+
+# q44: best/worst performing items by avg net profit (rank windows)
+QUERIES[44] = """
+SELECT asceding.rnk, i1.i_product_name best_performing,
+       i2.i_product_name worst_performing
+FROM (SELECT * FROM (
+        SELECT item_sk, rank() OVER (ORDER BY rank_col ASC) rnk
+        FROM (SELECT ss_item_sk item_sk, avg(ss_net_profit) rank_col
+              FROM store_sales ss1
+              WHERE ss_store_sk = 4
+              GROUP BY ss_item_sk
+              HAVING avg(ss_net_profit) > 0.9 * (
+                  SELECT avg(ss_net_profit) rank_col
+                  FROM store_sales
+                  WHERE ss_store_sk = 4
+                    AND ss_promo_sk IS NULL)) v1) v11
+      WHERE rnk < 11) asceding,
+     (SELECT * FROM (
+        SELECT item_sk, rank() OVER (ORDER BY rank_col DESC) rnk
+        FROM (SELECT ss_item_sk item_sk, avg(ss_net_profit) rank_col
+              FROM store_sales ss1
+              WHERE ss_store_sk = 4
+              GROUP BY ss_item_sk
+              HAVING avg(ss_net_profit) > 0.9 * (
+                  SELECT avg(ss_net_profit) rank_col
+                  FROM store_sales
+                  WHERE ss_store_sk = 4
+                    AND ss_promo_sk IS NULL)) v2) v21
+      WHERE rnk < 11) descending,
+     item i1, item i2
+WHERE asceding.rnk = descending.rnk
+  AND i1.i_item_sk = asceding.item_sk
+  AND i2.i_item_sk = descending.item_sk
+ORDER BY asceding.rnk
+"""
+
+# q2: week-over-year web+catalog sales ratios (53-week offset self-join)
+QUERIES[2] = """
+WITH wscs AS (
+    SELECT sold_date_sk, sales_price
+    FROM (SELECT ws_sold_date_sk sold_date_sk,
+                 ws_ext_sales_price sales_price
+          FROM web_sales
+          UNION ALL
+          SELECT cs_sold_date_sk sold_date_sk,
+                 cs_ext_sales_price sales_price
+          FROM catalog_sales) x),
+wswscs AS (
+    SELECT d_week_seq,
+           sum(CASE WHEN d_day_name = 'Sunday'
+                    THEN sales_price ELSE NULL END) sun_sales,
+           sum(CASE WHEN d_day_name = 'Monday'
+                    THEN sales_price ELSE NULL END) mon_sales,
+           sum(CASE WHEN d_day_name = 'Friday'
+                    THEN sales_price ELSE NULL END) fri_sales,
+           sum(CASE WHEN d_day_name = 'Saturday'
+                    THEN sales_price ELSE NULL END) sat_sales
+    FROM wscs, date_dim
+    WHERE d_date_sk = sold_date_sk
+    GROUP BY d_week_seq)
+SELECT d_week_seq1, round(sun_sales1 / sun_sales2, 2) r1,
+       round(mon_sales1 / mon_sales2, 2) r2,
+       round(fri_sales1 / fri_sales2, 2) r3,
+       round(sat_sales1 / sat_sales2, 2) r4
+FROM (SELECT wswscs.d_week_seq d_week_seq1, sun_sales sun_sales1,
+             mon_sales mon_sales1, fri_sales fri_sales1,
+             sat_sales sat_sales1
+      FROM wswscs, date_dim
+      WHERE date_dim.d_week_seq = wswscs.d_week_seq
+        AND d_year = 2001) y,
+     (SELECT wswscs.d_week_seq d_week_seq2, sun_sales sun_sales2,
+             mon_sales mon_sales2, fri_sales fri_sales2,
+             sat_sales sat_sales2
+      FROM wswscs, date_dim
+      WHERE date_dim.d_week_seq = wswscs.d_week_seq
+        AND d_year = 2002) z
+WHERE d_week_seq1 = d_week_seq2 - 53
+ORDER BY d_week_seq1
+"""
+
+# q74: year-over-year customer growth, store vs web (adapted: growth
+# ratio comparison on sums)
+QUERIES[74] = """
+WITH year_total AS (
+    SELECT c_customer_id customer_id, c_first_name customer_first_name,
+           c_last_name customer_last_name, d_year AS year1,
+           sum(ss_net_paid) year_total, 's' sale_type
+    FROM customer, store_sales, date_dim
+    WHERE c_customer_sk = ss_customer_sk
+      AND ss_sold_date_sk = d_date_sk
+      AND d_year IN (2001, 2002)
+    GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+    UNION ALL
+    SELECT c_customer_id customer_id, c_first_name customer_first_name,
+           c_last_name customer_last_name, d_year AS year1,
+           sum(ws_net_paid) year_total, 'w' sale_type
+    FROM customer, web_sales, date_dim
+    WHERE c_customer_sk = ws_bill_customer_sk
+      AND ws_sold_date_sk = d_date_sk
+      AND d_year IN (2001, 2002)
+    GROUP BY c_customer_id, c_first_name, c_last_name, d_year)
+SELECT t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.sale_type = 's'
+  AND t_w_firstyear.sale_type = 'w'
+  AND t_s_secyear.sale_type = 's'
+  AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.year1 = 2001
+  AND t_s_secyear.year1 = 2002
+  AND t_w_firstyear.year1 = 2001
+  AND t_w_secyear.year1 = 2002
+  AND t_s_firstyear.year_total > 0
+  AND t_w_firstyear.year_total > 0
+  AND CASE WHEN t_w_firstyear.year_total > 0
+           THEN t_w_secyear.year_total / t_w_firstyear.year_total
+           ELSE NULL END >
+      CASE WHEN t_s_firstyear.year_total > 0
+           THEN t_s_secyear.year_total / t_s_firstyear.year_total
+           ELSE NULL END
+ORDER BY 1, 2, 3
+LIMIT 100
+"""

@@ -681,6 +681,7 @@ def generate(scale: float, seed: int = 19980101) -> Dict[str, TableData]:
     ws_wp, ws_wp_v = fk(n_ws, n_wp)
     ws_ship_cust, ws_ship_cust_v = fk(n_ws, n_cust)
     ws_ship_addr, ws_ship_addr_v = fk(n_ws, n_ca)
+    ws_ship_hd, ws_ship_hd_v = fk(n_ws, n_hd)
     ws_ext_list = ws_list * ws_qty
     ws_ext_wholesale = ws_wholesale * ws_qty
     ws_ext_discount = ws_ext_list - ws_ext_sales
@@ -705,6 +706,7 @@ def generate(scale: float, seed: int = 19980101) -> Dict[str, TableData]:
            Field("ws_web_page_sk", BIGINT),
            Field("ws_ship_customer_sk", BIGINT),
            Field("ws_ship_addr_sk", BIGINT),
+           Field("ws_ship_hdemo_sk", BIGINT),
            Field("ws_wholesale_cost", D72), Field("ws_list_price", D72),
            Field("ws_ext_list_price", D72),
            Field("ws_ext_wholesale_cost", D72),
@@ -715,13 +717,13 @@ def generate(scale: float, seed: int = 19980101) -> Dict[str, TableData]:
            ws_order, ws_qty, ws_sales_price, ws_ext_sales, ws_net_paid,
            ws_net_profit,
            ws_ship_date, ws_time, ws_wh, ws_sm, ws_wp, ws_ship_cust,
-           ws_ship_addr, ws_wholesale, ws_list, ws_ext_list,
+           ws_ship_addr, ws_ship_hd, ws_wholesale, ws_list, ws_ext_list,
            ws_ext_wholesale, ws_ext_discount, ws_ext_tax, ws_coupon,
            ws_ext_ship, ws_net_paid_tax],
           valids=[ws_date_v, None, ws_cust_v, ws_addr_v, ws_site_v,
                   ws_promo_v] + [None] * 6 +
                  [None, None, ws_wh_v, ws_sm_v, ws_wp_v, ws_ship_cust_v,
-                  ws_ship_addr_v] + [None] * 9)
+                  ws_ship_addr_v, ws_ship_hd_v] + [None] * 9)
 
     # ---- web_returns (~10% of web sales) -------------------------------
     n_wr = n_ws // 10

@@ -317,9 +317,17 @@ class Executor:
                                        capacity)
             n_groups = int(out.live.sum())
             if n_groups < capacity or capacity >= child.capacity:
-                return out
+                break
             capacity *= 4
             self.stats.agg_capacity_retries += 1
+        if n_groups == 0 and not node.group_keys:
+            # zero-key sort aggregation (global DISTINCT) over an empty
+            # input: SQL still requires one output row (0 counts / NULL
+            # sums) — duplicates are irrelevant on empty input, so the
+            # plain global kernel supplies it
+            plain = tuple(AggSpec(a.func, a.arg_index) for a in aggs)
+            return global_aggregate(child, plain)
+        return out
 
     # ---- uncorrelated scalar subqueries (fold to constants) ----------
 
@@ -403,6 +411,8 @@ class Executor:
         build = self.run(node.right)
         self.validate_key_ranges(build, node.right_keys)
         probe = self.apply_dynamic_filter(node, probe, build)
+        if node.kind == "mark":
+            return self.run_mark_join(node, probe, build)
         if node.kind in ("semi", "anti"):
             return self.run_membership_join(node, probe, build)
         if node.build_unique:
@@ -432,9 +442,10 @@ class Executor:
         kernel (sort/join/agg) runs at the reduced size — the analog of
         Trino skipping probe splits entirely.
 
-        Skipped for anti joins (they keep non-matching rows) and left
-        joins (outer rows survive)."""
-        if node.kind in ("anti", "left") or node.null_aware:
+        Skipped for anti joins (they keep non-matching rows), left joins
+        (outer rows survive), and mark joins (non-matching rows carry
+        mark=false)."""
+        if node.kind in ("anti", "left", "mark") or node.null_aware:
             return probe
         for pk_i, bk_i in zip(node.left_keys, node.right_keys):
             bk = build.columns[bk_i]
@@ -454,6 +465,31 @@ class Executor:
             self.stats.dynamic_filter_compactions += 1
             probe = compact_batch(probe, new_cap)
         return probe
+
+    def run_mark_join(self, node: L.JoinNode, probe: Batch,
+                      build: Batch) -> Batch:
+        """EXISTS truth as an appended boolean column (JoinNode.Type.MARK
+        in the reference): every probe row survives; the mark powers
+        disjunctive EXISTS filters downstream. Build duplicates are
+        irrelevant (membership semantics)."""
+        if node.residual is None:
+            out, _dup = join_unique_build(probe, build, node.left_keys,
+                                          node.right_keys, "semi")
+            mark = out.live          # live & matched
+        else:
+            residual = self.fold_scalars(node.residual)
+            cap = probe.capacity
+            while True:
+                mark, total = join_mark(probe, build, node.left_keys,
+                                        node.right_keys, residual, cap)
+                total = int(total)
+                if total <= cap:
+                    break
+                cap = pad_capacity(total)
+                self.stats.join_expansion_retries += 1
+            mark = probe.live & mark
+        return Batch(probe.columns +
+                     (Column(mark, jnp.ones_like(mark)),), probe.live)
 
     def run_membership_join(self, node: L.JoinNode, probe: Batch,
                             build: Batch) -> Batch:

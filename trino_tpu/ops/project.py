@@ -327,6 +327,8 @@ def eval_expr(expr: ir.Expr, batch: Batch):
 
     if isinstance(expr, ir.DictPredicate):
         d, v = eval_expr(expr.arg, batch)
+        if len(expr.lut) == 0:      # empty pool: no code can match
+            return jnp.zeros_like(d, dtype=jnp.bool_), v
         lut = jnp.asarray(expr.lut, dtype=jnp.bool_)
         codes = jnp.clip(d.astype(jnp.int32), 0, len(expr.lut) - 1)
         return lut[codes], v

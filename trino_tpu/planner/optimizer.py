@@ -112,14 +112,20 @@ def _prune(node: L.PlanNode, needed: frozenset):
                    if old < len(node.output)}
         residual = None if node.residual is None else \
             ir.remap_columns(node.residual, pair_mapping)
+        if node.kind == "mark":
+            # output = probe ++ $mark: the mark column rides along at the
+            # end regardless of probe pruning
+            output = tuple(left.output) + (node.output[n_probe],)
+            mapping[n_probe] = n_new_probe
+        elif node.kind in ("inner", "left"):
+            output = tuple(left.output) + tuple(right.output)
+        else:
+            output = tuple(left.output)
         return L.JoinNode(
             node.kind, left, right,
             tuple(ml[k] for k in node.left_keys),
             tuple(mr[k] for k in node.right_keys),
-            residual, node.build_unique,
-            tuple(left.output) + (tuple(right.output)
-                                  if node.kind in ("inner", "left")
-                                  else ()),
+            residual, node.build_unique, output,
             null_aware=node.null_aware), mapping
 
     if isinstance(node, L.WindowNode):

@@ -388,14 +388,49 @@ class Planner:
             connected = [r for r in pending
                          if self.has_equi_edge(acc, r, conjuncts)]
             if not connected:
-                raise AnalysisError(
-                    "cross join without equi-condition not yet supported")
+                # cross join (NestedLoopJoinOperator's role): join on a
+                # synthesized constant key so the expansion kernel
+                # produces the cartesian product — the common shape is
+                # single-row aggregate subqueries placed side by side
+                # (TPC-DS q28/q88)
+                chosen = min(pending, key=lambda r:
+                             self.estimate_rows(r.node))
+                pending.remove(chosen)
+                acc = self.cross_join_pair(acc, chosen)
+                acc = self.apply_local_filters(acc, conjuncts)
+                continue
             chosen = min(connected, key=lambda r:
                          self.estimate_rows(r.node))
             pending.remove(chosen)
             acc = self.join_pair(acc, chosen, conjuncts, kind="inner")
             acc = self.apply_local_filters(acc, conjuncts)
         return acc
+
+    def cross_join_pair(self, left: PlannedRelation,
+                        right: PlannedRelation) -> PlannedRelation:
+        """Cartesian product via a constant-key equi-join: both sides gain
+        a $ck=0 column; the expansion kernel's 1:N fan-out does the rest.
+        The appended key columns stay out of the scope (like make_join's
+        remapped varchar keys)."""
+        zero = ir.Literal(0, BIGINT)
+
+        def with_key(node: L.PlanNode):
+            exprs = tuple(ir.ColumnRef(i, dt)
+                          for i, (_, dt) in enumerate(node.output))
+            out = tuple(node.output) + (("$ck", BIGINT),)
+            return L.ProjectNode(node, exprs + (zero,), out), \
+                len(node.output)
+
+        pnode, pk = with_key(left.node)
+        bnode, bk = with_key(right.node)
+        out = tuple(pnode.output) + tuple(bnode.output)
+        node = L.JoinNode("inner", pnode, bnode, (pk,), (bk,), None,
+                          False, out)
+        n_left = len(pnode.output)
+        cols = list(left.scope.columns) + [
+            ScopeColumn(c.qualifier, c.name, c.dtype, c.index + n_left,
+                        c.field) for c in right.scope.columns]
+        return PlannedRelation(node, Scope(cols))
 
     # ---- cardinality estimation (cost/StatsCalculator.java:22's role) --
 
@@ -612,8 +647,13 @@ class Planner:
             build_node = L.ProjectNode(
                 build_node, exprs,
                 tuple(build_node.output) + tuple(extra_cols))
-        output = tuple(probe_node.output) + \
-            (tuple(build_node.output) if kind in ("inner", "left") else ())
+        if kind in ("inner", "left"):
+            output = tuple(probe_node.output) + tuple(build_node.output)
+        elif kind == "mark":
+            # mark join: probe columns + the EXISTS truth column
+            output = tuple(probe_node.output) + (("$mark", BOOLEAN),)
+        else:
+            output = tuple(probe_node.output)
         return L.JoinNode(kind, probe_node, build_node,
                           tuple(probe_keys), tuple(build_keys), residual,
                           build_unique, output, null_aware=null_aware)
@@ -1190,10 +1230,6 @@ class Planner:
         for call in agg_calls:
             if call.distinct and call.name == "avg":
                 raise AnalysisError("avg(DISTINCT) not yet supported")
-            if call.distinct and call.name in ("sum", "count"):
-                if not group_irs:
-                    raise AnalysisError(
-                        "global DISTINCT aggregates not yet supported")
             if call.is_star or (call.name == "count" and not call.args):
                 agg_specs.append(L.AggSpecNode("count_star", None,
                                                "count", BIGINT))
@@ -1378,7 +1414,7 @@ class Planner:
         out_cols = []
         final_scope = []
         for i, (ast, name) in enumerate(items):
-            e = rewrite(ast)
+            e = materialize_string(rewrite(ast))
             post_exprs.append(e)
             names.append(name)
             out_cols.append((name, e.dtype))
@@ -1387,6 +1423,10 @@ class Planner:
                 fld = post_scope.columns[e.index].field
             if fld is None and isinstance(ast, A.WindowFunc):
                 fld = wfields.get(ast)
+            if fld is None:
+                # literal tags ('s' AS sale_type) and derived dictionary
+                # expressions keep their pools through aggregation
+                fld = self.field_for(e, post_scope)
             final_scope.append(ScopeColumn(None, name, e.dtype, i, fld))
 
         post_node = L.ProjectNode(current, tuple(post_exprs),
@@ -1434,6 +1474,12 @@ class Planner:
     def agg_strategy(self, group_irs, scope: Scope, pre_node,
                      any_distinct: bool = False):
         if not group_irs:
+            # global DISTINCT aggregates run the sort kernel with zero
+            # group keys (one segment); the executor falls back to
+            # global_aggregate on empty input so the mandatory single
+            # output row survives
+            if any_distinct:
+                return "sort", (), 1
             return "global", (), 0
         if any_distinct:
             return "sort", (), DEFAULT_SORT_GROUPS   # needs the sort kernel
@@ -1488,7 +1534,83 @@ class Planner:
             if isinstance(c.left, A.ScalarSubquery):
                 return self.plan_correlated_scalar(rel, flip(c.op), c.right,
                                                    c.left.query)
+        if isinstance(c, A.BinaryOp) and c.op == "or":
+            return self.plan_disjunctive_exists(rel, c)
         return None
+
+    def plan_disjunctive_exists(self, rel: PlannedRelation,
+                                c: A.Node) -> Optional[PlannedRelation]:
+        """(EXISTS s1 OR EXISTS s2 OR plain-pred ...) -> mark joins.
+
+        Each EXISTS term becomes a mark join appending a hidden boolean
+        column (TransformExistsApplyToCorrelatedJoin's MARK variant,
+        operator-level JoinNode.Type.MARK in the reference); the disjunct
+        then filters on the marks. EXISTS truth is 2-valued, so NOT
+        EXISTS inside OR is a plain negation of its mark."""
+        terms: List[A.Node] = []
+
+        def flatten(node):
+            if isinstance(node, A.BinaryOp) and node.op == "or":
+                flatten(node.left)
+                flatten(node.right)
+            else:
+                terms.append(node)
+        flatten(c)
+
+        def as_exists(t):
+            if isinstance(t, A.ExistsPredicate):
+                return t.query, t.negated
+            if isinstance(t, A.UnaryOp) and t.op == "not" and \
+                    isinstance(t.arg, A.ExistsPredicate):
+                return t.arg.query, not t.arg.negated
+            return None
+
+        def has_subquery(node) -> bool:
+            if isinstance(node, (A.ExistsPredicate, A.InSubquery,
+                                 A.ScalarSubquery)):
+                return True
+            return any(has_subquery(ch) for ch in ast_children(node))
+
+        exists_terms = [as_exists(t) for t in terms]
+        if not any(e is not None for e in exists_terms):
+            return None
+        if any(e is None and has_subquery(t)
+               for t, e in zip(terms, exists_terms)):
+            return None          # OR mixing other subquery shapes: punt
+
+        current = rel
+        parts: List[ir.Expr] = []
+        for t, e in zip(terms, exists_terms):
+            if e is None:
+                lowerer = ExpressionLowerer(current.scope, planner=self)
+                parts.append(lowerer.to_bool(lowerer.lower(t)))
+                continue
+            subq, negated = e
+            inner, corr, residual_asts = self.plan_inner_with_correlation(
+                current, subq)
+            if not corr:
+                return None
+            residual = None
+            if residual_asts:
+                lw = ExpressionLowerer(self.pair_scope(current, inner),
+                                       planner=self)
+                preds = [lw.to_bool(lw.lower(x)) for x in residual_asts]
+                residual = preds[0] if len(preds) == 1 else ir.Logical(
+                    "and", tuple(preds))
+            node = self.make_join(
+                "mark", current.node, inner.node,
+                tuple(o for o, _ in corr),
+                tuple(cc.index for _, cc in corr), residual, False,
+                probe_fields=[self._scope_field(current.scope, o)
+                              for o, _ in corr],
+                build_fields=[cc.field for _, cc in corr])
+            mark = ir.ColumnRef(len(node.output) - 1, BOOLEAN)
+            parts.append(ir.Not(mark, BOOLEAN) if negated else mark)
+            current = PlannedRelation(node, current.scope)
+        pred = parts[0] if len(parts) == 1 else ir.Logical(
+            "or", tuple(parts))
+        out = L.FilterNode(current.node, pred, current.node.output)
+        return PlannedRelation(out, rel.scope)
 
     def plan_inner_with_correlation(self, outer: PlannedRelation,
                                     subq: A.Query):

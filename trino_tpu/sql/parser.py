@@ -464,6 +464,18 @@ class Parser:
                     self.fail("derived table requires an alias")
                 return A.SubqueryRef(q, t.raw if t.kind == "name"
                                      else t.raw[1:-1])
+            if self.at_op("("):
+                # '((...' — either a parenthesized set operation used as
+                # a derived table, or a parenthesized join relation:
+                # try the query grammar first, backtrack on failure
+                mark = self.i
+                try:
+                    q = self.parse_query()
+                    self.expect_op(")")
+                    alias = self.maybe_alias()
+                    return A.SubqueryRef(q, alias or "$setop")
+                except SqlSyntaxError:
+                    self.i = mark
             rel = self.parse_relation()
             self.expect_op(")")
             return rel
