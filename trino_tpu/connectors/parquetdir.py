@@ -1,0 +1,135 @@
+"""Parquet file connector.
+
+Reference role: the parquet storage tier (lib/trino-parquet
+reader/ParquetReader.java:103 feeding the hive-style connectors). A root
+directory holds schemas as subdirectories and tables as `<name>.parquet`
+files; columns map onto the engine's types:
+
+- INT64 -> BIGINT, INT32 -> INTEGER, DOUBLE -> DOUBLE, BOOLEAN -> BOOLEAN
+- BYTE_ARRAY (UTF8) -> VARCHAR, dictionary-encoded at load (strings never
+  reach the device — the ingest policy shared with every connector)
+
+`export_table` writes engine tables back out (TableWriter + the parquet
+writer), which is also how round-trip tests and benchmark datasets are
+produced in an environment with no external parquet tooling.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..batch import Field, Schema
+from ..formats.parquet import read_parquet, write_parquet
+from ..types import BIGINT, BOOLEAN, DOUBLE, INTEGER, TypeKind, VARCHAR
+from .tpch.datagen import TableData
+
+
+def load_parquet(path: str, name: str) -> TableData:
+    from ..types import DATE, decimal
+    names, columns, valids, logicals = read_parquet(path)
+    fields: List[Field] = []
+    arrays: List[np.ndarray] = []
+    out_valids: List[Optional[np.ndarray]] = []
+    for cname, col, valid, logical in zip(names, columns, valids,
+                                          logicals):
+        if col.dtype == object:              # BYTE_ARRAY -> dict varchar
+            mask = valid if valid is not None else \
+                np.ones(len(col), dtype=np.bool_)
+            pool = sorted({s for s, v in zip(col, mask) if v})
+            index = {s: i for i, s in enumerate(pool)}
+            codes = np.fromiter((index.get(s, 0) for s in col),
+                                dtype=np.int32, count=len(col))
+            arrays.append(codes)
+            fields.append(Field(cname, VARCHAR, dictionary=tuple(pool)))
+        elif logical is not None and logical[0] == "decimal":
+            arrays.append(np.asarray(col, dtype=np.int64))
+            fields.append(Field(cname, decimal(logical[1], logical[2])))
+        elif logical is not None and logical[0] == "date":
+            arrays.append(np.asarray(col, dtype=np.int32))
+            fields.append(Field(cname, DATE))
+        elif col.dtype == np.dtype("<i8"):
+            arrays.append(np.asarray(col, dtype=np.int64))
+            fields.append(Field(cname, BIGINT))
+        elif col.dtype == np.dtype("<i4"):
+            arrays.append(np.asarray(col, dtype=np.int32))
+            fields.append(Field(cname, INTEGER))
+        elif col.dtype == np.dtype("<f8"):
+            arrays.append(np.asarray(col, dtype=np.float64))
+            fields.append(Field(cname, DOUBLE))
+        elif col.dtype == np.bool_:
+            arrays.append(np.asarray(col))
+            fields.append(Field(cname, BOOLEAN))
+        else:
+            raise ValueError(f"{name}.{cname}: unsupported parquet dtype "
+                             f"{col.dtype}")
+        out_valids.append(valid)
+    if all(v is None for v in out_valids):
+        out_valids = None
+    return TableData(name, Schema(tuple(fields)), arrays,
+                     valids=out_valids)
+
+
+def export_table(data: TableData, path: str) -> None:
+    """Engine TableData -> parquet file: dictionary codes decode back to
+    strings; DECIMAL/DATE columns carry converted-type annotations so a
+    round trip reconstructs the exact engine types."""
+    names, arrays, valids, logicals = [], [], [], []
+    for i, f in enumerate(data.schema):
+        names.append(f.name)
+        col = np.asarray(data.columns[i])
+        valid = None if data.valids is None else data.valids[i]
+        logical = None
+        if f.dtype.kind is TypeKind.VARCHAR:
+            pool = np.array(f.dictionary, dtype=object)
+            col = pool[col]
+        elif f.dtype.kind is TypeKind.DECIMAL:
+            col = col.astype(np.int64)
+            logical = ("decimal", f.dtype.precision, f.dtype.scale)
+        elif f.dtype.kind is TypeKind.DATE:
+            col = col.astype(np.int32)
+            logical = ("date",)
+        arrays.append(col)
+        valids.append(None if valid is None else np.asarray(valid))
+        logicals.append(logical)
+    write_parquet(path, names, arrays, valids, logicals)
+
+
+class ParquetConnector:
+    name = "parquet"
+
+    def __init__(self, root: str):
+        self.root = root
+        self._cache: Dict[Tuple[str, str], TableData] = {}
+
+    def _schema_dir(self, schema: str) -> str:
+        return os.path.join(self.root, schema)
+
+    def schema_names(self):
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(d for d in os.listdir(self.root)
+                      if os.path.isdir(os.path.join(self.root, d)))
+
+    def table_names(self, schema: str):
+        d = self._schema_dir(schema)
+        if not os.path.isdir(d):
+            return []
+        return sorted(f[:-8] for f in os.listdir(d)
+                      if f.endswith(".parquet"))
+
+    def get_table(self, schema: str, table: str) -> TableData:
+        key = (schema, table)
+        if key not in self._cache:
+            path = os.path.join(self._schema_dir(schema),
+                                f"{table}.parquet")
+            if not os.path.isfile(path):
+                raise KeyError(f"parquet table {schema}.{table} not found "
+                               f"({path})")
+            self._cache[key] = load_parquet(path, table)
+        return self._cache[key]
+
+    def get_table_schema(self, schema: str, table: str) -> Schema:
+        return self.get_table(schema, table).schema

@@ -1,0 +1,1 @@
+"""File format libraries (the lib/trino-parquet / trino-orc tier)."""
