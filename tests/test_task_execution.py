@@ -151,3 +151,34 @@ def _json_vals(row):
         else:
             out.append(str(v))
     return out
+
+
+def test_durable_exchange_resumes_from_spool(cluster):
+    """FTE recovery at task granularity: a failure at the stage boundary
+    (after source tasks spooled their outputs) triggers a QUERY retry,
+    which must consume the spool instead of re-running tasks — the
+    DeduplicatingDirectExchangeBuffer + FileSystemExchangeManager shape."""
+    from trino_tpu.server.failureinjector import FailureInjector
+    coord, workers, session = cluster
+    sched = coord.state.scheduler
+    sched.spool.clear()
+    coord.state.dispatcher.retry_policy = "QUERY"
+    injector = FailureInjector()
+    injector.inject("STAGE_BOUNDARY", times=1)
+    sched.failure_injector = injector
+    ran_before = sum(w.task_manager.tasks_run for w in workers)
+    want = _local_rows(session, Q1)
+    try:
+        client = Client(coord.uri, user="test")
+        r = client.execute(Q1)
+    finally:
+        sched.failure_injector = None
+        coord.state.dispatcher.retry_policy = "NONE"
+    assert injector.injected_count == 1
+    assert r.state == "FINISHED"
+    assert [tuple(row) for row in r.rows] == \
+        [tuple(_json_vals(row)) for row in want]
+    # the retry consumed spooled outputs: no new task executions
+    ran_after = sum(w.task_manager.tasks_run for w in workers)
+    first_attempt_tasks = ran_after - ran_before
+    assert sched.stats["spool_hits"] >= first_attempt_tasks >= 1

@@ -1,0 +1,74 @@
+"""Durable exchange spool: task outputs persisted across attempts.
+
+Reference: the fault-tolerant execution exchange —
+spi/exchange/ExchangeManager.java + FileSystemExchangeManager.java:40 spool
+every task's output partitions durably, so a retry re-runs only failed
+tasks and consumers deduplicate attempts
+(DeduplicatingDirectExchangeBuffer.java:87,
+spi/exchange/ExchangeSourceOutputSelector.java).
+
+TPU runtime shape: the coordinator is the exchange consumer. Every drained
+task's pages are written here keyed by the *work identity* — a digest of
+(fragment, splits) — not the attempt, so any successful attempt satisfies
+the key and later attempts of the same work are never re-dispatched: the
+scheduler checks the spool before POSTing a task, which turns retry-policy
+QUERY into task-granularity recovery (only unfinished work re-executes).
+Local disk plays the object store's role (the SPI boundary to swap in a
+real one is this class)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import threading
+from typing import List, Optional
+
+
+class ExchangeSpool:
+    def __init__(self, root: Optional[str] = None):
+        # default scope is one coordinator lifetime (fresh directory):
+        # the recovery quantum is a retried attempt within it. Pass an
+        # explicit root for durability across coordinator restarts.
+        self.root = root or tempfile.mkdtemp(prefix="trino_tpu_exchange_")
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def work_key(fragment_blob: str, splits) -> str:
+        """Digest of the task's deterministic work identity."""
+        h = hashlib.sha256()
+        h.update(fragment_blob.encode())
+        for s in splits:
+            h.update(f"{s.catalog}.{s.schema_name}.{s.table}"
+                     f":{s.start}+{s.count}".encode())
+        return h.hexdigest()[:32]
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, f"{key}.json")
+
+    def get(self, key: str) -> Optional[List[dict]]:
+        try:
+            with open(self._path(key)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def put(self, key: str, pages: List[dict]) -> None:
+        # write-then-rename: a crashed writer never leaves a torn file a
+        # later attempt could read (the exactly-one-attempt guarantee)
+        path = self._path(key)
+        with self._lock:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(pages, f)
+            os.replace(tmp, path)
+
+    def clear(self) -> None:
+        for f in os.listdir(self.root):
+            if f.endswith(".json"):
+                try:
+                    os.unlink(os.path.join(self.root, f))
+                except OSError:
+                    pass

@@ -104,7 +104,8 @@ class StageScheduler:
     path — Trino's coordinator-only queries take the same shortcut)."""
 
     def __init__(self, coordinator_state, session, split_rows: int = 250_000,
-                 max_task_retries: int = 2, task_timeout_s: float = 300.0):
+                 max_task_retries: int = 2, task_timeout_s: float = 300.0,
+                 spool=None):
         self.state = coordinator_state
         self.session = session
         self.split_rows = split_rows
@@ -113,7 +114,12 @@ class StageScheduler:
         self._seq = 0
         self._lock = threading.Lock()
         self.stats: Dict[str, int] = {"queries": 0, "tasks": 0,
-                                      "task_retries": 0}
+                                      "task_retries": 0, "spool_hits": 0}
+        # durable exchange (FTE): drained task outputs persist keyed by
+        # work identity; later attempts reuse instead of re-running
+        from .exchange_spool import ExchangeSpool
+        self.spool = spool if spool is not None else ExchangeSpool()
+        self.failure_injector = None     # hook: fail between stages
 
     # -- eligibility + planning -------------------------------------------
 
@@ -140,6 +146,10 @@ class StageScheduler:
             return None
         rel, root, analysis = planned
         partial_pages = self._run_source_stage(workers, analysis, root)
+        if self.failure_injector is not None:
+            # between-stage failure point: source outputs are already
+            # spooled, so the QUERY retry resumes from them
+            self.failure_injector.maybe_fail("STAGE_BOUNDARY", sql)
         result = self._run_final_stage(rel, root, analysis, partial_pages)
         result.elapsed_s = time.monotonic() - t0
         self.stats["queries"] += 1
@@ -162,6 +172,10 @@ class StageScheduler:
             is not None else root.child
         blob = encode_fragment({"root": fragment_root,
                                 "driver": analysis.driver})
+        # the work key hashes (fragment, splits) but not data contents:
+        # only deterministic generator sources may reuse spooled outputs
+        # (a memory-connector table can change between attempts)
+        use_spool = analysis.driver.catalog in ("tpch", "tpcds")
         splits = self._make_splits(analysis)
         # uniform assignment (UniformNodeSelector's round-robin core)
         assignment: Dict[str, List[Split]] = {w.node_id: [] for w in workers}
@@ -175,7 +189,16 @@ class StageScheduler:
         while pending:
             tasks: List[RemoteTask] = []
             failed: Dict[str, List[Split]] = {}
-            for nid, sp in pending.items():
+            for nid, sp in list(pending.items()):
+                # durable-exchange hit: a prior attempt already produced
+                # this work's output — consume the spool, skip dispatch
+                key = self.spool.work_key(blob, sp)
+                spooled = self.spool.get(key) if use_spool else None
+                if spooled is not None:
+                    pages.extend(spooled)
+                    self.stats["spool_hits"] += 1
+                    del pending[nid]
+                    continue
                 with self._lock:
                     self._seq += 1
                     tid = f"t{self._seq}"
@@ -190,7 +213,11 @@ class StageScheduler:
             deadline = time.time() + self.task_timeout_s
             for task in tasks:
                 try:
-                    pages.extend(task.drain(deadline))
+                    drained = task.drain(deadline)
+                    pages.extend(drained)
+                    if use_spool:
+                        self.spool.put(self.spool.work_key(
+                            blob, task.splits), drained)
                 except (TaskFailedError, URLError, HTTPError, OSError) as e:
                     self._mark_failed(task.node.node_id, e)
                     failed[task.node.node_id] = task.splits
