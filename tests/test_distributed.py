@@ -43,11 +43,11 @@ def test_sharded_scan_placement(session, oracle):
     check(session, oracle, "SELECT count(*) FROM lineitem")
 
 
-# the distributed executor must pass the same oracle suite as the local one
-@pytest.mark.parametrize("qid", [1, 3, 5, 6, 7, 12,
-                                 14, 19])
+# the distributed executor must pass the same oracle suite as the local
+# one — the FULL list (VERDICT round-1 item 7)
+@pytest.mark.parametrize("qid", sorted(QUERIES))
 def test_tpch_distributed(session, oracle, qid):
-    check(session, oracle, QUERIES[qid])
+    check(session, oracle, QUERIES[qid], abs_tol=0.02)
 
 
 def test_distributed_window(session, oracle):
@@ -64,3 +64,37 @@ def test_distributed_join_agg(session, oracle):
         FROM customer, nation
         WHERE c_nationkey = n_nationkey
         GROUP BY n_name ORDER BY c DESC, n_name""")
+
+
+# ---- full TPC-DS suite through the mesh executor ----
+
+from tpcds_queries import QUERIES as DS_QUERIES
+from trino_tpu.connectors.tpcds.connector import TABLE_NAMES as DS_TABLES
+
+
+@pytest.fixture(scope="module")
+def ds_session():
+    s = Session(default_cat="tpcds", default_schema="tiny")
+    s.executor = MeshExecutor(s.catalog, make_mesh(8))
+    return s
+
+
+@pytest.fixture(scope="module")
+def ds_oracle(ds_session):
+    conn = ds_session.catalog.connector("tpcds")
+    return load_oracle([conn.get_table("tiny", t) for t in DS_TABLES])
+
+
+# full 61-query distributed sweep ~8 min on the virtual mesh: CI runs a
+# cross-section; TRINO_TPU_FULL_DIST=1 runs everything (the full-run
+# record lives in docs/verification.md)
+import os
+_DS_DIST = sorted(DS_QUERIES) if os.environ.get("TRINO_TPU_FULL_DIST") \
+    else sorted(DS_QUERIES)[::4]
+
+
+@pytest.mark.parametrize("qid", _DS_DIST)
+def test_tpcds_distributed(ds_session, ds_oracle, qid):
+    got = ds_session.execute(DS_QUERIES[qid]).rows
+    want = oracle_query(ds_oracle, DS_QUERIES[qid])
+    assert_rows_match(got, want, rel_tol=1e-6, abs_tol=0.02)

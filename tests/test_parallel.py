@@ -83,7 +83,8 @@ def test_sharded_join_agg_matches_numpy(mesh):
 
     step = sharded_join_agg_step(mesh, 8, None, 1, None, 0,
                                  post, (0,), (6,), aggs)
-    dist = step(shard_rows(probe, mesh), shard_rows(build, mesh))
+    dist, dups = step(shard_rows(probe, mesh), shard_rows(build, mesh))
+    assert int(dups) == 0          # unique build keys: no silent drops
 
     want = np_join_agg(group, key, val, bkey, bval)
     live = np.asarray(dist.live)
@@ -154,3 +155,26 @@ def test_2d_mesh_distributed_query():
     r = s.execute("SELECT count(*) FROM lineitem, orders "
                   "WHERE l_orderkey = o_orderkey AND o_totalprice > 100")
     assert r.rows[0][0] > 0
+
+
+def test_sharded_join_detects_duplicate_build_keys(mesh):
+    """The mesh fast path assumes unique build keys; a duplicate must be
+    SURFACED (dups > 0), not silently dropped (the round-1 _dup hole)."""
+    import numpy as np
+    from trino_tpu.batch import batch_from_numpy
+    from trino_tpu.ops.aggregate import AggSpec
+    from trino_tpu.parallel.mesh import shard_rows
+    from trino_tpu.parallel.stages import sharded_join_agg_step
+
+    group = np.zeros(8192, dtype=np.int32)
+    key = np.arange(8192, dtype=np.int64) % 100 + 1
+    val = np.ones(8192, dtype=np.int64)
+    probe = batch_from_numpy([group, key, val], pad_multiple=8192)
+    bkey = np.concatenate([np.arange(1, 401, dtype=np.int64),
+                           np.array([7], dtype=np.int64)])  # dup key 7
+    bval = np.ones(len(bkey), dtype=np.int64)
+    build = batch_from_numpy([bkey, bval], pad_multiple=8192)
+    step = sharded_join_agg_step(mesh, 8, None, 1, None, 0,
+                                 None, (0,), (6,), (AggSpec("sum", 2),))
+    _out, dups = step(shard_rows(probe, mesh), shard_rows(build, mesh))
+    assert int(dups) >= 1

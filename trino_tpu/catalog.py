@@ -17,6 +17,7 @@ from .connectors.tpch.connector import TpchConnector
 class Catalog:
     def __init__(self):
         self._connectors: Dict[str, object] = {}
+        self._stats_cache: Dict[tuple, object] = {}
 
     def register(self, name: str, connector) -> None:
         self._connectors[name] = connector
@@ -31,6 +32,30 @@ class Catalog:
         if schema == "information_schema":
             return self.information_schema_table(catalog, table)
         return self.connector(catalog).get_table(schema, table)
+
+    def get_table_stats(self, catalog: str, schema: str, table: str):
+        """TableStats for an already-materialized table, else None —
+        plan-time stats must never trigger SF1000 generation
+        (spi/statistics ConnectorTableStatistics role, cached)."""
+        key = (catalog, schema, table)
+        if key in self._stats_cache:
+            return self._stats_cache[key]
+        try:
+            conn = self.connector(catalog)
+            if hasattr(conn, "scale_for_schema"):
+                # generator connectors: only stats for materialized scales
+                scale = conn.scale_for_schema(schema)
+                data = conn._cache.get(scale, {}).get(table)
+            else:
+                data = conn.get_table(schema, table)
+        except Exception:
+            data = None
+        if data is None:
+            return None
+        from .stats import compute_table_stats
+        stats = compute_table_stats(data)
+        self._stats_cache[key] = stats
+        return stats
 
     def information_schema_table(self, catalog: str, table: str):
         """Synthesize information_schema.{schemata,tables,columns} from

@@ -112,9 +112,25 @@ class Session:
                            self.executor.stats)
 
     def execute_explain(self, stmt: A.Explain, t0) -> QueryResult:
-        rel = self.planner().plan_query(stmt.query)
+        planner = self.planner()
+        rel = planner.plan_query(stmt.query)
         root = prune_plan(rel.node)
-        annotate = None
+
+        def estimate(node) -> str:
+            """Cost-model annotations (EXPLAIN shows estimates —
+            cost/PlanNodeStatsEstimate rendering)."""
+            try:
+                est = planner.estimate_rows(node)
+            except Exception:
+                return ""
+            extra = ""
+            from ..planner.logical import JoinNode
+            if isinstance(node, JoinNode) and \
+                    node.distribution != "auto":
+                extra = f", {node.distribution.upper()}"
+            return f"{{rows: {est:,.0f}{extra}}}"
+
+        annotate = estimate
         if stmt.analyze:
             saved = self.executor.profile
             self.executor.profile = True
@@ -127,9 +143,10 @@ class Session:
 
             def annotate(node):
                 s = stats.get(id(node))
+                est = estimate(node)
                 if s is None:
-                    return ""
-                return f"[{s[0] * 1000:.2f}ms, {s[1]} rows]"
+                    return est
+                return f"[{s[0] * 1000:.2f}ms, {s[1]} rows] {est}"
         text = explain_text(root, annotate=annotate)
         return QueryResult(["query plan"],
                            [(line,) for line in text.split("\n")],

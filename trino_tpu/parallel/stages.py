@@ -66,22 +66,28 @@ def sharded_join_agg_step(mesh, n_shards: int,
     agg_funcs = tuple(a.func for a in aggs)
     n_keys = len(agg_keys)
 
-    def body(probe: Batch, build: Batch) -> Batch:
+    def body(probe: Batch, build: Batch):
         if probe_filter is not None:
             probe = apply_filter(probe, probe_filter)
         if build_filter is not None:
             build = apply_filter(build, build_filter)
         probe = repartition_by_key(probe, probe_key, n_shards)
         build = repartition_by_key(build, build_key, n_shards)
-        joined, _dup = join_unique_build(probe, build, (probe_key,),
-                                         (build_key,), "inner")
+        joined, dup = join_unique_build(probe, build, (probe_key,),
+                                        (build_key,), "inner")
         if post_exprs is not None:
             joined = project(joined, post_exprs)
         partial = direct_group_aggregate(joined, agg_keys, domains, aggs)
-        return merge_partial_states(partial, agg_funcs, n_keys)
+        # surface build-key duplicates: hash partitioning co-locates all
+        # rows of a key, so a duplicate would silently drop join rows —
+        # the caller must check total_dups == 0 and fall back to the
+        # general expansion path (MeshExecutor) otherwise
+        total_dups = jax.lax.psum(dup, AXIS)
+        return merge_partial_states(partial, agg_funcs, n_keys), total_dups
 
     mapped = jax.shard_map(body, mesh=mesh,
-                           in_specs=(P(AXIS), P(AXIS)), out_specs=P(),
+                           in_specs=(P(AXIS), P(AXIS)),
+                           out_specs=(P(), P()),
                            check_vma=False)
     return jax.jit(mapped)
 

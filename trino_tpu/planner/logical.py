@@ -76,7 +76,7 @@ class JoinNode(PlanNode):
     """JoinNode (sql/planner/plan/JoinNode.java). Equi-join; left side is
     the probe, right side the build (LookupJoinOperator convention:
     HashBuilderOperator consumes the build side)."""
-    kind: str                         # inner|left|semi|anti
+    kind: str                         # inner|left|semi|anti|mark
     left: PlanNode                    # probe
     right: PlanNode                   # build
     left_keys: Tuple[int, ...]
@@ -85,6 +85,9 @@ class JoinNode(PlanNode):
     build_unique: bool                # planner's guarantee/assumption
     output: Tuple
     null_aware: bool = False          # NOT IN semantics (anti only)
+    # cost-chosen exchange strategy for the build side on a mesh
+    # (DetermineJoinDistributionType.java:51): REPLICATED vs PARTITIONED
+    distribution: str = "auto"        # auto|broadcast|partitioned
 
 
 @dataclass(frozen=True)

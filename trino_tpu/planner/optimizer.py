@@ -126,7 +126,8 @@ def _prune(node: L.PlanNode, needed: frozenset):
             tuple(ml[k] for k in node.left_keys),
             tuple(mr[k] for k in node.right_keys),
             residual, node.build_unique, output,
-            null_aware=node.null_aware), mapping
+            null_aware=node.null_aware,
+            distribution=node.distribution), mapping
 
     if isinstance(node, L.WindowNode):
         c = len(node.child.output)

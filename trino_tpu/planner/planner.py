@@ -44,6 +44,11 @@ from ..ops.aggregate import MAX_DIRECT_GROUPS  # dense-domain cutoff (64)
 DEFAULT_SORT_GROUPS = 1 << 16    # sort-agg output capacity default
 
 
+def _scale_of(dtype) -> int:
+    return dtype.scale if dtype is not None and \
+        dtype.kind is TypeKind.DECIMAL else 0
+
+
 def _remap_lut(lpool: tuple, rpool: tuple) -> tuple:
     """Per-code LUT translating rpool codes into lpool codes; -1 = the
     string is absent from lpool (matches no valid code)."""
@@ -400,11 +405,43 @@ class Planner:
                 acc = self.apply_local_filters(acc, conjuncts)
                 continue
             chosen = min(connected, key=lambda r:
-                         self.estimate_rows(r.node))
+                         self.join_output_estimate(acc, r, conjuncts))
             pending.remove(chosen)
             acc = self.join_pair(acc, chosen, conjuncts, kind="inner")
             acc = self.apply_local_filters(acc, conjuncts)
         return acc
+
+    def join_output_estimate(self, acc: PlannedRelation,
+                             r: PlannedRelation, conjuncts) -> float:
+        """Estimated |acc join r| — the greedy reorder cost (the
+        ReorderJoins objective reduced to output cardinality). With no
+        key stats it degrades to the build-side row count (the round-1
+        smallest-build heuristic)."""
+        rows_r = self.estimate_rows(r.node)
+        denom = None
+        astats = self.chain_column_stats(acc.node)
+        rstats = self.chain_column_stats(r.node)
+        for c in conjuncts:
+            eq = as_equi(c)
+            if eq is None:
+                continue
+            a, b = eq
+            for x, y in ((a, b), (b, a)):
+                ca = acc.scope.try_resolve(x)
+                cr = r.scope.try_resolve(y)
+                if ca is None or cr is None:
+                    continue
+                ndvs = [max(1.0, s.ndv) for s in (
+                    astats.get(ca.index) if astats else None,
+                    rstats.get(cr.index) if rstats else None)
+                    if s is not None]
+                if ndvs:
+                    m = max(ndvs)
+                    denom = m if denom is None else max(denom, m)
+        if denom is None:
+            return rows_r
+        rows_a = self.estimate_rows(acc.node)
+        return max(1.0, rows_a * rows_r / denom)
 
     def cross_join_pair(self, left: PlannedRelation,
                         right: PlannedRelation) -> PlannedRelation:
@@ -439,23 +476,15 @@ class Planner:
 
     def estimate_rows(self, node: L.PlanNode) -> float:
         if isinstance(node, L.ScanNode):
-            try:
-                conn = self.catalog.connector(node.catalog)
-                if hasattr(conn, "_cache"):
-                    # generator connectors: report exact counts only for
-                    # already-materialized scales — plan-time stats must
-                    # never trigger SF1000 generation (EXPLAIN included)
-                    data = conn._cache.get(
-                        conn.scale_for_schema(node.schema_name), {}
-                    ).get(node.table)
-                    return float(data.num_rows) if data is not None else 1e6
-                data = conn.get_table(node.schema_name, node.table)
-                return float(data.num_rows)
-            except Exception:
-                return 1e6
+            stats = self.catalog.get_table_stats(
+                node.catalog, node.schema_name, node.table)
+            if stats is not None:
+                return float(stats.row_count)
+            return 1e6
         if isinstance(node, L.FilterNode):
             return self.estimate_rows(node.child) * \
-                self.predicate_selectivity(node.predicate)
+                self.predicate_selectivity(
+                    node.predicate, self.chain_column_stats(node.child))
         if isinstance(node, (L.ProjectNode, L.WindowNode, L.SortNode)):
             return self.estimate_rows(node.child)
         if isinstance(node, L.LimitNode):
@@ -463,11 +492,22 @@ class Planner:
         if isinstance(node, L.AggregateNode):
             if not node.group_keys:
                 return 1.0
-            return max(1.0, self.estimate_rows(node.child) / 10)
+            child_rows = self.estimate_rows(node.child)
+            ndv = self.group_ndv_product(node)
+            if ndv is not None:
+                return max(1.0, min(child_rows, ndv))
+            return max(1.0, child_rows / 10)
         if isinstance(node, L.JoinNode):
             probe = self.estimate_rows(node.left)
             if node.kind in ("semi", "anti"):
                 return probe * 0.5
+            if node.kind == "mark":
+                return probe
+            build = self.estimate_rows(node.right)
+            key_ndv = self.join_key_ndv(node)
+            if key_ndv is not None and key_ndv > 0:
+                # |L join R| ~= |L|*|R| / max(ndv) (JoinStatsRule)
+                return max(1.0, probe * build / key_ndv)
             return probe if node.build_unique else probe * 2
         if isinstance(node, L.ValuesNode):
             return float(node.num_rows)
@@ -476,22 +516,83 @@ class Planner:
                 self.estimate_rows(node.right)
         return 1e6
 
-    def predicate_selectivity(self, pred: ir.Expr) -> float:
-        """Heuristic selectivities; dictionary predicates are near-exact
-        (fraction of pool values passing — the payoff of pool-side string
-        predicate evaluation)."""
+    def chain_column_stats(self, node: L.PlanNode):
+        """Per-output-column ColumnStats for a Filter/Project chain over a
+        scan (None where unknown). The seam where connector statistics
+        enter the cost model (spi/statistics -> FilterStatsCalculator)."""
+        chain = []
+        while isinstance(node, (L.FilterNode, L.ProjectNode)):
+            chain.append(node)
+            node = node.child
+        if not isinstance(node, L.ScanNode):
+            return None
+        stats = self.catalog.get_table_stats(
+            node.catalog, node.schema_name, node.table)
+        if stats is None:
+            return None
+        cur = {}
+        for i, ci in enumerate(node.column_indices):
+            cur[i] = stats.columns.get(node.table_schema.fields[ci].name)
+        for nd in reversed(chain):
+            if isinstance(nd, L.ProjectNode):
+                cur = {i: cur.get(e.index)
+                       if isinstance(e, ir.ColumnRef) else None
+                       for i, e in enumerate(nd.exprs)}
+        return cur
+
+    def join_key_ndv(self, node: L.JoinNode):
+        """max NDV across the equi-key pair (the join-size denominator)."""
+        lstats = self.chain_column_stats(node.left)
+        rstats = self.chain_column_stats(node.right)
+        best = None
+        for lk, rk in zip(node.left_keys, node.right_keys):
+            ln = lstats.get(lk) if lstats else None
+            rn = rstats.get(rk) if rstats else None
+            ndvs = [s.ndv for s in (ln, rn) if s is not None]
+            if ndvs:
+                m = max(ndvs)
+                best = m if best is None else max(best, m)
+        return best
+
+    def group_ndv_product(self, node: L.AggregateNode):
+        cstats = self.chain_column_stats(node.child)
+        if cstats is None:
+            return None
+        prod = 1.0
+        for k in node.group_keys:
+            s = cstats.get(k)
+            if s is None:
+                return None
+            prod *= max(1.0, s.ndv)
+        return prod
+
+    def predicate_selectivity(self, pred: ir.Expr,
+                              colstats=None) -> float:
+        """Selectivities: dictionary predicates are near-exact (fraction
+        of pool values passing); numeric comparisons interpolate against
+        column min/max + NDV when stats are known, else fall back to the
+        fixed heuristics (FilterStatsCalculator's structure)."""
         if isinstance(pred, ir.DictPredicate):
             if len(pred.lut) == 0:
                 return 0.1
             return max(0.01, sum(pred.lut) / len(pred.lut))
         if isinstance(pred, ir.Compare):
+            s = self._stats_compare_selectivity(pred, colstats)
+            if s is not None:
+                return s
             return self.FILTER_SELECTIVITY.get(pred.op, 0.33)
         if isinstance(pred, ir.Between):
-            return 0.25
+            s = self._range_fraction(pred.arg, pred.low, pred.high,
+                                     colstats)
+            return s if s is not None else 0.25
         if isinstance(pred, ir.InList):
+            cs = self._col_stats(pred.arg, colstats)
+            if cs is not None and cs.ndv > 0:
+                return min(1.0, len(pred.values) / cs.ndv)
             return min(0.9, 0.05 * len(pred.values))
         if isinstance(pred, ir.Logical):
-            parts = [self.predicate_selectivity(a) for a in pred.args]
+            parts = [self.predicate_selectivity(a, colstats)
+                     for a in pred.args]
             if pred.op == "and":
                 out = 1.0
                 for p in parts:
@@ -502,8 +603,61 @@ class Planner:
                 out = out + p - out * p
             return out
         if isinstance(pred, ir.Not):
-            return 1.0 - self.predicate_selectivity(pred.arg)
+            return 1.0 - self.predicate_selectivity(pred.arg, colstats)
         return 0.33
+
+    @staticmethod
+    def _col_stats(e: ir.Expr, colstats):
+        if colstats is None or not isinstance(e, ir.ColumnRef):
+            return None
+        return colstats.get(e.index)
+
+    def _stats_compare_selectivity(self, pred: ir.Compare, colstats):
+        col, lit = pred.left, pred.right
+        op = pred.op
+        if isinstance(col, ir.Literal) and isinstance(lit, ir.ColumnRef):
+            col, lit = lit, col
+            op = flip(op)
+        if not isinstance(lit, ir.Literal) or lit.value is None:
+            return None
+        cs = self._col_stats(col, colstats)
+        if cs is None:
+            return None
+        if op == '=':
+            return 1.0 / max(1.0, cs.ndv)
+        if op == '<>':
+            return 1.0 - 1.0 / max(1.0, cs.ndv)
+        if cs.min_val is None or cs.max_val is None or \
+                cs.max_val <= cs.min_val:
+            return None
+        try:
+            v = float(lit.value)
+            # column stats are over the stored (scaled-int) decimal
+            # representation; normalize the literal to the column's scale
+            v *= 10.0 ** (_scale_of(col.dtype) - _scale_of(lit.dtype))
+        except (TypeError, ValueError):
+            return None
+        frac = (v - cs.min_val) / (cs.max_val - cs.min_val)
+        frac = min(1.0, max(0.0, frac))
+        return frac if op in ('<', '<=') else 1.0 - frac
+
+    def _range_fraction(self, arg, low, high, colstats):
+        cs = self._col_stats(arg, colstats)
+        if cs is None or cs.min_val is None or cs.max_val is None or \
+                cs.max_val <= cs.min_val or \
+                not isinstance(low, ir.Literal) or \
+                not isinstance(high, ir.Literal) or \
+                low.value is None or high.value is None:
+            return None
+        try:
+            ref = _scale_of(arg.dtype)
+            lo = float(low.value) * 10.0 ** (ref - _scale_of(low.dtype))
+            hi = float(high.value) * 10.0 ** (ref - _scale_of(high.dtype))
+        except (TypeError, ValueError):
+            return None
+        span = cs.max_val - cs.min_val
+        frac = (min(hi, cs.max_val) - max(lo, cs.min_val)) / span
+        return min(1.0, max(0.0, frac))
 
     def has_equi_edge(self, left: PlannedRelation, right: PlannedRelation,
                       conjuncts: List[A.Node]) -> bool:
@@ -654,9 +808,17 @@ class Planner:
             output = tuple(probe_node.output) + (("$mark", BOOLEAN),)
         else:
             output = tuple(probe_node.output)
+        # DetermineJoinDistributionType.java:51's choice, by estimated
+        # build bytes: small builds replicate over the mesh (all_gather),
+        # large ones hash-repartition both sides (all_to_all)
+        build_bytes = self.estimate_rows(build_node) * \
+            max(1, len(build_node.output)) * 8
+        distribution = "broadcast" if build_bytes < (32 << 20) \
+            else "partitioned"
         return L.JoinNode(kind, probe_node, build_node,
                           tuple(probe_keys), tuple(build_keys), residual,
-                          build_unique, output, null_aware=null_aware)
+                          build_unique, output, null_aware=null_aware,
+                          distribution=distribution)
 
     def plan_left_join(self, left: PlannedRelation, right: PlannedRelation,
                        condition: Optional[A.Node]) -> PlannedRelation:

@@ -1,0 +1,75 @@
+"""Table/column statistics — the cost model's input.
+
+Reference: cost/StatsCalculator.java:22 propagates PlanNodeStatsEstimate
+(row count + per-symbol NDV/min/max/null fraction) bottom-up; connectors
+supply base stats via the statistics SPI (spi/statistics/). Here base
+stats are computed from materialized table data (numpy pass, sampled NDV)
+and cached by the catalog; the planner propagates them through filters
+and joins (FilterStatsCalculator / JoinStatsRule roles).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ColumnStats:
+    ndv: float                    # distinct values (estimate)
+    min_val: Optional[float]      # numeric/date min (None for varchar)
+    max_val: Optional[float]
+    null_frac: float
+
+
+@dataclass(frozen=True)
+class TableStats:
+    row_count: int
+    columns: Dict[str, ColumnStats]
+
+
+_SAMPLE = 1 << 18
+
+
+def _ndv_estimate(col: np.ndarray, n: int) -> float:
+    """Sampled distinct-count with linear scale-up for saturated samples
+    (the bias direction that keeps keys looking key-like)."""
+    if n <= _SAMPLE:
+        return float(len(np.unique(col)))
+    step = n // _SAMPLE
+    sample = col[::step][:_SAMPLE]
+    d = len(np.unique(sample))
+    if d >= 0.8 * len(sample):        # nearly all distinct: key-like
+        return float(n) * d / len(sample)
+    return float(min(n, d * max(1, n // len(sample)) ** 0.5 * 4 + d))
+
+
+def compute_table_stats(data) -> TableStats:
+    """One numpy pass per column over TableData."""
+    n = data.num_rows
+    cols: Dict[str, ColumnStats] = {}
+    for i, f in enumerate(data.schema):
+        arr = np.asarray(data.columns[i])
+        valid = None if data.valids is None else data.valids[i]
+        null_frac = 0.0
+        if valid is not None:
+            valid = np.asarray(valid)
+            null_frac = 1.0 - (valid.sum() / max(1, n))
+            arr_v = arr[valid]
+        else:
+            arr_v = arr
+        if len(arr_v) == 0:
+            cols[f.name] = ColumnStats(0.0, None, None, null_frac)
+            continue
+        from .types import TypeKind
+        if f.dtype.kind is TypeKind.VARCHAR:
+            ndv = float(min(len(f.dictionary or ()),
+                            len(arr_v))) or 1.0
+            cols[f.name] = ColumnStats(ndv, None, None, null_frac)
+            continue
+        ndv = _ndv_estimate(arr_v, len(arr_v))
+        cols[f.name] = ColumnStats(
+            ndv, float(arr_v.min()), float(arr_v.max()), null_frac)
+    return TableStats(n, cols)
