@@ -1,189 +1,391 @@
 """Driver benchmark: prints ONE JSON line.
 
-Workload: TPC-H q1 at SF1 (~6M lineitem rows) — the reference's benchto
-TPC-H methodology (testing/trino-benchto-benchmarks/.../tpch.yaml:1-40:
-prewarm runs then measured runs, concurrency 1) applied to the engine's
-flagship aggregation pipeline on the real TPU chip.
+Round-2 workloads — END-TO-END (SQL text -> host result) per the
+round-1 verdict, BASELINE.md configs 2-4:
 
-Baseline: the same computation, single-node CPU, vectorized numpy — the
-stand-in for the reference's single-node Java operator pipeline
-(BenchmarkHashAndStreamingAggregationOperators.java:75-99 measures the same
-shape). vs_baseline = cpu_time / tpu_time (higher is better; >1 = faster
-than CPU).
+  q6_sf1   : TPC-H q6 at SF1   — scan + filter/project + global agg
+  q3_sf10  : TPC-H q3 at SF10  — 3-way join + group-by, single chip
+  q5_sf100 : TPC-H q5-shaped at SF100 — 6-way join; lineitem (600M rows,
+             ~19GB) exceeds HBM, so it streams through the bounded-memory
+             chunked driver (exec/chunked.py). Only q5's columns are
+             generated (dbgen formulas; full SF100 generation needs >75GB
+             host RAM) — the VERDICT's "q5-shaped SF100 run".
 
-The TPU timing measures the steady-state jitted pipeline on device-resident
-columns (scan cache warm, like the reference benchmarks which read from
-in-memory pages), excluding one-time XLA compilation — consistent with
-JMH average-time methodology.
+Methodology (testing/trino-benchto-benchmarks/.../tpch.yaml: prewarm then
+measured runs, concurrency 1): per config we report cold (first run incl.
+XLA compile + host->device ingest), steady-state median end-to-end wall
+(parse -> plan -> execute -> decode; scan cache device-resident for
+configs 2-3 like the reference benchmarks reading in-memory pages; SF100
+re-streams host->device every run — bigger than HBM is the point), and an
+identical-results check against the CPU baseline. Baselines are
+single-node vectorized numpy implementations of the same queries (the
+stand-in for the single-node Java operator pipeline). NOTE: this
+environment reaches the TPU through a network tunnel measured at
+~0.35 GB/s host->device and ~60ms RTT per result fetch; real v5e host
+links are orders of magnitude faster, so tunnel-crossing numbers are a
+LOWER bound on the hardware.
+
+vs_baseline = cpu_ms / tpu_steady_ms for the headline config (q3_sf10).
 """
 
 import json
+import os
 import statistics
 import time
 
 import numpy as np
 
-PREWARM = 2
-RUNS = 6
-SCALE = 1.0
+PREWARM = 1
+RUNS = 3
+BUDGET_S = float(os.environ.get("TRINO_TPU_BENCH_BUDGET_S", 1500))
+T0 = time.monotonic()
+
+Q6 = """
+SELECT sum(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= DATE '1994-01-01'
+  AND l_shipdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+  AND l_discount BETWEEN 0.05 AND 0.07
+  AND l_quantity < 24
+"""
+
+Q3 = """
+SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS revenue,
+       o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING'
+  AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey
+  AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate, l_orderkey
+LIMIT 10
+"""
+
+Q5 = """
+SELECT n_name, sum(l_extendedprice * (1 - l_discount)) AS revenue
+FROM customer, orders, lineitem, supplier, nation, region
+WHERE c_custkey = o_custkey
+  AND l_orderkey = o_orderkey
+  AND l_suppkey = s_suppkey
+  AND c_nationkey = s_nationkey
+  AND s_nationkey = n_nationkey
+  AND n_regionkey = r_regionkey
+  AND r_name = 'ASIA'
+  AND o_orderdate >= DATE '1994-01-01'
+  AND o_orderdate < DATE '1994-01-01' + INTERVAL '1' YEAR
+GROUP BY n_name
+ORDER BY revenue DESC
+"""
 
 
-def numpy_q1(cols, cutoff):
-    """Single-node CPU baseline: vectorized numpy q1 (filter + group by
-    returnflag x linestatus + 6 aggregates + 3 avgs)."""
-    rf, ls, qty, price, disc, tax, ship = cols
-    m = ship <= cutoff
-    gid = rf[m] * 2 + ls[m]
-    qty_m, price_m, disc_m, tax_m = qty[m], price[m], disc[m], tax[m]
-    disc_price = price_m * (100 - disc_m)
-    charge = disc_price * (100 + tax_m)
-    n_groups = 6
-    out = {}
-    out["sum_qty"] = np.bincount(gid, weights=qty_m, minlength=n_groups)
-    out["sum_base"] = np.bincount(gid, weights=price_m, minlength=n_groups)
-    out["sum_disc_price"] = np.bincount(gid, weights=disc_price,
-                                        minlength=n_groups)
-    out["sum_charge"] = np.bincount(gid, weights=charge, minlength=n_groups)
-    out["sum_disc"] = np.bincount(gid, weights=disc_m, minlength=n_groups)
-    out["count"] = np.bincount(gid, minlength=n_groups)
-    c = np.maximum(out["count"], 1)
-    out["avg_qty"] = out["sum_qty"] / c
-    out["avg_price"] = out["sum_base"] / c
-    out["avg_disc"] = out["sum_disc"] / c
-    return out
+# ---------------------------------------------------------------------------
+# CPU baselines: single-node vectorized numpy over the same host arrays
+# ---------------------------------------------------------------------------
+
+def col(table, name):
+    return np.asarray(table.columns[table.schema.index_of(name)])
+
+
+def _days(s):
+    return (np.datetime64(s) - np.datetime64("1970-01-01")).astype(int)
+
+
+def numpy_q6(tables):
+    li = tables["lineitem"]
+    ship = col(li, "l_shipdate")
+    disc = col(li, "l_discount")
+    qty = col(li, "l_quantity")
+    price = col(li, "l_extendedprice")
+    m = (ship >= _days("1994-01-01")) & (ship < _days("1995-01-01")) & \
+        (disc >= 5) & (disc <= 7) & (qty < 2400)
+    return int((price[m] * disc[m]).sum())
+
+
+def numpy_q3(tables):
+    cust, orders, li = tables["customer"], tables["orders"], \
+        tables["lineitem"]
+    seg_pool = cust.schema.field("c_mktsegment").dictionary
+    seg_code = seg_pool.index("BUILDING")
+    ck = col(cust, "c_custkey")[col(cust, "c_mktsegment") == seg_code]
+    cutoff = _days("1995-03-15")
+    od = col(orders, "o_orderdate")
+    om = od < cutoff
+    okey, ocust = col(orders, "o_orderkey")[om], \
+        col(orders, "o_custkey")[om]
+    od_f, oprio = od[om], col(orders, "o_shippriority")[om]
+    ck_sorted = np.sort(ck)
+    pos = np.clip(np.searchsorted(ck_sorted, ocust), 0,
+                  len(ck_sorted) - 1)
+    keep = ck_sorted[pos] == ocust
+    okey, od_f, oprio = okey[keep], od_f[keep], oprio[keep]
+    order_o = np.argsort(okey, kind="stable")
+    okey_s, od_s = okey[order_o], od_f[order_o]
+    lk = col(li, "l_orderkey")
+    lm = col(li, "l_shipdate") > cutoff
+    lk, price, disc = lk[lm], col(li, "l_extendedprice")[lm], \
+        col(li, "l_discount")[lm]
+    pos = np.clip(np.searchsorted(okey_s, lk), 0, len(okey_s) - 1)
+    keep = okey_s[pos] == lk
+    lk = lk[keep]
+    rev = price[keep] * (100 - disc[keep])     # scaled 1e4
+    uniq, inv = np.unique(lk, return_inverse=True)
+    sums = np.bincount(inv, weights=rev.astype(np.float64))
+    upos = np.clip(np.searchsorted(okey_s, uniq), 0, len(okey_s) - 1)
+    order = np.lexsort((uniq, od_s[upos], -sums))
+    top = order[:10]
+    return [(int(uniq[i]), float(sums[i]) / 1e4) for i in top]
+
+
+def numpy_q5(tables, chunk=1 << 26):
+    nat, reg = tables["nation"], tables["region"]
+    sup, cust = tables["supplier"], tables["customer"]
+    orders, li = tables["orders"], tables["lineitem"]
+    r_pool = reg.schema.field("r_name").dictionary
+    asia = r_pool.index("ASIA")
+    asia_regionkeys = col(reg, "r_regionkey")[col(reg, "r_name") == asia]
+    asia_nations = col(nat, "n_nationkey")[
+        np.isin(col(nat, "n_regionkey"), asia_regionkeys)]
+    od = col(orders, "o_orderdate")
+    om = (od >= _days("1994-01-01")) & (od < _days("1995-01-01"))
+    okey, ocust = col(orders, "o_orderkey")[om], \
+        col(orders, "o_custkey")[om]
+    c_nation = col(cust, "c_nationkey")      # custkey dense 1..N
+    o_nation = c_nation[ocust - 1]
+    ok = np.isin(o_nation, asia_nations)
+    okey, o_nation = okey[ok], o_nation[ok]
+    order_o = np.argsort(okey, kind="stable")
+    okey_s, onat_s = okey[order_o], o_nation[order_o]
+    s_nation = col(sup, "s_nationkey")
+    acc = np.zeros(25, dtype=np.float64)
+    n = li.num_rows
+    lk_all, ls_all = col(li, "l_orderkey"), col(li, "l_suppkey")
+    price_all, disc_all = col(li, "l_extendedprice"), \
+        col(li, "l_discount")
+    for start in range(0, n, chunk):
+        lk = lk_all[start:start + chunk]
+        ls = ls_all[start:start + chunk]
+        price = price_all[start:start + chunk]
+        disc = disc_all[start:start + chunk]
+        pos = np.clip(np.searchsorted(okey_s, lk), 0, len(okey_s) - 1)
+        keep = okey_s[pos] == lk
+        snat = s_nation[ls[keep] - 1]
+        match = snat == onat_s[pos[keep]]
+        rev = (price[keep][match] * (100 - disc[keep][match])
+               ).astype(np.float64)
+        acc += np.bincount(snat[match], weights=rev, minlength=25)
+    n_pool = nat.schema.field("n_name").dictionary
+    name_of = {int(k): n_pool[int(c)]
+               for k, c in zip(col(nat, "n_nationkey"),
+                               col(nat, "n_name"))}
+    return [(name_of[i], acc[i] / 1e4)
+            for i in np.argsort(-acc) if acc[i] > 0]
+
+
+# ---------------------------------------------------------------------------
+# q5-shaped SF100 generation (pruned columns, dbgen formulas)
+# ---------------------------------------------------------------------------
+
+def q5_tables(scale: float, seed: int = 19920101):
+    """The q5 columns only, same shapes/distributions as datagen.py."""
+    from trino_tpu.batch import Field, Schema
+    from trino_tpu.connectors.tpch.datagen import (ENDDATE, NATIONS,
+                                                   REGIONS, STARTDATE,
+                                                   TableData, _codes_for,
+                                                   retail_price_cents)
+    from trino_tpu.types import BIGINT, DATE, VARCHAR, decimal
+    rng = np.random.default_rng(seed)
+    t = {}
+    t["region"] = TableData(
+        "region", Schema.of(Field("r_regionkey", BIGINT),
+                            Field("r_name", VARCHAR,
+                                  dictionary=tuple(sorted(REGIONS)))),
+        [np.arange(5, dtype=np.int64),
+         _codes_for(REGIONS, sorted(REGIONS))],
+        primary_key=("r_regionkey",))
+    n_names = [n for n, _ in NATIONS]
+    t["nation"] = TableData(
+        "nation", Schema.of(Field("n_nationkey", BIGINT),
+                            Field("n_name", VARCHAR,
+                                  dictionary=tuple(sorted(n_names))),
+                            Field("n_regionkey", BIGINT)),
+        [np.arange(25, dtype=np.int64),
+         _codes_for(n_names, sorted(n_names)),
+         np.array([r for _, r in NATIONS], dtype=np.int64)],
+        primary_key=("n_nationkey",))
+    n_supp = int(scale * 10_000)
+    t["supplier"] = TableData(
+        "supplier", Schema.of(Field("s_suppkey", BIGINT),
+                              Field("s_nationkey", BIGINT)),
+        [np.arange(1, n_supp + 1, dtype=np.int64),
+         rng.integers(0, 25, n_supp).astype(np.int64)],
+        primary_key=("s_suppkey",))
+    n_cust = int(scale * 150_000)
+    t["customer"] = TableData(
+        "customer", Schema.of(Field("c_custkey", BIGINT),
+                              Field("c_nationkey", BIGINT)),
+        [np.arange(1, n_cust + 1, dtype=np.int64),
+         rng.integers(0, 25, n_cust).astype(np.int64)],
+        primary_key=("c_custkey",))
+    n_ord = int(scale * 1_500_000)
+    idx = np.arange(n_ord, dtype=np.int64)
+    orderkey = (idx // 8) * 32 + (idx % 8) + 1
+    m_active = max(1, n_cust - n_cust // 3)
+    j = rng.integers(1, m_active + 1, n_ord).astype(np.int64)
+    o_custkey = np.clip(j + (j - 1) // 2, 1, n_cust)
+    o_orderdate = rng.integers(STARTDATE, ENDDATE - 151 + 1,
+                               n_ord).astype(np.int32)
+    t["orders"] = TableData(
+        "orders", Schema.of(Field("o_orderkey", BIGINT),
+                            Field("o_custkey", BIGINT),
+                            Field("o_orderdate", DATE)),
+        [orderkey, o_custkey, o_orderdate],
+        primary_key=("o_orderkey",))
+    lines_per_order = rng.integers(1, 8, n_ord)
+    l_orderkey = np.repeat(orderkey, lines_per_order)
+    n_li = len(l_orderkey)
+    l_partkey = rng.integers(1, int(scale * 200_000) + 1,
+                             n_li).astype(np.int64)
+    li_i = rng.integers(0, 4, n_li).astype(np.int64)
+    l_suppkey = ((l_partkey + li_i * (n_supp // 4 + (l_partkey - 1)
+                                      // n_supp)) % n_supp) + 1
+    l_quantity = rng.integers(1, 51, n_li).astype(np.int64)
+    l_extendedprice = l_quantity * retail_price_cents(l_partkey)
+    del l_partkey, li_i, l_quantity
+    l_discount = rng.integers(0, 11, n_li).astype(np.int64)
+    d122 = decimal(12, 2)
+    t["lineitem"] = TableData(
+        "lineitem", Schema.of(Field("l_orderkey", BIGINT),
+                              Field("l_suppkey", BIGINT),
+                              Field("l_extendedprice", d122),
+                              Field("l_discount", d122)),
+        [l_orderkey, l_suppkey, l_extendedprice, l_discount])
+    return t
+
+
+class BenchConnector:
+    """Prebuilt q5-shaped tables under one schema."""
+    name = "bench"
+
+    def __init__(self, tables, schema):
+        self._tables = tables
+        self._schema = schema
+        self._cache = {schema: tables}         # stats-probe shape
+
+    def scale_for_schema(self, schema):
+        return schema
+
+    def schema_names(self):
+        return [self._schema]
+
+    def table_names(self, schema):
+        return sorted(self._tables)
+
+    def get_table(self, schema, table):
+        return self._tables[table]
+
+
+# ---------------------------------------------------------------------------
+
+def run_config(session, sql, runs=RUNS, prewarm=PREWARM):
+    """End-to-end timings: cold (first exec: compiles + ingest), then
+    steady-state median."""
+    t0 = time.monotonic()
+    result = session.execute(sql)
+    cold_ms = (time.monotonic() - t0) * 1000
+    for _ in range(max(0, prewarm - 1)):
+        session.execute(sql)
+    times = []
+    for _ in range(runs):
+        t0 = time.monotonic()
+        result = session.execute(sql)
+        times.append((time.monotonic() - t0) * 1000)
+    return result, cold_ms, statistics.median(times)
+
+
+def budget_left(frac):
+    return (time.monotonic() - T0) < BUDGET_S * frac
 
 
 def main():
     import jax
+    from trino_tpu.exec.session import Session
+    detail = {"device": str(jax.devices()[0]),
+              "prewarm": PREWARM, "runs": RUNS}
 
-    from trino_tpu import ir
-    from trino_tpu.batch import batch_from_numpy
-    from trino_tpu.connectors.tpch.connector import TpchConnector
-    from trino_tpu.ops.aggregate import AggSpec, direct_group_aggregate
-    from trino_tpu.ops.project import apply_filter, project
-    from trino_tpu.types import BIGINT, DATE, VARCHAR, decimal
+    # ---- config 2: q6 SF1 end-to-end --------------------------------
+    session = Session(default_schema="sf1")
+    tables = {"lineitem": session.catalog.get_table("tpch", "sf1",
+                                                    "lineitem")}
+    t0 = time.monotonic()
+    cpu_q6 = numpy_q6(tables)
+    cpu_q6_ms = (time.monotonic() - t0) * 1000
+    res, cold, steady = run_config(session, Q6)
+    got = float(res.rows[0][0])
+    assert abs(got - cpu_q6 / 1e4) < 1e-2, (got, cpu_q6 / 1e4)
+    detail["q6_sf1"] = {
+        "tpu_cold_ms": round(cold, 1), "tpu_steady_ms": round(steady, 1),
+        "cpu_ms": round(cpu_q6_ms, 1),
+        "speedup": round(cpu_q6_ms / steady, 2), "verified": True}
 
-    conn = TpchConnector()
-    li = conn.get_table(f"sf{SCALE:g}" if SCALE != 1 else "sf1", "lineitem")
-    s = li.schema
-    names = ["l_returnflag", "l_linestatus", "l_quantity",
-             "l_extendedprice", "l_discount", "l_tax", "l_shipdate"]
-    host_cols = [li.columns[s.index_of(n)] for n in names]
-    cutoff = 10561  # DATE '1998-12-01' - 90 days
+    # ---- config 3: q3 SF10 end-to-end -------------------------------
+    if budget_left(0.5):
+        session10 = Session(default_schema="sf10")
+        tables10 = {t: session10.catalog.get_table("tpch", "sf10", t)
+                    for t in ["customer", "orders", "lineitem"]}
+        t0 = time.monotonic()
+        cpu_q3 = numpy_q3(tables10)
+        cpu_q3_ms = (time.monotonic() - t0) * 1000
+        res, cold, steady = run_config(session10, Q3)
+        got = [(int(r[0]), round(float(r[1]), 2)) for r in res.rows]
+        want = [(k, round(v, 2)) for k, v in cpu_q3]
+        assert got == want, (got[:3], want[:3])
+        detail["q3_sf10"] = {
+            "tpu_cold_ms": round(cold, 1),
+            "tpu_steady_ms": round(steady, 1),
+            "cpu_ms": round(cpu_q3_ms, 1),
+            "speedup": round(cpu_q3_ms / steady, 2), "verified": True}
+        del session10, tables10
 
-    # ---- CPU baseline -----------------------------------------------------
-    cpu_times = []
-    for i in range(PREWARM + RUNS):
-        t0 = time.perf_counter()
-        ref = numpy_q1(host_cols, cutoff)
-        dt = time.perf_counter() - t0
-        if i >= PREWARM:
-            cpu_times.append(dt)
-    cpu_t = statistics.median(cpu_times)
+    # ---- config 4: q5-shaped SF100, chunked (bigger than HBM) -------
+    if budget_left(0.6) and \
+            os.environ.get("TRINO_TPU_BENCH_SKIP_SF100") != "1":
+        scale = float(os.environ.get("TRINO_TPU_BENCH_SF100_SCALE", 100))
+        t0 = time.monotonic()
+        tables100 = q5_tables(scale)
+        gen_s = time.monotonic() - t0
+        from trino_tpu.catalog import Catalog
+        cat = Catalog()
+        cat.register("bench", BenchConnector(tables100, "q5"))
+        s100 = Session(catalog=cat, default_cat="bench",
+                       default_schema="q5")
+        s100.properties["spill_chunk_rows"] = 50_000_000
+        s100.executor.spill_chunk_rows = 50_000_000
+        t0 = time.monotonic()
+        cpu_q5 = numpy_q5(tables100)
+        cpu_q5_ms = (time.monotonic() - t0) * 1000
+        res, cold, steady = run_config(s100, Q5, runs=1, prewarm=1)
+        got = [(r[0], round(float(r[1]), 2)) for r in res.rows]
+        want = [(n, round(v, 2)) for n, v in cpu_q5]
+        assert got == want, (got[:3], want[:3])
+        detail["q5_sf100"] = {
+            "tpu_cold_ms": round(cold, 1),
+            "tpu_steady_ms": round(steady, 1),
+            "cpu_ms": round(cpu_q5_ms, 1),
+            "speedup": round(cpu_q5_ms / steady, 2),
+            "gen_s": round(gen_s, 1), "scale": scale,
+            "rows_lineitem": tables100["lineitem"].num_rows,
+            "chunked": True, "verified": True,
+            "note": "ingest-bound: tunnel host->device ~0.35GB/s"}
 
-    # ---- TPU pipeline -----------------------------------------------------
-    batch = batch_from_numpy(host_cols, pad_multiple=8192)
-    d122 = decimal(12, 2)
-    rf = ir.ColumnRef(0, VARCHAR, "l_returnflag")
-    ls = ir.ColumnRef(1, VARCHAR, "l_linestatus")
-    qty = ir.ColumnRef(2, d122, "l_quantity")
-    price = ir.ColumnRef(3, d122, "l_extendedprice")
-    disc = ir.ColumnRef(4, d122, "l_discount")
-    tax = ir.ColumnRef(5, d122, "l_tax")
-    ship = ir.ColumnRef(6, DATE, "l_shipdate")
-    one = ir.Literal(100, d122)
-    flt = ir.Compare("<=", ship, ir.Literal(cutoff, DATE))
-    disc_price = ir.arith("*", price, ir.arith("-", one, disc))
-    charge = ir.arith("*", disc_price, ir.arith("+", one, tax))
-    pre = (rf, ls, qty, price, disc_price, charge, disc)
-    aggs = (AggSpec("sum", 2), AggSpec("sum", 3), AggSpec("sum", 4),
-            AggSpec("sum", 5), AggSpec("sum", 6),
-            AggSpec("count_star", None))
-
-    # XLA masked-reduction path: measured faster than the Pallas MXU
-    # kernel at this shape (see ops/pallas_agg.py docstring) because the
-    # whole filter+project+aggregate stage fuses into one HBM pass
-    @jax.jit
-    def q1_step(b):
-        filtered = apply_filter(b, flt)
-        projected = project(filtered, pre)
-        return direct_group_aggregate(projected, (0, 1), (3, 2), aggs)
-
-    # Through the axon tunnel block_until_ready returns before remote
-    # execution finishes and any host fetch pays ~60ms network RTT, so we
-    # time N pipeline iterations inside ONE jitted fori_loop (per-iteration
-    # data perturbation defeats CSE/hoisting), fetch a single scalar, and
-    # difference two loop lengths so RTT + dispatch cancel exactly.
-    from jax import lax
-
-    from trino_tpu.batch import Batch, Column
-
-    import jax.numpy as jnp
-
-    @jax.jit
-    def q1_iterated(b, n_iter):
-        def body(i, acc):
-            # perturb the shipdate column: the filter feeds every
-            # aggregate, so no part of the pipeline is loop-invariant and
-            # XLA cannot hoist work out of the timing loop
-            cols = list(b.columns)
-            ship_c = cols[6]
-            cols[6] = Column(
-                data=ship_c.data + (i % 2).astype(ship_c.data.dtype),
-                valid=ship_c.valid)
-            bb = Batch(columns=tuple(cols), live=b.live)
-            out = q1_step(bb)
-            # consume EVERY aggregate output — anything unconsumed is
-            # dead-code-eliminated together with its inputs, silently
-            # shrinking the measured pipeline
-            total = acc
-            for c in out.columns[2:]:
-                total = total + c.data.sum()
-            return total
-        return lax.fori_loop(0, n_iter, body,
-                             jnp.asarray(0, dtype=jnp.int64))
-
-    # dynamic trip count: one compile, two loop lengths; the long loop is
-    # sized so per-iteration time dominates RTT noise (~ms) by >100x
-    N_SHORT, N_LONG = 8, 264
-    np.asarray(q1_iterated(batch, N_SHORT))   # warm compile
-
-    def timed(n):
-        ts = []
-        for _ in range(RUNS):
-            t0 = time.perf_counter()
-            np.asarray(q1_iterated(batch, n))  # forces remote round trip
-            ts.append(time.perf_counter() - t0)
-        return statistics.median(ts)
-
-    t_short = timed(N_SHORT)
-    t_long = timed(N_LONG)
-    tpu_t = max((t_long - t_short) / (N_LONG - N_SHORT), 1e-9)
-
-    out = q1_step(batch)
-
-    # ---- correctness gate (verifier-style: identical results) -------------
-    got_counts = np.asarray(out.columns[7].data)
-    got_sum_qty = np.asarray(out.columns[2].data)
-    # engine group id = rf*2+ls, same mixed radix as baseline
-    assert int(got_counts.sum()) == int(ref["count"].sum()), "count mismatch"
-    np.testing.assert_allclose(
-        np.sort(got_sum_qty[got_counts > 0]),
-        np.sort(ref["sum_qty"][ref["count"] > 0]), rtol=0, atol=0)
-
-    n_rows = li.num_rows
+    headline = detail.get("q3_sf10", detail["q6_sf1"])
     print(json.dumps({
-        "metric": "tpch_sf1_q1_agg_pipeline_wall_ms",
-        "value": round(tpu_t * 1000, 3),
+        "metric": "tpch_e2e_sql_to_result_wall_ms",
+        "value": headline["tpu_steady_ms"],
         "unit": "ms",
-        "vs_baseline": round(cpu_t / tpu_t, 3),
-        "detail": {
-            "rows": n_rows,
-            "tpu_rows_per_sec": round(n_rows / tpu_t),
-            "cpu_baseline_ms": round(cpu_t * 1000, 3),
-            "prewarm": PREWARM, "runs": RUNS,
-            "device": str(jax.devices()[0]),
-        },
+        "vs_baseline": headline["speedup"],
+        "detail": detail,
     }))
 
 

@@ -159,11 +159,15 @@ def batch_from_numpy(arrays: Sequence[np.ndarray],
 
 
 def batch_to_numpy(batch: Batch) -> tuple:
-    """Compact live rows back to host numpy. Returns (arrays, valids)."""
-    live = np.asarray(batch.live)
+    """Compact live rows back to host numpy. Returns (arrays, valids).
+
+    One device_get for the whole pytree: per-column np.asarray would pay
+    a network round trip each over a tunneled accelerator (~60ms/RTT)."""
+    host = jax.device_get(batch)
+    live = np.asarray(host.live)
     idx = np.nonzero(live)[0]
     arrays, valids = [], []
-    for col in batch.columns:
+    for col in host.columns:
         arrays.append(np.asarray(col.data)[idx])
         valids.append(np.asarray(col.valid)[idx])
     return arrays, valids

@@ -30,7 +30,8 @@ from ..catalog import Catalog
 from ..ops.aggregate import (AggSpec, direct_group_aggregate,
                              global_aggregate, sort_group_aggregate)
 from ..batch import pad_capacity
-from ..ops.join import join_expand, join_mark, join_unique_build
+from ..ops.join import (join_expand, join_mark, join_unique_build,
+                        join_unique_build_dense)
 from ..ops.project import apply_filter, filter_project, project
 from ..ops.sort import limit_batch, sort_batch
 from ..planner import logical as L
@@ -43,6 +44,7 @@ class ExecStats:
     rows_scanned: int = 0
     join_fallbacks: int = 0
     join_expansion_retries: int = 0
+    join_domain_fallbacks: int = 0   # dense-LUT stats were stale
     agg_capacity_retries: int = 0
     dynamic_filter_compactions: int = 0
     agg_spill_chunks: int = 0
@@ -415,22 +417,46 @@ class Executor:
             return self.run_mark_join(node, probe, build)
         if node.kind in ("semi", "anti"):
             return self.run_membership_join(node, probe, build)
+        domain = node.build_key_domain
         if node.build_unique:
-            out, dup = join_unique_build(probe, build, node.left_keys,
-                                         node.right_keys, node.kind)
-            if int(dup) == 0:
+            out = self.try_unique_join(node, probe, build, domain)
+            if out is not None:
                 return out
             # planner's uniqueness proof was wrong — degrade gracefully
             self.stats.join_fallbacks += 1
         cap = probe.capacity
         while True:
-            out, total = join_expand(probe, build, node.left_keys,
-                                     node.right_keys, node.kind, cap)
-            total = int(total)
+            out, total, oob = join_expand(probe, build, node.left_keys,
+                                          node.right_keys, node.kind,
+                                          cap, domain)
+            total, oob = (int(v) for v in np.asarray(
+                jnp.stack([total, jnp.asarray(oob, total.dtype)])))
+            if oob > 0:             # stale stats: keys escaped the domain
+                domain = None
+                self.stats.join_domain_fallbacks += 1
+                continue
             if total <= cap:
                 return out
             cap = pad_capacity(total)     # exact requirement, one retry
             self.stats.join_expansion_retries += 1
+
+    def try_unique_join(self, node: L.JoinNode, probe: Batch,
+                        build: Batch, domain) -> Optional[Batch]:
+        """Unique-build fast paths: dense LUT when stats bound the key
+        domain, sorted+searchsorted otherwise. None = build had duplicate
+        keys (caller expands)."""
+        if domain is not None:
+            out, dup, oob = join_unique_build_dense(
+                probe, build, node.left_keys, node.right_keys,
+                node.kind, domain)
+            dup, oob = (int(v) for v in np.asarray(
+                jnp.stack([dup, oob])))
+            if oob == 0:
+                return out if dup == 0 else None
+            self.stats.join_domain_fallbacks += 1
+        out, dup = join_unique_build(probe, build, node.left_keys,
+                                     node.right_keys, node.kind)
+        return out if int(dup) == 0 else None
 
     def apply_dynamic_filter(self, node: L.JoinNode, probe: Batch,
                              build: Batch) -> Batch:
@@ -472,17 +498,34 @@ class Executor:
         in the reference): every probe row survives; the mark powers
         disjunctive EXISTS filters downstream. Build duplicates are
         irrelevant (membership semantics)."""
+        domain = node.build_key_domain
         if node.residual is None:
-            out, _dup = join_unique_build(probe, build, node.left_keys,
-                                          node.right_keys, "semi")
+            out = None
+            if domain is not None:
+                dout, _dup, oob = join_unique_build_dense(
+                    probe, build, node.left_keys, node.right_keys,
+                    "semi", domain)
+                if int(oob) == 0:
+                    out = dout
+                else:
+                    self.stats.join_domain_fallbacks += 1
+            if out is None:
+                out, _dup = join_unique_build(
+                    probe, build, node.left_keys, node.right_keys, "semi")
             mark = out.live          # live & matched
         else:
             residual = self.fold_scalars(node.residual)
             cap = probe.capacity
             while True:
-                mark, total = join_mark(probe, build, node.left_keys,
-                                        node.right_keys, residual, cap)
-                total = int(total)
+                mark, total, oob = join_mark(
+                    probe, build, node.left_keys, node.right_keys,
+                    residual, cap, domain)
+                total, oob = (int(v) for v in np.asarray(
+                    jnp.stack([total, jnp.asarray(oob, total.dtype)])))
+                if oob > 0:
+                    domain = None
+                    self.stats.join_domain_fallbacks += 1
+                    continue
                 if total <= cap:
                     break
                 cap = pad_capacity(total)
@@ -500,16 +543,30 @@ class Executor:
             bk = build.columns[node.right_keys[0]]
             if bool(jnp.any(build.live & ~bk.valid)):
                 return probe.with_live(jnp.zeros_like(probe.live))
+        domain = node.build_key_domain
         if node.residual is None:
+            if domain is not None:
+                out, _dup, oob = join_unique_build_dense(
+                    probe, build, node.left_keys, node.right_keys,
+                    node.kind, domain)
+                if int(oob) == 0:
+                    return out
+                self.stats.join_domain_fallbacks += 1
             out, _dup = join_unique_build(probe, build, node.left_keys,
                                           node.right_keys, node.kind)
             return out
         residual = self.fold_scalars(node.residual)
         cap = probe.capacity
         while True:
-            mark, total = join_mark(probe, build, node.left_keys,
-                                    node.right_keys, residual, cap)
-            total = int(total)
+            mark, total, oob = join_mark(probe, build, node.left_keys,
+                                         node.right_keys, residual, cap,
+                                         domain)
+            total, oob = (int(v) for v in np.asarray(
+                jnp.stack([total, jnp.asarray(oob, total.dtype)])))
+            if oob > 0:
+                domain = None
+                self.stats.join_domain_fallbacks += 1
+                continue
             if total <= cap:
                 break
             cap = pad_capacity(total)
@@ -530,7 +587,14 @@ class Executor:
                     "multi-column join key outside packable range")
 
     def result_to_host(self, root: L.OutputNode, batch: Batch):
-        """Compact + return (names, columns, valids) on host."""
+        """Compact + return (names, columns, valids) on host. Selective
+        results compact on device first so the host fetch moves live rows,
+        not padded capacity (a 60M-capacity TopN result is 10 rows)."""
+        if batch.columns:
+            live = int(jnp.sum(batch.live))
+            new_cap = pad_capacity(live)
+            if new_cap * 4 <= batch.capacity:
+                batch = compact_batch(batch, new_cap)
         arrays, valids = batch_to_numpy(batch)
         return list(root.names), arrays, valids
 

@@ -204,10 +204,18 @@ def sort_group_aggregate(batch: Batch, key_indices: tuple, aggs: tuple,
 
     g = jnp.arange(out_capacity)
     group_live = g < num_groups
-    # segment extents per output group, via binary search (gather-only)
-    start_pos = jnp.searchsorted(seg, g, side="left")
-    end_pos = jnp.clip(jnp.searchsorted(seg, g, side="right") - 1, 0, n - 1)
-    start_c = jnp.clip(start_pos, 0, n - 1)
+    # segment extents per output group: scatter each boundary position at
+    # its group id (unique indices), then end[g] = start[g+1] - 1 — one
+    # scatter + one gather instead of two searchsorteds (searchsorted
+    # lowers to ~24 serial gather rounds; pathological at 10M+ rows)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    sidx = jnp.where(boundary & (seg < out_capacity), seg, out_capacity)
+    start_lut = jnp.zeros(out_capacity + 1, dtype=jnp.int32)
+    start_lut = start_lut.at[sidx].max(pos, mode="drop")
+    start_c = jnp.clip(start_lut[:out_capacity], 0, n - 1)
+    next_start = start_lut[jnp.clip(g + 1, 0, out_capacity)]
+    end_pos = jnp.where(g + 1 < num_groups,
+                        jnp.clip(next_start - 1, 0, n - 1), n - 1)
 
     out_cols = []
     for ki in key_indices:

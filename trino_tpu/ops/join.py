@@ -5,19 +5,30 @@ builds a JoinHash; LookupJoinOperator probes it per page
 (operator/join/unspilled/HashBuilderOperator.java:48,
 unspilled/LookupJoinOperator.java:41, PageJoiner.java:138).
 
-TPUs lack efficient pointer-chasing, so the build structure is a *sorted key
-array* and the probe is a vectorized binary search (`searchsorted`, which
-XLA lowers to a fully parallel per-lane search) — exact, static-shape, no
-hash collisions (SURVEY.md §7 "GroupBy/Join on TPU").
+Two build structures, chosen like BigintGroupByHash vs FlatGroupByHash
+(GroupByHash.java:82-93), measured on v5e via the tunnel at 60M probe /
+15M build rows:
 
-Unique-build joins (key is a primary key: every TPC-H dimension join) have
-fan-out <= 1, so output capacity == probe capacity and everything stays on
-device. Duplicate-build joins run the two-pass device expansion
-(join_expand) under a static output bound with grow-and-retry on overflow
-(the "conservative upper bounds" mitigation from SURVEY.md §7 hard part 1).
+- **dense-domain LUT** (single integer key, bounded domain known from
+  connector stats — every TPC-H/DS surrogate key): build rows scatter into
+  a dense `domain`-sized table (unique-index scatter, 0.2s) and each probe
+  is ONE gather (0.9s). This is the BigintGroupByHash analog and the fast
+  path for fact-dimension joins.
+- **sorted-array + binary search** (general fallback): `lax.sort` of the
+  build (0.2s at 15M — TPU sorts are fast) and `searchsorted` probes.
+  searchsorted lowers to ~24 sequential gather rounds (30s at 60M probes)
+  — usable for small/medium probes, pathological at scale, hence the LUT.
+
+Output-row mapping in the expansion kernels uses scatter + cummax
+(associative scan) instead of a second searchsorted for the same reason.
+
+Duplicate-build joins run the two-pass device expansion (join_expand)
+under a static output bound with grow-and-retry on overflow (the
+"conservative upper bounds" mitigation from SURVEY.md §7 hard part 1).
 
 Multi-column equi-keys are packed into one int64 by the planner (key
-columns are bounded by table cardinalities, known from connector stats).
+columns are bounded by table cardinalities, known from connector stats);
+packed keys use the sorted fallback.
 """
 
 from __future__ import annotations
@@ -46,6 +57,66 @@ def _combined_key(batch: Batch, key_indices: tuple) -> Tuple[jax.Array,
         key = key * (1 << 32) + c.data.astype(jnp.int64)
         valid = valid & c.valid
     return key, valid
+
+
+def _cummax(x: jax.Array) -> jax.Array:
+    return jax.lax.associative_scan(jnp.maximum, x)
+
+
+def _dense_row_lut(key: jax.Array, ok: jax.Array, domain: int):
+    """Scatter build-row indices into a dense key->row table.
+
+    Returns (lut[domain+1] int32, dup_count). Slot `domain` is the
+    dead/invalid sink. -1 = no build row for that key. Duplicates are
+    detected by reading back: an overwritten row's slot holds a different
+    row index."""
+    n = key.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)
+    idx = jnp.where(ok, jnp.clip(key, 0, domain - 1), domain)
+    lut = jnp.full(domain + 1, -1, dtype=jnp.int32)
+    lut = lut.at[idx].max(rows, mode="drop")
+    readback = lut[idx]
+    dup = jnp.sum(ok & (readback != rows))
+    return lut, dup
+
+
+def _out_of_domain(key: jax.Array, ok: jax.Array, domain: int):
+    return jnp.any(ok & ((key < 0) | (key >= domain)))
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def join_unique_build_dense(probe: Batch, build: Batch, probe_keys: tuple,
+                            build_keys: tuple, kind: str, domain: int):
+    """Unique-build equi-join via dense LUT: one scatter to build, one
+    gather per probe (the BigintGroupByHash-style fast path).
+
+    Returns (out_batch, dup_count, oob_count); oob_count > 0 means a
+    build key fell outside [0, domain) — the caller's stats were stale
+    and it must re-run on the sorted fallback."""
+    pk, pk_valid = _combined_key(probe, probe_keys)
+    bk, bk_valid = _combined_key(build, build_keys)
+    b_ok = build.live & bk_valid
+    oob = jnp.sum(b_ok & ((bk < 0) | (bk >= domain)))
+    lut, dup = _dense_row_lut(bk, b_ok, domain)
+
+    p_idx = jnp.where(pk_valid, jnp.clip(pk, 0, domain - 1), domain)
+    src = lut[p_idx]
+    matched = (src >= 0) & pk_valid & probe.live & \
+        (pk >= 0) & (pk < domain)
+    src_c = jnp.clip(src, 0, build.capacity - 1)
+
+    if kind == "semi":
+        return probe.with_live(probe.live & matched), dup, oob
+    if kind == "anti":
+        return probe.with_live(probe.live & ~matched), dup, oob
+
+    build_cols = []
+    for col in build.columns:
+        build_cols.append(Column(data=col.data[src_c],
+                                 valid=col.valid[src_c] & matched))
+    live = probe.live & matched if kind == "inner" else probe.live
+    return (Batch(columns=probe.columns + tuple(build_cols), live=live),
+            dup, oob)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4))
@@ -96,51 +167,106 @@ def join_unique_build(probe: Batch, build: Batch, probe_keys: tuple,
     return Batch(columns=probe.columns + tuple(build_cols), live=live), dup
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
-def join_expand(probe: Batch, build: Batch, probe_keys: tuple,
-                build_keys: tuple, kind: str, out_capacity: int):
-    """Equi-join with arbitrary build-side multiplicity (1:N fan-out),
-    fully on device and scatter-free.
+def _expand_map(out_counts: jax.Array, out_capacity: int):
+    """Output row j -> (probe_row, within-run offset) without binary
+    search: scatter each probe row's index at its output start, then a
+    cummax scan floods it across the run (associative scan = log rounds
+    of elementwise max, no gathers)."""
+    n = out_counts.shape[0]
+    cum = jnp.cumsum(out_counts)
+    total = cum[n - 1]
+    starts = cum - out_counts
+    has = out_counts > 0
+    idx = jnp.where(has & (starts < out_capacity), starts, out_capacity)
+    seed = jnp.zeros(out_capacity + 1, dtype=jnp.int32)
+    seed = seed.at[idx].max(jnp.arange(n, dtype=jnp.int32) + 1,
+                            mode="drop")
+    probe_row = _cummax(seed[:out_capacity]) - 1
+    probe_row_c = jnp.clip(probe_row, 0, n - 1)
+    j = jnp.arange(out_capacity, dtype=cum.dtype)
+    out_live = (j < total) & (probe_row >= 0)
+    within = j - starts[probe_row_c]
+    return probe_row_c, within, out_live, total
 
-    Two-pass expansion (the TPU answer to LookupJoinOperator's variable
-    JoinProbe fan-out, operator/join/unspilled/PageJoiner.java:138):
-    1. per-probe-row match counts via sorted build + two searchsorteds;
-    2. output row j maps back to its probe row by binary search on the
-       cumulative count array, and to its build row by offset within the
-       match run — both gathers.
 
-    Returns (out_batch, total_rows); total_rows > out_capacity means the
-    static bound overflowed and the caller must grow and retry (executor
-    does, like the sort-agg capacity retry).
-    kind: 'inner' | 'left'.
-    """
+def _dense_run_luts(sorted_keys: jax.Array, domain: int):
+    """(lo, count) per key from a sorted build — two unique-index
+    scatters; absent keys read back count 0."""
+    n = sorted_keys.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    validk = sorted_keys != _SENTINEL
+    in_dom = validk & (sorted_keys >= 0) & (sorted_keys < domain)
+    boundary = in_dom & ((pos == 0) |
+                         (sorted_keys != jnp.roll(sorted_keys, 1)))
+    run_end = in_dom & ((pos == n - 1) |
+                        (jnp.roll(sorted_keys, -1) != sorted_keys))
+    key_c = jnp.clip(sorted_keys, 0, domain - 1).astype(jnp.int64)
+    lo_lut = jnp.zeros(domain + 1, dtype=jnp.int32)
+    lo_lut = lo_lut.at[jnp.where(boundary, key_c, domain)].max(
+        pos, mode="drop")
+    lo_of_row = lo_lut[key_c]
+    cnt_lut = jnp.zeros(domain + 1, dtype=jnp.int32)
+    cnt_lut = cnt_lut.at[jnp.where(run_end, key_c, domain)].max(
+        pos - lo_of_row + 1, mode="drop")
+    oob = jnp.sum(validk & ~in_dom)
+    return lo_lut, cnt_lut, oob
+
+
+def _probe_runs(probe: Batch, build: Batch, probe_keys: tuple,
+                build_keys: tuple, domain):
+    """Per-probe-row (lo, count) of the matching build run, plus the
+    build sort order. domain None = sorted+searchsorted fallback."""
     pk, pk_valid = _combined_key(probe, probe_keys)
     bk, bk_valid = _combined_key(build, build_keys)
     n_build = build.capacity
-    n_probe = probe.capacity
-
     bk_eff = jnp.where(build.live & bk_valid, bk, _SENTINEL)
     sorted_keys, order = jax.lax.sort(
         (bk_eff, jnp.arange(n_build, dtype=jnp.int32)), num_keys=1)
-
-    lo = jnp.searchsorted(sorted_keys, pk, side="left")
-    hi = jnp.searchsorted(sorted_keys, pk, side="right")
     pk_ok = probe.live & pk_valid & (pk != _SENTINEL)
-    counts = jnp.where(pk_ok, hi - lo, 0)
+    if domain is None:
+        lo = jnp.searchsorted(sorted_keys, pk, side="left")
+        hi = jnp.searchsorted(sorted_keys, pk, side="right")
+        counts = jnp.where(pk_ok, hi - lo, 0)
+        oob = jnp.zeros((), dtype=jnp.int64)
+    else:
+        lo_lut, cnt_lut, oob = _dense_run_luts(sorted_keys, domain)
+        ok = pk_ok & (pk >= 0) & (pk < domain)
+        p_idx = jnp.where(ok, pk, domain)
+        # the sink slot collects non-run-end scatter garbage; only
+        # in-domain live probes may read real counts
+        lo = jnp.where(ok, lo_lut[p_idx], 0).astype(jnp.int64)
+        counts = jnp.where(ok, cnt_lut[p_idx], 0).astype(jnp.int64)
+    return lo, counts, order, pk_ok, oob
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+def join_expand(probe: Batch, build: Batch, probe_keys: tuple,
+                build_keys: tuple, kind: str, out_capacity: int,
+                domain=None):
+    """Equi-join with arbitrary build-side multiplicity (1:N fan-out),
+    fully on device.
+
+    Two-pass expansion (the TPU answer to LookupJoinOperator's variable
+    JoinProbe fan-out, operator/join/unspilled/PageJoiner.java:138):
+    1. per-probe-row match runs (dense LUTs when `domain` is given, else
+       sorted build + searchsorted);
+    2. output row j maps to its probe row by scatter+cummax and to its
+       build row by offset within the run.
+
+    Returns (out_batch, total_rows, oob); total_rows > out_capacity means
+    the static bound overflowed and the caller must grow and retry; oob >
+    0 means build keys fell outside the dense domain and the caller must
+    re-run with domain=None. kind: 'inner' | 'left'.
+    """
+    n_build = build.capacity
+    lo, counts, order, pk_ok, oob = _probe_runs(
+        probe, build, probe_keys, build_keys, domain)
     if kind == "left":
         out_counts = jnp.maximum(counts, probe.live.astype(counts.dtype))
     else:
         out_counts = counts
-    cum = jnp.cumsum(out_counts)
-    total = cum[n_probe - 1]
-
-    j = jnp.arange(out_capacity, dtype=cum.dtype)
-    probe_row = jnp.searchsorted(cum, j, side="right")
-    probe_row_c = jnp.clip(probe_row, 0, n_probe - 1)
-    before = jnp.where(probe_row_c > 0,
-                       cum[jnp.clip(probe_row_c - 1, 0, n_probe - 1)], 0)
-    within = j - before
-    out_live = j < total
+    probe_row_c, within, out_live, total = _expand_map(out_counts,
+                                                       out_capacity)
     matched = out_live & (within < counts[probe_row_c])
     build_row = order[jnp.clip(lo[probe_row_c] + within, 0, n_build - 1)]
 
@@ -151,12 +277,13 @@ def join_expand(probe: Batch, build: Batch, probe_keys: tuple,
     for col in build.columns:
         out_cols.append(Column(data=col.data[build_row],
                                valid=col.valid[build_row] & matched))
-    return Batch(columns=tuple(out_cols), live=out_live), total
+    return Batch(columns=tuple(out_cols), live=out_live), total, oob
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
 def join_mark(probe: Batch, build: Batch, probe_keys: tuple,
-              build_keys: tuple, residual, out_capacity: int):
+              build_keys: tuple, residual, out_capacity: int,
+              domain=None):
     """Mark join: per probe row, does ANY build row match the equi keys AND
     the residual predicate? Powers semi/anti joins with non-equi correlated
     conditions (TPC-H q21's l2.l_suppkey <> l1.l_suppkey), the role of
@@ -165,36 +292,20 @@ def join_mark(probe: Batch, build: Batch, probe_keys: tuple,
 
     Same two-pass expansion as join_expand; the residual is evaluated over
     the expanded pair batch (probe columns ++ build columns), then reduced
-    back per probe row with a cumulative-count window — scatter-free.
+    back per probe row with a cumulative-count window.
 
-    Returns (mark_bool_per_probe_row, total_pairs). total_pairs >
+    Returns (mark_bool_per_probe_row, total_pairs, oob). total_pairs >
     out_capacity means the expansion overflowed; caller grows and retries.
     """
     from .project import filter_mask
 
-    pk, pk_valid = _combined_key(probe, probe_keys)
-    bk, bk_valid = _combined_key(build, build_keys)
     n_build = build.capacity
-    n_probe = probe.capacity
-
-    bk_eff = jnp.where(build.live & bk_valid, bk, _SENTINEL)
-    sorted_keys, order = jax.lax.sort(
-        (bk_eff, jnp.arange(n_build, dtype=jnp.int32)), num_keys=1)
-
-    lo = jnp.searchsorted(sorted_keys, pk, side="left")
-    hi = jnp.searchsorted(sorted_keys, pk, side="right")
-    pk_ok = probe.live & pk_valid & (pk != _SENTINEL)
-    counts = jnp.where(pk_ok, hi - lo, 0)
+    lo, counts, order, pk_ok, oob = _probe_runs(
+        probe, build, probe_keys, build_keys, domain)
     cum = jnp.cumsum(counts)
-    total = cum[n_probe - 1]
-
-    j = jnp.arange(out_capacity, dtype=cum.dtype)
-    probe_row = jnp.searchsorted(cum, j, side="right")
-    probe_row_c = jnp.clip(probe_row, 0, n_probe - 1)
-    before = jnp.where(probe_row_c > 0,
-                       cum[jnp.clip(probe_row_c - 1, 0, n_probe - 1)], 0)
-    within = j - before
-    pair_live = (j < total) & (within < counts[probe_row_c])
+    probe_row_c, within, out_live, total = _expand_map(counts,
+                                                       out_capacity)
+    pair_live = out_live & (within < counts[probe_row_c])
     build_row = order[jnp.clip(lo[probe_row_c] + within, 0, n_build - 1)]
 
     pair_cols = []
@@ -216,4 +327,4 @@ def join_mark(probe: Batch, build: Batch, probe_keys: tuple,
     before_start = jnp.where(start > 0, cs[jnp.clip(start - 1, 0,
                                                     out_capacity - 1)], 0)
     any_ok = (counts > 0) & ((upto_end - before_start) > 0)
-    return any_ok, total
+    return any_ok, total, oob

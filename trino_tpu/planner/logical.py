@@ -88,6 +88,10 @@ class JoinNode(PlanNode):
     # cost-chosen exchange strategy for the build side on a mesh
     # (DetermineJoinDistributionType.java:51): REPLICATED vs PARTITIONED
     distribution: str = "auto"        # auto|broadcast|partitioned
+    # dense-LUT probe domain (exclusive key upper bound) when connector
+    # stats prove the single build key lives in [0, domain) — the
+    # BigintGroupByHash-style fast path; None = sorted+searchsorted
+    build_key_domain: Optional[int] = None
 
 
 @dataclass(frozen=True)

@@ -127,7 +127,8 @@ def _prune(node: L.PlanNode, needed: frozenset):
             tuple(mr[k] for k in node.right_keys),
             residual, node.build_unique, output,
             null_aware=node.null_aware,
-            distribution=node.distribution), mapping
+            distribution=node.distribution,
+            build_key_domain=node.build_key_domain), mapping
 
     if isinstance(node, L.WindowNode):
         c = len(node.child.output)

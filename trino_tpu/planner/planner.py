@@ -517,22 +517,34 @@ class Planner:
         return 1e6
 
     def chain_column_stats(self, node: L.PlanNode):
-        """Per-output-column ColumnStats for a Filter/Project chain over a
-        scan (None where unknown). The seam where connector statistics
-        enter the cost model (spi/statistics -> FilterStatsCalculator)."""
+        """Per-output-column ColumnStats for Filter/Project/Join trees
+        over scans (None where unknown). Joins concatenate probe++build
+        column stats (NDVs are upper bounds post-join — callers cap by
+        row estimates). The seam where connector statistics enter the
+        cost model (spi/statistics -> FilterStatsCalculator)."""
         chain = []
         while isinstance(node, (L.FilterNode, L.ProjectNode)):
             chain.append(node)
             node = node.child
-        if not isinstance(node, L.ScanNode):
+        if isinstance(node, L.JoinNode):
+            left = self.chain_column_stats(node.left) or {}
+            cur = dict(left)
+            if node.kind in ("inner", "left"):
+                right = self.chain_column_stats(node.right) or {}
+                n_probe = len(node.left.output)
+                for i, s in right.items():
+                    cur[n_probe + i] = s
+        elif isinstance(node, L.ScanNode):
+            stats = self.catalog.get_table_stats(
+                node.catalog, node.schema_name, node.table)
+            if stats is None:
+                return None
+            cur = {}
+            for i, ci in enumerate(node.column_indices):
+                cur[i] = stats.columns.get(
+                    node.table_schema.fields[ci].name)
+        else:
             return None
-        stats = self.catalog.get_table_stats(
-            node.catalog, node.schema_name, node.table)
-        if stats is None:
-            return None
-        cur = {}
-        for i, ci in enumerate(node.column_indices):
-            cur[i] = stats.columns.get(node.table_schema.fields[ci].name)
         for nd in reversed(chain):
             if isinstance(nd, L.ProjectNode):
                 cur = {i: cur.get(e.index)
@@ -770,6 +782,8 @@ class Planner:
         of Trino's type-coerced join clauses."""
         probe_keys = list(probe_keys)
         build_keys = list(build_keys)
+        build_key_domain = self._dense_key_domain(
+            build_node, build_keys, build_fields)
         extra: List[ir.Expr] = []
         extra_cols: List[Tuple[str, DataType]] = []
         nb = len(build_node.output)
@@ -815,10 +829,43 @@ class Planner:
             max(1, len(build_node.output)) * 8
         distribution = "broadcast" if build_bytes < (32 << 20) \
             else "partitioned"
+        if extra:
+            build_key_domain = None    # remapped varchar keys can be -1
         return L.JoinNode(kind, probe_node, build_node,
                           tuple(probe_keys), tuple(build_keys), residual,
                           build_unique, output, null_aware=null_aware,
-                          distribution=distribution)
+                          distribution=distribution,
+                          build_key_domain=build_key_domain)
+
+    # dense-LUT memory caps: absolute 2^30 entries (4GB of int32), and
+    # 16x the build rows so wildly sparse domains stay on the sorted path
+    _DENSE_DOMAIN_CAP = 1 << 30
+
+    def _dense_key_domain(self, build_node, build_keys, build_fields):
+        """Static [0, domain) bound for a single build key, from exact
+        connector min/max stats (integer keys) or the dictionary pool
+        size (same-pool varchar keys)."""
+        if len(build_keys) != 1:
+            return None
+        bk = build_keys[0]
+        dt = build_node.output[bk][1]
+        if dt.kind is TypeKind.VARCHAR:
+            bf = build_fields[0]
+            if bf is not None and bf.dictionary is not None:
+                return max(1, len(bf.dictionary))
+            return None
+        if dt.kind not in (TypeKind.BIGINT, TypeKind.INTEGER,
+                           TypeKind.DATE):
+            return None
+        cstats = self.chain_column_stats(build_node)
+        s = cstats.get(bk) if cstats else None
+        if s is None or s.min_val is None or s.min_val < 0:
+            return None
+        d = int(s.max_val) + 2
+        rows = self.estimate_rows(build_node)
+        if d > self._DENSE_DOMAIN_CAP or d > max(1 << 22, 16 * rows):
+            return None
+        return 1 << (d - 1).bit_length()      # pow2: stable jit cache
 
     def plan_left_join(self, left: PlannedRelation, right: PlannedRelation,
                        condition: Optional[A.Node]) -> PlannedRelation:
@@ -1656,7 +1703,36 @@ class Planner:
             prod = math.prod(domains)
             if prod <= MAX_DIRECT_GROUPS:
                 return "direct", tuple(domains), prod
-        return "sort", (), DEFAULT_SORT_GROUPS
+        return "sort", (), self._sort_capacity(group_irs, scope, pre_node)
+
+    def _sort_capacity(self, group_irs, scope: Scope, pre_node) -> int:
+        """Size the sort-aggregation output from stats (NDV product capped
+        by input rows) instead of a fixed default: every capacity retry is
+        a fresh XLA compile plus a full re-sort, so landing right the
+        first time is the difference between one device pass and four
+        (GroupByHash's expectedSize estimation)."""
+        est = None
+        cstats = self.chain_column_stats(pre_node.child) \
+            if isinstance(pre_node, L.ProjectNode) else None
+        if cstats is not None:
+            # group keys are the pre-projection's leading exprs
+            prod = 1.0
+            for e in group_irs:
+                s = cstats.get(e.index) if isinstance(e, ir.ColumnRef) \
+                    else None
+                if s is None:
+                    prod = None
+                    break
+                prod *= max(1.0, s.ndv)
+            if prod is not None:
+                rows = self.estimate_rows(pre_node.child)
+                est = min(prod, rows)
+        if est is None:
+            return DEFAULT_SORT_GROUPS
+        # 1.3x headroom, pow2 bucket (stable jit cache), floor at the
+        # default so small queries share one trace
+        cap = 1 << max(1, int(1.3 * est) - 1).bit_length()
+        return int(min(max(cap, DEFAULT_SORT_GROUPS), 1 << 26))
 
     def domain_of(self, e: ir.Expr, scope: Scope) -> Optional[int]:
         if isinstance(e, ir.DerivedDict):
