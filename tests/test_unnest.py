@@ -1,0 +1,68 @@
+"""ARRAY type + UNNEST tests.
+
+Reference: operator/unnest/UnnestOperator.java:42 + spi/type/ArrayType.
+Arrays follow the engine's pool-id discipline (types.py): device carries
+int32 ids, element tuples live host-side — UNNEST/cardinality/contains
+are pool transforms like the varchar functions.
+"""
+
+from trino_tpu.exec.session import Session
+
+
+def session():
+    return Session(default_schema="tiny")
+
+
+def test_unnest_literal():
+    r = session().execute(
+        "SELECT x FROM UNNEST(ARRAY[3, 1, 2]) AS t(x) ORDER BY x")
+    assert r.rows == [(1,), (2,), (3,)]
+
+
+def test_unnest_with_ordinality_preserves_element_order():
+    r = session().execute(
+        "SELECT x, o FROM UNNEST(ARRAY[30, 10, 20]) "
+        "WITH ORDINALITY AS t(x, o) ORDER BY o")
+    assert r.rows == [(30, 1), (10, 2), (20, 3)]
+
+
+def test_unnest_lateral_cross_product():
+    r = session().execute(
+        "SELECT n_name, x FROM nation, UNNEST(ARRAY['a', 'b']) AS u(x) "
+        "WHERE n_nationkey < 2 ORDER BY n_name, x")
+    assert r.rows == [("ALGERIA", "a"), ("ALGERIA", "b"),
+                      ("ARGENTINA", "a"), ("ARGENTINA", "b")]
+
+
+def test_unnest_feeds_aggregation():
+    r = session().execute(
+        "SELECT count(*), sum(x), min(x) "
+        "FROM UNNEST(ARRAY[5, 10, 15, 20]) AS t(x)")
+    assert r.rows == [(4, 50, 5)]
+
+
+def test_unnest_varchar_elements():
+    r = session().execute(
+        "SELECT upper(x) FROM UNNEST(ARRAY['pear', 'fig']) AS t(x) "
+        "ORDER BY x")
+    assert r.rows == [("FIG",), ("PEAR",)]
+
+
+def test_unnest_filter_on_element():
+    r = session().execute(
+        "SELECT x FROM UNNEST(ARRAY[1, 2, 3, 4, 5]) AS t(x) "
+        "WHERE x > 3 ORDER BY x")
+    assert r.rows == [(4,), (5,)]
+
+
+def test_array_functions():
+    r = session().execute(
+        "SELECT cardinality(ARRAY[1, 2, 3]), contains(ARRAY[1, 2], 2), "
+        "contains(ARRAY['a', 'b'], 'c')")
+    assert r.rows == [(3, True, False)]
+
+
+def test_empty_array_unnest():
+    r = session().execute(
+        "SELECT count(*) FROM UNNEST(ARRAY[]) AS t(x)")
+    assert r.rows == [(0,)]

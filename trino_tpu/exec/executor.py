@@ -161,7 +161,47 @@ class Executor:
             return self.run_values(node)
         if isinstance(node, L.SetOpNode):
             return self.run_setop(node)
+        if isinstance(node, L.UnnestNode):
+            return self.run_unnest(node)
         raise NotImplementedError(type(node).__name__)
+
+    def run_unnest(self, node: L.UnnestNode) -> Batch:
+        """UNNEST expansion (operator/unnest/UnnestOperator.java:42):
+        repeat each live row once per element of its array. Arrays are
+        pool ids (types.py), so the expansion is a host-edge transform
+        like the other pool operations — flat offsets are precomputed
+        per pool, rows gather through np.repeat."""
+        child = self.run(node.child)
+        arrays, valids = batch_to_numpy(child)
+        ids = arrays[node.array_col]
+        id_valid = valids[node.array_col]
+        pool = node.array_pool
+        lengths = np.array([len(t) for t in pool], dtype=np.int64)
+        flat = [v for t in pool for v in t]
+        offsets = np.concatenate([[0], np.cumsum(lengths)])
+        reps = np.where(id_valid, lengths[ids], 0)   # NULL array: 0 rows
+        row_idx = np.repeat(np.arange(len(ids)), reps)
+        within = np.arange(len(row_idx)) - np.repeat(
+            np.cumsum(reps) - reps, reps)
+        elem_pos = offsets[ids[row_idx]] + within
+        elem_vals = [flat[int(p)] for p in elem_pos]
+        elem_valid = np.array([v is not None for v in elem_vals],
+                              dtype=np.bool_)
+        t = node.element_dtype
+        from ..types import TypeKind as TK
+        if t.kind is TK.VARCHAR:
+            index = {s: i for i, s in enumerate(node.element_pool or ())}
+            elem = np.array([index.get(v, 0) for v in elem_vals],
+                            dtype=np.int32)
+        else:
+            elem = np.array([v if v is not None else 0
+                             for v in elem_vals], dtype=t.np_dtype)
+        out_arrays = [a[row_idx] for a in arrays] + [elem]
+        out_valids = [v[row_idx] for v in valids] + [elem_valid]
+        if node.ordinality:
+            out_arrays.append((within + 1).astype(np.int64))
+            out_valids.append(np.ones(len(row_idx), dtype=np.bool_))
+        return batch_from_numpy(out_arrays, valids=out_valids)
 
     def run_values(self, node: L.ValuesNode) -> Batch:
         if node.arrays:

@@ -200,6 +200,15 @@ class DictValueMap(Expr):
 
 
 @dataclass(frozen=True)
+class ArrayConst(Expr):
+    """ARRAY[...] of constants: device sees pool id 0, the single-entry
+    element pool rides in the expression (the dictionary discipline,
+    types.py ARRAY)."""
+    pool: tuple                  # ((elem, elem, ...),)
+    dtype: DataType
+
+
+@dataclass(frozen=True)
 class DecimalAvg(Expr):
     """Exact decimal AVG finalizer: round-half-away-from-zero of
     sum/count at the argument's scale (Trino avg(decimal) semantics,
@@ -286,7 +295,7 @@ def remap_columns(expr: Expr, mapping) -> Expr:
     `mapping` (used by the column-pruning optimizer pass)."""
     if isinstance(expr, ColumnRef):
         return ColumnRef(mapping[expr.index], expr.dtype, expr.name)
-    if isinstance(expr, Literal):
+    if isinstance(expr, (Literal, ArrayConst)):
         return expr
     if isinstance(expr, Arith):
         return Arith(expr.op, remap_columns(expr.left, mapping),

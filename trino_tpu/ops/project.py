@@ -319,6 +319,10 @@ def eval_expr(expr: ir.Expr, batch: Batch):
             return d.astype(jnp.int64), v
         raise NotImplementedError(f"cast {src} -> {dst}")
 
+    if isinstance(expr, ir.ArrayConst):
+        return (jnp.zeros(n, dtype=jnp.int32),
+                jnp.ones(n, dtype=jnp.bool_))
+
     if isinstance(expr, ir.DerivedDict):
         d, v = eval_expr(expr.arg, batch)
         lut = jnp.asarray(expr.lut, dtype=jnp.int32)

@@ -235,6 +235,8 @@ class ExpressionLowerer:
         if isinstance(node, A.IntervalLit):
             raise AnalysisError(
                 "INTERVAL literal only supported in date +/- INTERVAL")
+        if isinstance(node, A.ArrayLiteral):
+            return self.lower_array_literal(node)
 
         if isinstance(node, A.BinaryOp):
             return self.lower_binary(node)
@@ -378,6 +380,33 @@ class ExpressionLowerer:
         lut = tuple(index[s] for s in transformed)
         return ir.DerivedDict(arg, lut, new_pool, arg.dtype)
 
+    def lower_array_literal(self, node: "A.ArrayLiteral") -> ir.Expr:
+        """ARRAY[...] of constants -> pool entry (tree/ArrayConstructor).
+        Elements must be literals; NULL elements allowed."""
+        from ..types import array_of
+        elems = []
+        elem_t = None
+        for item in node.items:
+            e = self.lower(item)
+            if isinstance(e, _StringConst):
+                elems.append(e.value)
+                et = VARCHAR
+            elif isinstance(e, ir.Literal):
+                elems.append(e.value)
+                et = e.dtype
+            else:
+                raise AnalysisError(
+                    "ARRAY[...] elements must be constants")
+            if e_is_null := (elems[-1] is None):
+                continue
+            if elem_t is None or elem_t.kind is TypeKind.BIGINT:
+                elem_t = et
+            elif et.kind is not TypeKind.BIGINT and et != elem_t:
+                elem_t = common_super_type(elem_t, et)
+        if elem_t is None:
+            elem_t = BIGINT
+        return ir.ArrayConst((tuple(elems),), array_of(elem_t))
+
     def lower_scalar_func(self, node: A.FunctionCall) -> ir.Expr:
         """Built-in scalar functions (metadata/InternalFunctionBundle.java's
         registry role): numeric ones lower to ir.ScalarFunc, varchar ones to
@@ -399,6 +428,28 @@ class ExpressionLowerer:
             pool = self.pool_of(args[0])
             return ir.DictValueMap(args[0],
                                    tuple(len(s) for s in pool), BIGINT)
+        if name == "cardinality":
+            if len(args) != 1 or \
+                    args[0].dtype.kind is not TypeKind.ARRAY:
+                raise AnalysisError("cardinality takes an array")
+            pool = self.pool_of(args[0])
+            return ir.DictValueMap(args[0],
+                                   tuple(len(t) for t in pool), BIGINT)
+        if name == "contains":
+            if len(args) != 2 or \
+                    args[0].dtype.kind is not TypeKind.ARRAY:
+                raise AnalysisError("contains(array, constant)")
+            pool = self.pool_of(args[0])
+            needle = args[1]
+            if isinstance(needle, _StringConst):
+                v = needle.value
+            elif isinstance(needle, ir.Literal):
+                v = needle.value
+            else:
+                raise AnalysisError("contains needle must be a constant")
+            from ..types import BOOLEAN as _B
+            return ir.DictPredicate(args[0],
+                                    tuple(v in t for t in pool), _B)
         if name == "concat":
             return self.lower_concat(args)
         if name == "replace":
@@ -636,10 +687,12 @@ class ExpressionLowerer:
     def pool_of(self, col: ir.Expr) -> tuple:
         if isinstance(col, ir.DerivedDict):
             return col.pool
+        if isinstance(col, ir.ArrayConst):
+            return col.pool
         if not isinstance(col, ir.ColumnRef):
             raise AnalysisError("varchar predicate requires a plain column")
         sc = next(c for c in self.scope.columns if c.index == col.index
-                  and c.dtype.kind is TypeKind.VARCHAR)
+                  and c.dtype.kind in (TypeKind.VARCHAR, TypeKind.ARRAY))
         if sc.field is None or sc.field.dictionary is None:
             raise AnalysisError(f"column {sc.name} has no dictionary")
         return sc.field.dictionary

@@ -120,6 +120,23 @@ class WindowNode(PlanNode):
 
 
 @dataclass(frozen=True)
+class UnnestNode(PlanNode):
+    """UNNEST lateral expansion (operator/unnest/UnnestOperator.java:42):
+    each input row repeats once per element of its array; output = child
+    columns ++ element column (++ ordinality). Arrays follow the pool-id
+    discipline (types.py), so expansion runs at the host edge like the
+    other pool transforms."""
+    child: PlanNode
+    array_col: int                    # child output column (pool ids)
+    array_pool: Tuple                 # id -> tuple of elements
+    element_name: str
+    element_dtype: "DataType"
+    element_pool: Optional[Tuple]     # varchar elements: their dict pool
+    ordinality: bool
+    output: Tuple
+
+
+@dataclass(frozen=True)
 class SortKey:
     index: int
     ascending: bool
@@ -182,7 +199,7 @@ class OutputNode(PlanNode):
 
 def children(node: PlanNode):
     if isinstance(node, (FilterNode, ProjectNode, AggregateNode, SortNode,
-                         LimitNode, OutputNode, WindowNode)):
+                         LimitNode, OutputNode, WindowNode, UnnestNode)):
         return (node.child,)
     if isinstance(node, (JoinNode, SetOpNode)):
         return (node.left, node.right)
@@ -221,6 +238,10 @@ def explain_text(node: PlanNode, indent: int = 0, annotate=None) -> str:
         line = f"{pad}Values[{node.num_rows} rows]"
     elif isinstance(node, SetOpNode):
         line = f"{pad}SetOp[{node.op}]"
+    elif isinstance(node, UnnestNode):
+        line = (f"{pad}Unnest[col={node.array_col} -> "
+                f"{node.element_name}"
+                f"{', ordinality' if node.ordinality else ''}]")
     elif isinstance(node, OutputNode):
         line = f"{pad}Output[{', '.join(node.names)}]"
     else:

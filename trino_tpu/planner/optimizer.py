@@ -152,6 +152,21 @@ def _prune(node: L.PlanNode, needed: frozenset):
             specs,
             tuple(child.output) + tuple(node.output[c:])), mapping
 
+    if isinstance(node, L.UnnestNode):
+        c = len(node.child.output)
+        child_needed = {i for i in needed if i < c} | {node.array_col}
+        child, m = _prune(node.child, frozenset(child_needed))
+        nc = len(child.output)
+        mapping = dict(m)
+        mapping[c] = nc                       # element column
+        if node.ordinality:
+            mapping[c + 1] = nc + 1
+        return L.UnnestNode(
+            child, m[node.array_col], node.array_pool,
+            node.element_name, node.element_dtype, node.element_pool,
+            node.ordinality,
+            tuple(child.output) + tuple(node.output[c:])), mapping
+
     if isinstance(node, L.SortNode):
         child_needed = needed | {k.index for k in node.keys}
         child, m = _prune(node.child, frozenset(child_needed))

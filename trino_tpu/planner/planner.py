@@ -320,14 +320,21 @@ class Planner:
         right = _cast_relation(right, rcasts)
         return left, right, out_fields, lremaps, rremaps
 
-    def plan_relation_tree(self, rel: A.Node) -> Tuple[List[PlannedRelation],
-                                                       List[A.Node]]:
-        """Flatten the FROM tree into base relations + ON conjuncts."""
+    def plan_relation_tree(self, rel: A.Node, unnests=None) \
+            -> Tuple[List[PlannedRelation], List[A.Node]]:
+        """Flatten the FROM tree into base relations + ON conjuncts.
+        UNNEST items collect into `unnests` (lateral: they expand the
+        combined preceding relations); passing None rejects them."""
         relations: List[PlannedRelation] = []
         conjuncts: List[A.Node] = []
 
         def walk(node: A.Node):
-            if isinstance(node, A.TableRef):
+            if isinstance(node, A.UnnestRef):
+                if unnests is None:
+                    raise AnalysisError(
+                        "UNNEST not supported in this position")
+                unnests.append(node)
+            elif isinstance(node, A.TableRef):
                 relations.append(self.plan_table(node))
             elif isinstance(node, A.ValuesRef):
                 relations.append(self.plan_values_ref(node))
@@ -442,6 +449,52 @@ class Planner:
             return rows_r
         rows_a = self.estimate_rows(acc.node)
         return max(1.0, rows_a * rows_r / denom)
+
+    def plan_unnest(self, rel: PlannedRelation,
+                    u: A.UnnestRef) -> PlannedRelation:
+        """Lateral UNNEST over the combined preceding relations
+        (tree/Unnest.java -> UnnestOperator.java:42)."""
+        lowerer = ExpressionLowerer(rel.scope, planner=self)
+        arg = lowerer.lower(u.arg)
+        if arg.dtype.kind is not TypeKind.ARRAY:
+            raise AnalysisError("UNNEST argument must be an array")
+        fld = self.field_for(arg, rel.scope)
+        if fld is None or fld.dictionary is None:
+            raise AnalysisError("UNNEST array lost its element pool")
+        node = rel.node
+        if isinstance(arg, ir.ColumnRef):
+            array_col = arg.index
+        else:
+            exprs = tuple(ir.ColumnRef(i, dt) for i, (_, dt)
+                          in enumerate(node.output)) + (arg,)
+            out = tuple(node.output) + (("$unnest_arr", arg.dtype),)
+            node = L.ProjectNode(node, exprs, out)
+            array_col = len(out) - 1
+
+        elem_t = arg.dtype.element
+        elem_name = (u.colnames[0] if u.colnames else "$unnest").lower()
+        elem_pool = None
+        if elem_t.kind is TypeKind.VARCHAR:
+            elem_pool = tuple(sorted(
+                {v for tup in fld.dictionary for v in tup
+                 if v is not None}))
+        output = tuple(node.output) + ((elem_name, elem_t),)
+        if u.ordinality:
+            ord_name = (u.colnames[1] if u.colnames and
+                        len(u.colnames) > 1 else "ordinality").lower()
+            output = output + ((ord_name, BIGINT),)
+        unnest = L.UnnestNode(node, array_col, tuple(fld.dictionary),
+                              elem_name, elem_t, elem_pool, u.ordinality,
+                              output)
+        alias = (u.alias or "$unnest").lower()
+        n0 = len(node.output)
+        cols = list(rel.scope.columns)
+        elem_field = Field(elem_name, elem_t, dictionary=elem_pool)
+        cols.append(ScopeColumn(alias, elem_name, elem_t, n0, elem_field))
+        if u.ordinality:
+            cols.append(ScopeColumn(alias, output[-1][0], BIGINT,
+                                    n0 + 1, None))
+        return PlannedRelation(unnest, Scope(cols))
 
     def cross_join_pair(self, left: PlannedRelation,
                         right: PlannedRelation) -> PlannedRelation:
@@ -1019,13 +1072,19 @@ class Planner:
             self.ctes = saved_ctes
 
     def plan_query_body(self, q: A.Query) -> PlannedRelation:
+        unnests: List[A.UnnestRef] = []
         if q.relation is None:
             # SELECT without FROM: single-row zero-column input relation
             # (Trino: Query with an implicit single-row ValuesNode)
             relations, on_conjuncts = [PlannedRelation(
                 L.ValuesNode((), (), 1, (), ()), Scope([]))], []
         else:
-            relations, on_conjuncts = self.plan_relation_tree(q.relation)
+            relations, on_conjuncts = self.plan_relation_tree(q.relation,
+                                                              unnests)
+        if not relations and unnests:
+            # FROM UNNEST(ARRAY[...]) alone: expand a single-row input
+            relations = [PlannedRelation(
+                L.ValuesNode((), (), 1, (), ()), Scope([]))]
 
         conjuncts: List[A.Node] = list(on_conjuncts)
         if q.where is not None:
@@ -1036,6 +1095,9 @@ class Planner:
             rel = self.apply_local_filters(relations[0], conjuncts)
         else:
             rel = self.build_join_tree(relations, conjuncts)
+        for u in unnests:
+            rel = self.plan_unnest(rel, u)
+            rel = self.apply_local_filters(rel, conjuncts)
         # residual multi-relation predicates (e.g. q19's OR-of-blocks)
         # become filters over the joined scope
         rel = self.apply_local_filters(rel, conjuncts)
@@ -1374,13 +1436,15 @@ class Planner:
         and through CASE when every branch shares one pool."""
         if isinstance(e, ir.DerivedDict):
             return Field("$derived", e.dtype, dictionary=e.pool)
+        if isinstance(e, ir.ArrayConst):
+            return Field("$array", e.dtype, dictionary=e.pool)
         if isinstance(e, ir.Literal) and e.dtype is not None and \
                 e.dtype.kind is TypeKind.VARCHAR:
             return Field("$literal", e.dtype, dictionary=(e.value,))
         if isinstance(e, ir.ColumnRef) and \
-                e.dtype.kind is TypeKind.VARCHAR:
+                e.dtype.kind in (TypeKind.VARCHAR, TypeKind.ARRAY):
             for c in scope.columns:
-                if c.index == e.index and c.dtype.kind is TypeKind.VARCHAR:
+                if c.index == e.index and c.dtype.kind is e.dtype.kind:
                     return c.field
         if isinstance(e, ir.Case) and e.dtype.kind is TypeKind.VARCHAR:
             branches = [v for _, v in e.whens]

@@ -182,6 +182,22 @@ class SubqueryRef(Node):
 
 
 @dataclass(frozen=True)
+class UnnestRef(Node):
+    """UNNEST(expr) [WITH ORDINALITY] [AS alias(col [, ord])] in FROM —
+    a lateral expansion over the preceding relations (tree/Unnest.java)."""
+    arg: Node
+    alias: Optional[str] = None
+    colnames: Optional[Tuple[str, ...]] = None
+    ordinality: bool = False
+
+
+@dataclass(frozen=True)
+class ArrayLiteral(Node):
+    """ARRAY[e1, e2, ...] (tree/ArrayConstructor.java)."""
+    items: Tuple[Node, ...]
+
+
+@dataclass(frozen=True)
 class Join(Node):
     kind: str                       # 'inner'|'left'|'right'|'full'|'cross'
     left: Node

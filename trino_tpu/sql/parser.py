@@ -449,6 +449,17 @@ class Parser:
             left = A.Join(kind, left, right, cond)
 
     def table_primary(self) -> A.Node:
+        if self.at_kw("UNNEST"):
+            self.advance()
+            self.expect_op("(")
+            arg = self.parse_expr()
+            self.expect_op(")")
+            ordinality = False
+            if self.accept_kw("WITH"):
+                self.expect_kw("ORDINALITY")
+                ordinality = True
+            alias, colnames = self.table_alias_with_columns()
+            return A.UnnestRef(arg, alias, colnames, ordinality)
         if self.accept_op("("):
             if self.at_kw("VALUES"):
                 v = self.parse_values()
@@ -627,6 +638,17 @@ class Parser:
             self.fail(f"unexpected token {t.raw!r}")
 
         # keyword-introduced primaries
+        if self.at_kw("ARRAY") and \
+                self.peek(1).kind == "op" and self.peek(1).text == "[":
+            self.advance()
+            self.expect_op("[")
+            items = []
+            if not self.at_op("]"):
+                items.append(self.parse_expr())
+                while self.accept_op(","):
+                    items.append(self.parse_expr())
+            self.expect_op("]")
+            return A.ArrayLiteral(tuple(items))
         if self.accept_kw("TRUE"):
             return A.BoolLit(True)
         if self.accept_kw("FALSE"):

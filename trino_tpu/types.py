@@ -42,6 +42,7 @@ class TypeKind(enum.Enum):
     TIMESTAMP = "timestamp"
     DECIMAL = "decimal"
     VARCHAR = "varchar"
+    ARRAY = "array"
 
 
 @dataclass(frozen=True)
@@ -51,11 +52,14 @@ class DataType:
     kind: TypeKind
     precision: Optional[int] = None  # DECIMAL only
     scale: Optional[int] = None      # DECIMAL only
+    element: Optional["DataType"] = None   # ARRAY only
 
     def __post_init__(self):
         if self.kind is TypeKind.DECIMAL:
             assert self.precision is not None and self.scale is not None
             assert self.precision <= 18, "long decimals (>18 digits) not yet supported"
+        if self.kind is TypeKind.ARRAY:
+            assert self.element is not None
 
     # ---- physical layout ------------------------------------------------
 
@@ -70,11 +74,17 @@ class DataType:
             TypeKind.TIMESTAMP: np.dtype(np.int64),   # micros since epoch
             TypeKind.DECIMAL: np.dtype(np.int64),
             TypeKind.VARCHAR: np.dtype(np.int32),  # dictionary codes
+            # arrays follow the dictionary discipline: the device carries
+            # int32 pool ids, element tuples live host-side in the Field
+            # (offsets+flat-values device layout is the escape hatch once
+            # array-heavy kernels become hot; today's consumers — UNNEST,
+            # cardinality, element access — run at batch edges)
+            TypeKind.ARRAY: np.dtype(np.int32),
         }[self.kind]
 
     @property
     def is_dictionary(self) -> bool:
-        return self.kind is TypeKind.VARCHAR
+        return self.kind in (TypeKind.VARCHAR, TypeKind.ARRAY)
 
     @property
     def is_integerlike(self) -> bool:
@@ -99,6 +109,10 @@ VARCHAR = DataType(TypeKind.VARCHAR)
 
 def decimal(precision: int, scale: int) -> DataType:
     return DataType(TypeKind.DECIMAL, precision, scale)
+
+
+def array_of(element: DataType) -> DataType:
+    return DataType(TypeKind.ARRAY, element=element)
 
 
 def common_super_type(a: DataType, b: DataType) -> DataType:
