@@ -132,6 +132,21 @@ def pad_capacity(n: int, multiple: int = 1024) -> int:
     return max(multiple, _round_up(n, multiple))
 
 
+def bucket_capacity(n: int) -> int:
+    """Coarse capacity bucket: the smallest of {2^k, 1.5*2^k} >= n.
+
+    Data-dependent capacities (post-compaction, join-expansion retries)
+    must land on few distinct values or every query compiles fresh
+    multi-minute XLA programs at large sizes; two buckets per octave caps
+    padding waste at 33% while keeping the jit/persistent-cache hit rate
+    high."""
+    n = max(1024, int(n))
+    k = (n - 1).bit_length()
+    if n <= 3 << (k - 2):          # 1.5 * 2^(k-1)
+        return 3 << (k - 2)
+    return 1 << k
+
+
 def batch_from_numpy(arrays: Sequence[np.ndarray],
                      valids: Optional[Sequence[Optional[np.ndarray]]] = None,
                      capacity: Optional[int] = None,

@@ -90,6 +90,12 @@ def join_unique_build_dense(probe: Batch, build: Batch, probe_keys: tuple,
     """Unique-build equi-join via dense LUT: one scatter to build, one
     gather per probe (the BigintGroupByHash-style fast path).
 
+    Random gathers are the whole cost on TPU (~1s per 60M-row column
+    through XLA's gather), so the kernel gathers as little as possible:
+    the build KEY column is reconstructed from the probe key (equal by
+    definition where matched), and all build validity masks pack into ONE
+    gathered word instead of one bool gather per column.
+
     Returns (out_batch, dup_count, oob_count); oob_count > 0 means a
     build key fell outside [0, domain) — the caller's stats were stale
     and it must re-run on the sorted fallback."""
@@ -110,13 +116,134 @@ def join_unique_build_dense(probe: Batch, build: Batch, probe_keys: tuple,
     if kind == "anti":
         return probe.with_live(probe.live & ~matched), dup, oob
 
+    bkey = build_keys[0] if len(build_keys) == 1 else None
+    pack_valids = len(build.columns) <= 63
+    vbits = None
+    if pack_valids:
+        # validity word: bit i = column i valid (skipping the key column,
+        # whose validity IS `matched`)
+        vword = jnp.zeros(build.capacity, dtype=jnp.int64)
+        for i, col in enumerate(build.columns):
+            if i == bkey:
+                continue
+            vword = vword | (col.valid.astype(jnp.int64) << i)
+        vbits = vword[src_c]
+
     build_cols = []
-    for col in build.columns:
+    for i, col in enumerate(build.columns):
+        if i == bkey:
+            # matched rows' build key == probe key; no gather needed
+            build_cols.append(Column(
+                data=jnp.where(matched, pk, 0).astype(col.data.dtype),
+                valid=matched))
+            continue
+        valid = ((vbits >> i) & 1).astype(jnp.bool_) if pack_valids \
+            else col.valid[src_c]
         build_cols.append(Column(data=col.data[src_c],
-                                 valid=col.valid[src_c] & matched))
+                                 valid=valid & matched))
     live = probe.live & matched if kind == "inner" else probe.live
     return (Batch(columns=probe.columns + tuple(build_cols), live=live),
             dup, oob)
+
+
+def _flood_first(vals: jax.Array, boundary: jax.Array) -> jax.Array:
+    """Inclusive segmented scan keeping each segment's FIRST value —
+    log-depth elementwise passes, no gathers."""
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, va)
+    _, out = jax.lax.associative_scan(combine, (boundary, vals))
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def join_unique_build_merge(probe: Batch, build: Batch,
+                            probe_keys: tuple, build_keys: tuple,
+                            kind: str):
+    """Unique-build equi-join as a sort-merge: concat both sides, ONE
+    multi-operand sort by (key, side), then flood each run's build row
+    (first in its run) across the run with segmented scans.
+
+    Zero random gathers: the sort network moves every payload column at
+    HBM-friendly cost (~0.7s for 67M x 5 operands on v5e) where
+    XLA's gather costs ~1.6s PER COLUMN — this kernel is why. The output
+    batch has capacity probe+build (build slots dead) and is ordered by
+    key; callers compact (sort-based, cheap) when live density drops.
+
+    kind: 'inner' | 'left'. Returns (out_batch, dup_count)."""
+    pk, pk_valid = _combined_key(probe, probe_keys)
+    bk, bk_valid = _combined_key(build, build_keys)
+    m, n = build.capacity, probe.capacity
+    b_ok = build.live & bk_valid
+    p_ok = probe.live & pk_valid
+    key = jnp.concatenate([jnp.where(b_ok, bk, _SENTINEL),
+                           jnp.where(p_ok, pk, _SENTINEL)])
+    side = jnp.concatenate([jnp.zeros(m, dtype=jnp.int8),
+                            jnp.ones(n, dtype=jnp.int8)])
+
+    bkey = build_keys[0] if len(build_keys) == 1 else None
+    operands = [key, side]
+    # probe payloads ride the sort (zeros in build slots)
+    p_slots = []
+    for col in probe.columns:
+        operands.append(jnp.concatenate([
+            jnp.zeros(m, dtype=col.data.dtype), col.data]))
+        p_slots.append(len(operands) - 1)
+    pvw = jnp.zeros(n, dtype=jnp.int64)
+    for i, col in enumerate(probe.columns):
+        pvw = pvw | (col.valid.astype(jnp.int64) << i)
+    operands.append(jnp.concatenate([jnp.zeros(m, dtype=jnp.int64),
+                                     pvw]))
+    pvw_slot = len(operands) - 1
+    # build payloads (key column reconstructed from the run key)
+    b_slots = {}
+    for i, col in enumerate(build.columns):
+        if i == bkey:
+            continue
+        operands.append(jnp.concatenate([
+            col.data, jnp.zeros(n, dtype=col.data.dtype)]))
+        b_slots[i] = len(operands) - 1
+    bvw = jnp.zeros(m, dtype=jnp.int64)
+    for i, col in enumerate(build.columns):
+        bvw = bvw | (col.valid.astype(jnp.int64) << i)
+    operands.append(jnp.concatenate([bvw, jnp.zeros(n, dtype=jnp.int64)]))
+    bvw_slot = len(operands) - 1
+    operands.append(jnp.concatenate([jnp.zeros(m, dtype=jnp.bool_),
+                                     probe.live]))
+
+    out = jax.lax.sort(tuple(operands), num_keys=2)
+    skey, sside = out[0], out[1]
+    plive = out[-1]
+    N = m + n
+    pos = jnp.arange(N)
+    boundary = (pos == 0) | (skey != jnp.roll(skey, 1))
+    is_build = (sside == 0) & (skey != _SENTINEL)
+    # a build row not at its run start follows another build row of the
+    # same key (side sorts build first) — the uniqueness violation
+    dup = jnp.sum(is_build & ~boundary)
+    has_build = _flood_first(is_build & boundary, boundary)
+    is_probe = sside == 1
+    matched = is_probe & has_build & (skey != _SENTINEL)
+
+    spvw = out[pvw_slot]
+    sbvw = _flood_first(out[bvw_slot], boundary)
+    cols = []
+    for i, col in enumerate(probe.columns):
+        cols.append(Column(
+            data=out[p_slots[i]],
+            valid=((spvw >> i) & 1).astype(jnp.bool_) & is_probe))
+    for i, col in enumerate(build.columns):
+        if i == bkey:
+            cols.append(Column(
+                data=jnp.where(matched, skey, 0).astype(col.data.dtype),
+                valid=matched))
+            continue
+        cols.append(Column(
+            data=_flood_first(out[b_slots[i]], boundary),
+            valid=((sbvw >> i) & 1).astype(jnp.bool_) & matched))
+    live = plive & (matched if kind == "inner" else is_probe)
+    return Batch(columns=tuple(cols), live=live), dup
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4))
