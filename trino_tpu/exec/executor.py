@@ -503,7 +503,12 @@ class Executor:
         network); dense LUT / sorted probing remain for membership and
         wide-row fallbacks. None = build had duplicate keys (caller
         expands)."""
-        if node.kind in ("inner", "left") and \
+        # the multi-operand sort stops compiling around ~48M x 11 operands
+        # (TPU AOT compiler OOM); above the gate the dense-LUT/gather path
+        # carries the join
+        merge_ok = (probe.capacity + build.capacity) * \
+            max(1, len(probe.columns) + len(build.columns)) <= (1 << 28)
+        if node.kind in ("inner", "left") and merge_ok and \
                 len(probe.columns) <= 63 and len(build.columns) <= 63:
             out, dup = join_unique_build_merge(
                 probe, build, node.left_keys, node.right_keys, node.kind)
