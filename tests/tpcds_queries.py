@@ -1513,3 +1513,1718 @@ WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
 ORDER BY 1, 2, 3
 LIMIT 100
 """
+
+# ---------------------------------------------------------------------------
+# round-3 additions: the remaining spec queries, adapted (noted per query)
+# to the generated schema subset. Oracle-verified like the rest.
+# ---------------------------------------------------------------------------
+
+# q1: customers returning more than 1.2x their store's average
+QUERIES[1] = """
+WITH customer_total_return AS (
+  SELECT sr_customer_sk ctr_customer_sk, sr_store_sk ctr_store_sk,
+         sum(sr_return_amt) ctr_total_return
+  FROM store_returns, date_dim
+  WHERE sr_returned_date_sk = d_date_sk AND d_year = 2000
+  GROUP BY sr_customer_sk, sr_store_sk)
+SELECT c_customer_id
+FROM customer_total_return ctr1, store, customer
+WHERE ctr1.ctr_total_return >
+      (SELECT avg(ctr_total_return) * 1.2 FROM customer_total_return ctr2
+       WHERE ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+  AND s_store_sk = ctr1.ctr_store_sk
+  AND s_state = 'TN'
+  AND ctr1.ctr_customer_sk = c_customer_sk
+ORDER BY c_customer_id
+LIMIT 100
+"""
+
+# q4: year-over-year growth, store vs catalog vs web (3-channel year_total)
+QUERIES[4] = """
+WITH year_total AS (
+  SELECT c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name, d_year dyear,
+         sum(((ss_ext_list_price - ss_ext_wholesale_cost
+               - ss_ext_discount_amt) + ss_ext_sales_price) / 2) year_total,
+         's' sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk AND ss_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+  UNION ALL
+  SELECT c_customer_id, c_first_name, c_last_name, d_year,
+         sum(((cs_ext_list_price - cs_ext_wholesale_cost
+               - cs_ext_discount_amt) + cs_ext_sales_price) / 2),
+         'c' sale_type
+  FROM customer, catalog_sales, date_dim
+  WHERE c_customer_sk = cs_bill_customer_sk AND cs_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+  UNION ALL
+  SELECT c_customer_id, c_first_name, c_last_name, d_year,
+         sum(((ws_ext_list_price - ws_ext_wholesale_cost
+               - ws_ext_discount_amt) + ws_ext_sales_price) / 2),
+         'w' sale_type
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk AND ws_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year)
+SELECT t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_c_firstyear, year_total t_c_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_c_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_c_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.sale_type = 's' AND t_c_firstyear.sale_type = 'c'
+  AND t_w_firstyear.sale_type = 'w' AND t_s_secyear.sale_type = 's'
+  AND t_c_secyear.sale_type = 'c' AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.dyear = 2001 AND t_s_secyear.dyear = 2002
+  AND t_c_firstyear.dyear = 2001 AND t_c_secyear.dyear = 2002
+  AND t_w_firstyear.dyear = 2001 AND t_w_secyear.dyear = 2002
+  AND t_s_firstyear.year_total > 0 AND t_c_firstyear.year_total > 0
+  AND t_w_firstyear.year_total > 0
+  AND CASE WHEN t_c_firstyear.year_total > 0
+           THEN t_c_secyear.year_total / t_c_firstyear.year_total
+           ELSE NULL END >
+      CASE WHEN t_s_firstyear.year_total > 0
+           THEN t_s_secyear.year_total / t_s_firstyear.year_total
+           ELSE NULL END
+  AND CASE WHEN t_c_firstyear.year_total > 0
+           THEN t_c_secyear.year_total / t_c_firstyear.year_total
+           ELSE NULL END >
+      CASE WHEN t_w_firstyear.year_total > 0
+           THEN t_w_secyear.year_total / t_w_firstyear.year_total
+           ELSE NULL END
+ORDER BY t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+         t_s_secyear.customer_last_name
+LIMIT 100
+"""
+
+# q5: sales + returns per channel with ROLLUP(channel, id)
+QUERIES[5] = """
+WITH ssr AS (
+  SELECT s_store_id,
+         sum(sales_price) sales, sum(profit) profit,
+         sum(return_amt) returns_amt, sum(net_loss) profit_loss
+  FROM (SELECT ss_store_sk store_sk, ss_sold_date_sk date_sk,
+               ss_ext_sales_price sales_price, ss_net_profit profit,
+               cast(0 AS decimal(7,2)) return_amt,
+               cast(0 AS decimal(7,2)) net_loss
+        FROM store_sales
+        UNION ALL
+        SELECT sr_store_sk store_sk, sr_returned_date_sk date_sk,
+               cast(0 AS decimal(7,2)) sales_price,
+               cast(0 AS decimal(7,2)) profit,
+               sr_return_amt return_amt, sr_net_loss net_loss
+        FROM store_returns) salesreturns, date_dim, store
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23'
+                   AND DATE '2000-08-23' + INTERVAL '14' DAY
+    AND store_sk = s_store_sk
+  GROUP BY s_store_id),
+ csr AS (
+  SELECT cp_catalog_page_id,
+         sum(sales_price) sales, sum(profit) profit,
+         sum(return_amt) returns_amt, sum(net_loss) profit_loss
+  FROM (SELECT cs_catalog_page_sk page_sk, cs_sold_date_sk date_sk,
+               cs_ext_sales_price sales_price, cs_net_profit profit,
+               cast(0 AS decimal(7,2)) return_amt,
+               cast(0 AS decimal(7,2)) net_loss
+        FROM catalog_sales
+        UNION ALL
+        SELECT cr_catalog_page_sk page_sk, cr_returned_date_sk date_sk,
+               cast(0 AS decimal(7,2)) sales_price,
+               cast(0 AS decimal(7,2)) profit,
+               cr_return_amount return_amt, cr_net_loss net_loss
+        FROM catalog_returns) salesreturns, date_dim, catalog_page
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23'
+                   AND DATE '2000-08-23' + INTERVAL '14' DAY
+    AND page_sk = cp_catalog_page_sk
+  GROUP BY cp_catalog_page_id),
+ wsr AS (
+  SELECT web_name,
+         sum(sales_price) sales, sum(profit) profit,
+         sum(return_amt) returns_amt, sum(net_loss) profit_loss
+  FROM (SELECT ws_web_site_sk wsr_web_site_sk, ws_sold_date_sk date_sk,
+               ws_ext_sales_price sales_price, ws_net_profit profit,
+               cast(0 AS decimal(7,2)) return_amt,
+               cast(0 AS decimal(7,2)) net_loss
+        FROM web_sales
+        UNION ALL
+        SELECT ws.ws_web_site_sk wsr_web_site_sk,
+               wr.wr_returned_date_sk date_sk,
+               cast(0 AS decimal(7,2)) sales_price,
+               cast(0 AS decimal(7,2)) profit,
+               wr.wr_return_amt return_amt, wr.wr_net_loss net_loss
+        FROM web_returns wr
+        LEFT JOIN web_sales ws
+          ON wr.wr_item_sk = ws.ws_item_sk
+         AND wr.wr_order_number = ws.ws_order_number) salesreturns,
+       date_dim, web_site
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23'
+                   AND DATE '2000-08-23' + INTERVAL '14' DAY
+    AND wsr_web_site_sk = web_site_sk
+  GROUP BY web_name)
+SELECT channel, id, sum(sales) sales, sum(returns_amt) returns_amt,
+       sum(profit - profit_loss) profit
+FROM (SELECT 'store channel' channel, s_store_id id, sales,
+             returns_amt, profit, profit_loss
+      FROM ssr
+      UNION ALL
+      SELECT 'catalog channel' channel, cp_catalog_page_id id, sales,
+             returns_amt, profit, profit_loss
+      FROM csr
+      UNION ALL
+      SELECT 'web channel' channel, web_name id, sales, returns_amt,
+             profit, profit_loss
+      FROM wsr) x
+GROUP BY ROLLUP (channel, id)
+ORDER BY channel, id
+LIMIT 100
+"""
+
+# q6: states whose customers buy items priced over 1.2x category average
+QUERIES[6] = """
+SELECT a.ca_state state, count(*) cnt
+FROM customer_address a, customer c, store_sales s, date_dim d, item i
+WHERE a.ca_address_sk = c.c_current_addr_sk
+  AND c.c_customer_sk = s.ss_customer_sk
+  AND s.ss_sold_date_sk = d.d_date_sk
+  AND s.ss_item_sk = i.i_item_sk
+  AND d.d_month_seq =
+      (SELECT DISTINCT d_month_seq FROM date_dim
+       WHERE d_year = 2001 AND d_moy = 1)
+  AND i.i_current_price > 1.2 *
+      (SELECT avg(j.i_current_price) FROM item j
+       WHERE j.i_category = i.i_category)
+GROUP BY a.ca_state
+HAVING count(*) >= 10
+ORDER BY cnt, a.ca_state
+LIMIT 100
+"""
+
+# q8: store sales uplift in zips with concentrated preferred customers
+# (adapted: 2-digit zip prefixes instead of the spec's 400-entry 5-digit
+# list — the generated zip pool is synthetic)
+QUERIES[8] = """
+SELECT s_store_name, sum(ss_net_profit)
+FROM store_sales, date_dim, store,
+     (SELECT ca_zip
+      FROM (SELECT substr(ca_zip, 1, 2) ca_zip, count(*) cnt
+            FROM customer_address, customer
+            WHERE ca_address_sk = c_current_addr_sk
+              AND c_preferred_cust_flag = 'Y'
+            GROUP BY substr(ca_zip, 1, 2)
+            HAVING count(*) > 10) a1) v1
+WHERE ss_store_sk = s_store_sk
+  AND ss_sold_date_sk = d_date_sk
+  AND d_qoy = 2 AND d_year = 1998
+  AND substr(s_zip, 1, 2) = v1.ca_zip
+GROUP BY s_store_name
+ORDER BY s_store_name
+LIMIT 100
+"""
+
+# q14 (first variant): cross-channel items, ROLLUP over channel/brand/class
+# (adapted: the spec's second AVG-gated half is represented by the
+# avg_sales HAVING gate; d_moy window per spec)
+QUERIES[14] = """
+WITH cross_items AS (
+  SELECT i_item_sk ss_item_sk
+  FROM item,
+       (SELECT iss.i_brand_id brand_id, iss.i_class_id class_id,
+               iss.i_category_id category_id
+        FROM store_sales, item iss, date_dim d1
+        WHERE ss_item_sk = iss.i_item_sk
+          AND ss_sold_date_sk = d1.d_date_sk
+          AND d1.d_year BETWEEN 1999 AND 2001
+        INTERSECT
+        SELECT ics.i_brand_id, ics.i_class_id, ics.i_category_id
+        FROM catalog_sales, item ics, date_dim d2
+        WHERE cs_item_sk = ics.i_item_sk
+          AND cs_sold_date_sk = d2.d_date_sk
+          AND d2.d_year BETWEEN 1999 AND 2001
+        INTERSECT
+        SELECT iws.i_brand_id, iws.i_class_id, iws.i_category_id
+        FROM web_sales, item iws, date_dim d3
+        WHERE ws_item_sk = iws.i_item_sk
+          AND ws_sold_date_sk = d3.d_date_sk
+          AND d3.d_year BETWEEN 1999 AND 2001) x
+  WHERE i_brand_id = brand_id AND i_class_id = class_id
+    AND i_category_id = category_id),
+ avg_sales AS (
+  SELECT avg(quantity * list_price) average_sales
+  FROM (SELECT ss_quantity quantity, ss_list_price list_price
+        FROM store_sales, date_dim
+        WHERE ss_sold_date_sk = d_date_sk
+          AND d_year BETWEEN 1999 AND 2001
+        UNION ALL
+        SELECT cs_quantity, cs_list_price
+        FROM catalog_sales, date_dim
+        WHERE cs_sold_date_sk = d_date_sk
+          AND d_year BETWEEN 1999 AND 2001
+        UNION ALL
+        SELECT ws_quantity, ws_list_price
+        FROM web_sales, date_dim
+        WHERE ws_sold_date_sk = d_date_sk
+          AND d_year BETWEEN 1999 AND 2001) x)
+SELECT channel, i_brand_id, i_class_id, i_category_id,
+       sum(sales) sum_sales, sum(number_sales) number_sales
+FROM (SELECT 'store' channel, i_brand_id, i_class_id, i_category_id,
+             sum(ss_quantity * ss_list_price) sales, count(*) number_sales
+      FROM store_sales, item, date_dim
+      WHERE ss_item_sk IN (SELECT ss_item_sk FROM cross_items)
+        AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+        AND d_year = 2001 AND d_moy = 11
+      GROUP BY i_brand_id, i_class_id, i_category_id
+      HAVING sum(ss_quantity * ss_list_price) >
+             (SELECT average_sales FROM avg_sales)
+      UNION ALL
+      SELECT 'catalog' channel, i_brand_id, i_class_id, i_category_id,
+             sum(cs_quantity * cs_list_price) sales, count(*) number_sales
+      FROM catalog_sales, item, date_dim
+      WHERE cs_item_sk IN (SELECT ss_item_sk FROM cross_items)
+        AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+        AND d_year = 2001 AND d_moy = 11
+      GROUP BY i_brand_id, i_class_id, i_category_id
+      HAVING sum(cs_quantity * cs_list_price) >
+             (SELECT average_sales FROM avg_sales)
+      UNION ALL
+      SELECT 'web' channel, i_brand_id, i_class_id, i_category_id,
+             sum(ws_quantity * ws_list_price) sales, count(*) number_sales
+      FROM web_sales, item, date_dim
+      WHERE ws_item_sk IN (SELECT ss_item_sk FROM cross_items)
+        AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+        AND d_year = 2001 AND d_moy = 11
+      GROUP BY i_brand_id, i_class_id, i_category_id
+      HAVING sum(ws_quantity * ws_list_price) >
+             (SELECT average_sales FROM avg_sales)) y
+GROUP BY ROLLUP (channel, i_brand_id, i_class_id, i_category_id)
+ORDER BY channel, i_brand_id, i_class_id, i_category_id
+LIMIT 100
+"""
+
+# q17: quantity statistics across the sale->return->re-purchase chain
+# (adapted: d_quarter_name -> d_year/d_qoy; the generator has no
+# quarter-name column)
+QUERIES[17] = """
+SELECT i_item_id, i_item_desc, s_state,
+       count(ss_quantity) store_sales_quantitycount,
+       avg(ss_quantity) store_sales_quantityave,
+       stddev_samp(ss_quantity) store_sales_quantitystdev,
+       count(sr_return_quantity) store_returns_quantitycount,
+       avg(sr_return_quantity) store_returns_quantityave,
+       stddev_samp(sr_return_quantity) store_returns_quantitystdev,
+       count(cs_quantity) catalog_sales_quantitycount,
+       avg(cs_quantity) catalog_sales_quantityave,
+       stddev_samp(cs_quantity) catalog_sales_quantitystdev
+FROM store_sales, store_returns, catalog_sales, date_dim d1, date_dim d2,
+     date_dim d3, store, item
+WHERE d1.d_year = 2001 AND d1.d_qoy = 1
+  AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk
+  AND s_store_sk = ss_store_sk
+  AND ss_customer_sk = sr_customer_sk
+  AND ss_item_sk = sr_item_sk
+  AND ss_ticket_number = sr_ticket_number
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND d2.d_year = 2001 AND d2.d_qoy IN (1, 2, 3)
+  AND sr_customer_sk = cs_bill_customer_sk
+  AND sr_item_sk = cs_item_sk
+  AND cs_sold_date_sk = d3.d_date_sk
+  AND d3.d_year = 2001 AND d3.d_qoy IN (1, 2, 3)
+GROUP BY i_item_id, i_item_desc, s_state
+ORDER BY i_item_id, i_item_desc, s_state
+LIMIT 100
+"""
+
+# q18: catalog sales demographics with ROLLUP over geography
+QUERIES[18] = """
+SELECT i_item_id, ca_country, ca_state, ca_county,
+       avg(cast(cs_quantity AS decimal(12,2))) agg1,
+       avg(cast(cs_list_price AS decimal(12,2))) agg2,
+       avg(cast(cs_coupon_amt AS decimal(12,2))) agg3,
+       avg(cast(cs_sales_price AS decimal(12,2))) agg4,
+       avg(cast(cs_net_profit AS decimal(12,2))) agg5,
+       avg(cast(c_birth_year AS decimal(12,2))) agg6,
+       avg(cast(cd1.cd_dep_count AS decimal(12,2))) agg7
+FROM catalog_sales, customer_demographics cd1, customer_demographics cd2,
+     customer, customer_address, date_dim, item
+WHERE cs_sold_date_sk = d_date_sk
+  AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd1.cd_demo_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cd1.cd_gender = 'F' AND cd1.cd_education_status = 'Unknown'
+  AND c_current_cdemo_sk = cd2.cd_demo_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND c_birth_month IN (1, 6, 8, 9, 12, 2)
+  AND d_year = 1998
+GROUP BY ROLLUP (i_item_id, ca_country, ca_state, ca_county)
+ORDER BY ca_country, ca_state, ca_county, i_item_id
+LIMIT 100
+"""
+
+# q22: inventory quantity-on-hand averages, 4-level ROLLUP
+QUERIES[22] = """
+SELECT i_product_name, i_brand, i_class, i_category,
+       avg(inv_quantity_on_hand) qoh
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk
+  AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+GROUP BY ROLLUP (i_product_name, i_brand, i_class, i_category)
+ORDER BY qoh, i_product_name, i_brand, i_class, i_category
+LIMIT 100
+"""
+
+# q23 (first variant): frequent cross-channel shoppers' catalog+web sales
+# (adapted: substr(i_item_desc,1,30) grouping kept; best customers are
+# those above 50% of max store spend — tiny scale makes 95% empty)
+QUERIES[23] = """
+WITH frequent_ss_items AS (
+  SELECT substr(i_item_desc, 1, 30) itemdesc, i_item_sk item_sk,
+         d_date solddate, count(*) cnt
+  FROM store_sales, date_dim, item
+  WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+    AND d_year IN (2000, 2001, 2002, 2003)
+  GROUP BY substr(i_item_desc, 1, 30), i_item_sk, d_date
+  HAVING count(*) > 4),
+ max_store_sales AS (
+  SELECT max(csales) tpcds_cmax
+  FROM (SELECT c_customer_sk, sum(ss_quantity * ss_sales_price) csales
+        FROM store_sales, customer, date_dim
+        WHERE ss_customer_sk = c_customer_sk
+          AND ss_sold_date_sk = d_date_sk
+          AND d_year IN (2000, 2001, 2002, 2003)
+        GROUP BY c_customer_sk) x),
+ best_ss_customer AS (
+  SELECT c_customer_sk, sum(ss_quantity * ss_sales_price) ssales
+  FROM store_sales, customer
+  WHERE ss_customer_sk = c_customer_sk
+  GROUP BY c_customer_sk
+  HAVING sum(ss_quantity * ss_sales_price) >
+         0.5 * (SELECT tpcds_cmax FROM max_store_sales))
+SELECT sum(sales)
+FROM (SELECT cs_quantity * cs_list_price sales
+      FROM catalog_sales, date_dim
+      WHERE d_year = 2000 AND d_moy = 2 AND cs_sold_date_sk = d_date_sk
+        AND cs_item_sk IN (SELECT item_sk FROM frequent_ss_items)
+        AND cs_bill_customer_sk IN
+            (SELECT c_customer_sk FROM best_ss_customer)
+      UNION ALL
+      SELECT ws_quantity * ws_list_price sales
+      FROM web_sales, date_dim
+      WHERE d_year = 2000 AND d_moy = 2 AND ws_sold_date_sk = d_date_sk
+        AND ws_item_sk IN (SELECT item_sk FROM frequent_ss_items)
+        AND ws_bill_customer_sk IN
+            (SELECT c_customer_sk FROM best_ss_customer)) y
+"""
+
+# q24 (first variant): store-channel sales by customer/color where the
+# customer's birth country differs from their address country
+QUERIES[24] = """
+WITH ssales AS (
+  SELECT c_last_name, c_first_name, s_store_name, ca_state, s_state,
+         i_color, i_current_price, i_manager_id, i_units, i_size,
+         sum(ss_net_paid) netpaid
+  FROM store_sales, store_returns, store, item, customer,
+       customer_address
+  WHERE ss_ticket_number = sr_ticket_number
+    AND ss_item_sk = sr_item_sk
+    AND ss_customer_sk = c_customer_sk
+    AND ss_item_sk = i_item_sk
+    AND ss_store_sk = s_store_sk
+    AND c_current_addr_sk = ca_address_sk
+    AND c_birth_country <> upper(ca_country)
+    AND s_zip = ca_zip
+    AND s_market_id = 8
+  GROUP BY c_last_name, c_first_name, s_store_name, ca_state, s_state,
+           i_color, i_current_price, i_manager_id, i_units, i_size)
+SELECT c_last_name, c_first_name, s_store_name, sum(netpaid) paid
+FROM ssales
+WHERE i_color = 'pale'
+GROUP BY c_last_name, c_first_name, s_store_name
+HAVING sum(netpaid) > (SELECT 0.05 * avg(netpaid) FROM ssales)
+ORDER BY c_last_name, c_first_name, s_store_name
+"""
+
+# q36: gross-margin ranking with grouping()-keyed partitions
+QUERIES[36] = """
+SELECT sum(ss_net_profit) / sum(ss_ext_sales_price) gross_margin,
+       i_category, i_class,
+       grouping(i_category) + grouping(i_class) lochierarchy,
+       rank() OVER (
+         PARTITION BY grouping(i_category) + grouping(i_class),
+                      CASE WHEN grouping(i_class) = 0
+                           THEN i_category END
+         ORDER BY sum(ss_net_profit) / sum(ss_ext_sales_price) ASC)
+         rank_within_parent
+FROM store_sales, date_dim d1, item, store
+WHERE d1.d_year = 2001
+  AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk
+  AND s_store_sk = ss_store_sk
+  AND s_state = 'TN'
+GROUP BY ROLLUP (i_category, i_class)
+ORDER BY lochierarchy DESC,
+         CASE WHEN grouping(i_category) + grouping(i_class) = 0
+              THEN i_category END,
+         rank_within_parent
+LIMIT 100
+"""
+
+# q39 (first variant): inventory coefficient-of-variation pairs across
+# consecutive months
+QUERIES[39] = """
+WITH inv AS (
+  SELECT w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy, stdev, mean,
+         CASE mean WHEN 0 THEN NULL ELSE stdev / mean END cov
+  FROM (SELECT w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy,
+               stddev_samp(inv_quantity_on_hand) stdev,
+               avg(inv_quantity_on_hand) mean
+        FROM inventory, item, warehouse, date_dim
+        WHERE inv_item_sk = i_item_sk
+          AND inv_warehouse_sk = w_warehouse_sk
+          AND inv_date_sk = d_date_sk
+          AND d_year = 2001
+        GROUP BY w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy) foo
+  WHERE CASE mean WHEN 0 THEN 0 ELSE stdev / mean END > 1)
+SELECT inv1.w_warehouse_sk, inv1.i_item_sk, inv1.d_moy, inv1.mean,
+       inv1.cov, inv2.w_warehouse_sk w2, inv2.i_item_sk i2,
+       inv2.d_moy moy2, inv2.mean mean2, inv2.cov cov2
+FROM inv inv1, inv inv2
+WHERE inv1.i_item_sk = inv2.i_item_sk
+  AND inv1.w_warehouse_sk = inv2.w_warehouse_sk
+  AND inv1.d_moy = 1 AND inv2.d_moy = 2
+ORDER BY inv1.w_warehouse_sk, inv1.i_item_sk, inv1.d_moy, inv1.mean,
+         inv1.cov, inv2.d_moy, inv2.mean, inv2.cov
+"""
+
+# q41: distinct product names of items whose manufacturer also makes
+# items in specific color/unit/size combinations
+QUERIES[41] = """
+SELECT DISTINCT i_product_name
+FROM item i1
+WHERE i_manufact_id BETWEEN 738 AND 778
+  AND (SELECT count(*) FROM item
+       WHERE i_manufact = i1.i_manufact
+         AND ((i_category = 'Women'
+               AND i_color IN ('powder', 'khaki')
+               AND i_units IN ('Ounce', 'Oz')
+               AND i_size IN ('medium', 'extra large'))
+           OR (i_category = 'Women'
+               AND i_color IN ('brown', 'honeydew')
+               AND i_units IN ('Bunch', 'Ton')
+               AND i_size IN ('N/A', 'small'))
+           OR (i_category = 'Men'
+               AND i_color IN ('floral', 'deep')
+               AND i_units IN ('N/A', 'Dozen')
+               AND i_size IN ('petite', 'petite'))
+           OR (i_category = 'Men'
+               AND i_color IN ('light', 'cornflower')
+               AND i_units IN ('Box', 'Pound')
+               AND i_size IN ('medium', 'extra large')))) > 0
+ORDER BY i_product_name
+LIMIT 100
+"""
+
+# q47: monthly brand sales vs yearly average, with the neighbouring
+# months joined through rank self-joins
+QUERIES[47] = """
+WITH v1 AS (
+  SELECT i_category, i_brand, s_store_name, d_year, d_moy,
+         sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) OVER (
+           PARTITION BY i_category, i_brand, s_store_name,
+                        d_year) avg_monthly_sales,
+         rank() OVER (
+           PARTITION BY i_category, i_brand, s_store_name
+           ORDER BY d_year, d_moy) rn
+  FROM item, store_sales, date_dim, store
+  WHERE ss_item_sk = i_item_sk
+    AND ss_sold_date_sk = d_date_sk
+    AND ss_store_sk = s_store_sk
+    AND (d_year = 2000
+         OR (d_year = 1999 AND d_moy = 12)
+         OR (d_year = 2001 AND d_moy = 1))
+  GROUP BY i_category, i_brand, s_store_name, d_year,
+           d_moy),
+ v2 AS (
+  SELECT v1.i_category, v1.i_brand, v1.s_store_name,
+         v1.d_year, v1.d_moy, v1.avg_monthly_sales, v1.sum_sales,
+         v1_lag.sum_sales psum, v1_lead.sum_sales nsum
+  FROM v1, v1 v1_lag, v1 v1_lead
+  WHERE v1.i_category = v1_lag.i_category
+    AND v1.i_category = v1_lead.i_category
+    AND v1.i_brand = v1_lag.i_brand
+    AND v1.i_brand = v1_lead.i_brand
+    AND v1.s_store_name = v1_lag.s_store_name
+    AND v1.s_store_name = v1_lead.s_store_name
+    AND v1.rn = v1_lag.rn + 1
+    AND v1.rn = v1_lead.rn - 1)
+SELECT v2.i_category, v2.i_brand, v2.d_year, v2.d_moy, v2.avg_monthly_sales,
+       v2.sum_sales, v2.psum, v2.nsum
+FROM v2
+WHERE v2.d_year = 2000
+  AND v2.avg_monthly_sales > 0
+  AND CASE WHEN v2.avg_monthly_sales > 0
+           THEN abs(v2.sum_sales - v2.avg_monthly_sales)
+                / v2.avg_monthly_sales
+           ELSE NULL END > 0.1
+ORDER BY v2.sum_sales - v2.avg_monthly_sales, v2.i_category, v2.i_brand,
+         v2.d_year, v2.d_moy
+LIMIT 100
+"""
+
+# q49: worst return ratios per channel, rank()-windowed, unioned
+QUERIES[49] = """
+SELECT channel, item, return_ratio, return_rank, currency_rank
+FROM (SELECT 'web' channel, web.item, web.return_ratio,
+             web.return_rank, web.currency_rank
+      FROM (SELECT item, return_ratio, currency_ratio,
+                   rank() OVER (ORDER BY return_ratio) return_rank,
+                   rank() OVER (ORDER BY currency_ratio) currency_rank
+            FROM (SELECT ws.ws_item_sk item,
+                         cast(sum(coalesce(wr.wr_return_quantity, 0))
+                              AS decimal(15,4)) /
+                         cast(sum(coalesce(ws.ws_quantity, 0))
+                              AS decimal(15,4)) return_ratio,
+                         cast(sum(coalesce(wr.wr_return_amt, 0))
+                              AS decimal(15,4)) /
+                         cast(sum(coalesce(ws.ws_net_paid, 0))
+                              AS decimal(15,4)) currency_ratio
+                  FROM web_sales ws
+                  LEFT JOIN web_returns wr
+                    ON ws.ws_order_number = wr.wr_order_number
+                   AND ws.ws_item_sk = wr.wr_item_sk,
+                       date_dim
+                  WHERE wr.wr_return_amt > 100
+                    AND ws.ws_net_profit > 1
+                    AND ws.ws_net_paid > 0
+                    AND ws.ws_quantity > 0
+                    AND ws_sold_date_sk = d_date_sk
+                    AND d_year = 2001 AND d_moy = 12
+                  GROUP BY ws.ws_item_sk) in_web) web
+      WHERE web.return_rank <= 10 OR web.currency_rank <= 10
+      UNION
+      SELECT 'catalog' channel, catalog.item, catalog.return_ratio,
+             catalog.return_rank, catalog.currency_rank
+      FROM (SELECT item, return_ratio, currency_ratio,
+                   rank() OVER (ORDER BY return_ratio) return_rank,
+                   rank() OVER (ORDER BY currency_ratio) currency_rank
+            FROM (SELECT cs.cs_item_sk item,
+                         cast(sum(coalesce(cr.cr_return_quantity, 0))
+                              AS decimal(15,4)) /
+                         cast(sum(coalesce(cs.cs_quantity, 0))
+                              AS decimal(15,4)) return_ratio,
+                         cast(sum(coalesce(cr.cr_return_amount, 0))
+                              AS decimal(15,4)) /
+                         cast(sum(coalesce(cs.cs_net_paid, 0))
+                              AS decimal(15,4)) currency_ratio
+                  FROM catalog_sales cs
+                  LEFT JOIN catalog_returns cr
+                    ON cs.cs_order_number = cr.cr_order_number
+                   AND cs.cs_item_sk = cr.cr_item_sk,
+                       date_dim
+                  WHERE cr.cr_return_amount > 100
+                    AND cs.cs_net_profit > 1
+                    AND cs.cs_net_paid > 0
+                    AND cs.cs_quantity > 0
+                    AND cs_sold_date_sk = d_date_sk
+                    AND d_year = 2001 AND d_moy = 12
+                  GROUP BY cs.cs_item_sk) in_cat) catalog
+      WHERE catalog.return_rank <= 10 OR catalog.currency_rank <= 10
+      UNION
+      SELECT 'store' channel, store.item, store.return_ratio,
+             store.return_rank, store.currency_rank
+      FROM (SELECT item, return_ratio, currency_ratio,
+                   rank() OVER (ORDER BY return_ratio) return_rank,
+                   rank() OVER (ORDER BY currency_ratio) currency_rank
+            FROM (SELECT sts.ss_item_sk item,
+                         cast(sum(coalesce(sr.sr_return_quantity, 0))
+                              AS decimal(15,4)) /
+                         cast(sum(coalesce(sts.ss_quantity, 0))
+                              AS decimal(15,4)) return_ratio,
+                         cast(sum(coalesce(sr.sr_return_amt, 0))
+                              AS decimal(15,4)) /
+                         cast(sum(coalesce(sts.ss_net_paid, 0))
+                              AS decimal(15,4)) currency_ratio
+                  FROM store_sales sts
+                  LEFT JOIN store_returns sr
+                    ON sts.ss_ticket_number = sr.sr_ticket_number
+                   AND sts.ss_item_sk = sr.sr_item_sk,
+                       date_dim
+                  WHERE sr.sr_return_amt > 100
+                    AND sts.ss_net_profit > 1
+                    AND sts.ss_net_paid > 0
+                    AND sts.ss_quantity > 0
+                    AND ss_sold_date_sk = d_date_sk
+                    AND d_year = 2001 AND d_moy = 12
+                  GROUP BY sts.ss_item_sk) in_store) store
+      WHERE store.return_rank <= 10 OR store.currency_rank <= 10) x
+ORDER BY 1, 4, 5, 2
+LIMIT 100
+"""
+
+# q51: cumulative web vs store sales crossover (FULL OUTER JOIN of two
+# running-window aggregates)
+QUERIES[51] = """
+WITH web_v1 AS (
+  SELECT ws_item_sk item_sk, d_date,
+         sum(sum(ws_sales_price)) OVER (
+           PARTITION BY ws_item_sk ORDER BY d_date
+           ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) cume_sales
+  FROM web_sales, date_dim
+  WHERE ws_sold_date_sk = d_date_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+    AND ws_item_sk IS NOT NULL
+  GROUP BY ws_item_sk, d_date),
+ store_v1 AS (
+  SELECT ss_item_sk item_sk, d_date,
+         sum(sum(ss_sales_price)) OVER (
+           PARTITION BY ss_item_sk ORDER BY d_date
+           ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) cume_sales
+  FROM store_sales, date_dim
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+    AND ss_item_sk IS NOT NULL
+  GROUP BY ss_item_sk, d_date)
+SELECT *
+FROM (SELECT item_sk, d_date, web_sales, store_sales,
+             max(web_sales) OVER (
+               PARTITION BY item_sk ORDER BY d_date
+               ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)
+               web_cumulative,
+             max(store_sales) OVER (
+               PARTITION BY item_sk ORDER BY d_date
+               ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)
+               store_cumulative
+      FROM (SELECT CASE WHEN web.item_sk IS NOT NULL
+                        THEN web.item_sk ELSE store.item_sk END item_sk,
+                   CASE WHEN web.d_date IS NOT NULL
+                        THEN web.d_date ELSE store.d_date END d_date,
+                   web.cume_sales web_sales,
+                   store.cume_sales store_sales
+            FROM web_v1 web
+            FULL OUTER JOIN store_v1 store
+              ON web.item_sk = store.item_sk
+             AND web.d_date = store.d_date) x) y
+WHERE web_cumulative > store_cumulative
+ORDER BY item_sk, d_date
+LIMIT 100
+"""
+
+# q54: revenue segments of cross-channel customers buying from stores in
+# the following quarter
+QUERIES[54] = """
+WITH my_customers AS (
+  SELECT DISTINCT c_customer_sk, c_current_addr_sk
+  FROM (SELECT cs_sold_date_sk sold_date_sk,
+               cs_bill_customer_sk customer_sk, cs_item_sk item_sk
+        FROM catalog_sales
+        UNION ALL
+        SELECT ws_sold_date_sk sold_date_sk,
+               ws_bill_customer_sk customer_sk, ws_item_sk item_sk
+        FROM web_sales) cs_or_ws_sales, item, date_dim, customer
+  WHERE sold_date_sk = d_date_sk
+    AND item_sk = i_item_sk
+    AND i_category = 'Women'
+    AND i_class = 'maternity'
+    AND c_customer_sk = cs_or_ws_sales.customer_sk
+    AND d_moy = 12 AND d_year = 1998),
+ my_revenue AS (
+  SELECT c_customer_sk, sum(ss_ext_sales_price) revenue
+  FROM my_customers, store_sales, customer_address, store, date_dim
+  WHERE c_current_addr_sk = ca_address_sk
+    AND ca_county = s_county AND ca_state = s_state
+    AND ss_customer_sk = c_customer_sk
+    AND ss_sold_date_sk = d_date_sk
+    AND ss_store_sk = s_store_sk
+    AND d_month_seq BETWEEN
+        (SELECT DISTINCT d_month_seq + 1 FROM date_dim
+         WHERE d_year = 1998 AND d_moy = 12)
+        AND
+        (SELECT DISTINCT d_month_seq + 3 FROM date_dim
+         WHERE d_year = 1998 AND d_moy = 12)
+  GROUP BY c_customer_sk),
+ segments AS (
+  SELECT cast((revenue / 50) AS bigint) segment FROM my_revenue)
+SELECT segment, count(*) num_customers, segment * 50 segment_base
+FROM segments
+GROUP BY segment
+ORDER BY segment, num_customers
+LIMIT 100
+"""
+
+# q57: like q47 for the catalog channel (call centers)
+QUERIES[57] = """
+WITH v1 AS (
+  SELECT i_category, i_brand, cc_name, d_year, d_moy,
+         sum(cs_sales_price) sum_sales,
+         avg(sum(cs_sales_price)) OVER (
+           PARTITION BY i_category, i_brand, cc_name, d_year)
+           avg_monthly_sales,
+         rank() OVER (
+           PARTITION BY i_category, i_brand, cc_name
+           ORDER BY d_year, d_moy) rn
+  FROM item, catalog_sales, date_dim, call_center
+  WHERE cs_item_sk = i_item_sk
+    AND cs_sold_date_sk = d_date_sk
+    AND cc_call_center_sk = cs_call_center_sk
+    AND (d_year = 2000
+         OR (d_year = 1999 AND d_moy = 12)
+         OR (d_year = 2001 AND d_moy = 1))
+  GROUP BY i_category, i_brand, cc_name, d_year, d_moy),
+ v2 AS (
+  SELECT v1.i_category, v1.i_brand, v1.cc_name, v1.d_year, v1.d_moy,
+         v1.avg_monthly_sales, v1.sum_sales, v1_lag.sum_sales psum,
+         v1_lead.sum_sales nsum
+  FROM v1, v1 v1_lag, v1 v1_lead
+  WHERE v1.i_category = v1_lag.i_category
+    AND v1.i_category = v1_lead.i_category
+    AND v1.i_brand = v1_lag.i_brand
+    AND v1.i_brand = v1_lead.i_brand
+    AND v1.cc_name = v1_lag.cc_name
+    AND v1.cc_name = v1_lead.cc_name
+    AND v1.rn = v1_lag.rn + 1
+    AND v1.rn = v1_lead.rn - 1)
+SELECT v2.i_category, v2.i_brand, v2.d_year, v2.d_moy,
+       v2.avg_monthly_sales, v2.sum_sales, v2.psum, v2.nsum
+FROM v2
+WHERE v2.d_year = 2000
+  AND v2.avg_monthly_sales > 0
+  AND CASE WHEN v2.avg_monthly_sales > 0
+           THEN abs(v2.sum_sales - v2.avg_monthly_sales)
+                / v2.avg_monthly_sales
+           ELSE NULL END > 0.1
+ORDER BY v2.sum_sales - v2.avg_monthly_sales, v2.i_category, v2.i_brand,
+         v2.d_year, v2.d_moy
+LIMIT 100
+"""
+
+# q58: items selling comparably across all 3 channels in one week
+QUERIES[58] = """
+WITH ss_items AS (
+  SELECT i_item_id item_id, sum(ss_ext_sales_price) ss_item_rev
+  FROM store_sales, item, date_dim
+  WHERE ss_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq = (SELECT d_week_seq FROM date_dim
+                                       WHERE d_date = DATE '2000-01-03'))
+    AND ss_sold_date_sk = d_date_sk
+  GROUP BY i_item_id),
+ cs_items AS (
+  SELECT i_item_id item_id, sum(cs_ext_sales_price) cs_item_rev
+  FROM catalog_sales, item, date_dim
+  WHERE cs_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq = (SELECT d_week_seq FROM date_dim
+                                       WHERE d_date = DATE '2000-01-03'))
+    AND cs_sold_date_sk = d_date_sk
+  GROUP BY i_item_id),
+ ws_items AS (
+  SELECT i_item_id item_id, sum(ws_ext_sales_price) ws_item_rev
+  FROM web_sales, item, date_dim
+  WHERE ws_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq = (SELECT d_week_seq FROM date_dim
+                                       WHERE d_date = DATE '2000-01-03'))
+    AND ws_sold_date_sk = d_date_sk
+  GROUP BY i_item_id)
+SELECT ss_items.item_id, ss_item_rev,
+       ss_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3)
+         * 100 ss_dev,
+       cs_item_rev,
+       cs_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3)
+         * 100 cs_dev,
+       ws_item_rev,
+       ws_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3)
+         * 100 ws_dev,
+       (ss_item_rev + cs_item_rev + ws_item_rev) / 3 average
+FROM ss_items, cs_items, ws_items
+WHERE ss_items.item_id = cs_items.item_id
+  AND ss_items.item_id = ws_items.item_id
+  AND ss_item_rev BETWEEN 0.9 * cs_item_rev AND 1.1 * cs_item_rev
+  AND ss_item_rev BETWEEN 0.9 * ws_item_rev AND 1.1 * ws_item_rev
+  AND cs_item_rev BETWEEN 0.9 * ss_item_rev AND 1.1 * ss_item_rev
+  AND cs_item_rev BETWEEN 0.9 * ws_item_rev AND 1.1 * ws_item_rev
+  AND ws_item_rev BETWEEN 0.9 * ss_item_rev AND 1.1 * ss_item_rev
+  AND ws_item_rev BETWEEN 0.9 * cs_item_rev AND 1.1 * cs_item_rev
+ORDER BY ss_items.item_id, ss_item_rev
+LIMIT 100
+"""
+
+# q59: week-over-week store sales by day of week (year vs year+1)
+QUERIES[59] = """
+WITH wss AS (
+  SELECT d_week_seq, ss_store_sk,
+         sum(CASE WHEN d_day_name = 'Sunday'
+                  THEN ss_sales_price ELSE NULL END) sun_sales,
+         sum(CASE WHEN d_day_name = 'Monday'
+                  THEN ss_sales_price ELSE NULL END) mon_sales,
+         sum(CASE WHEN d_day_name = 'Tuesday'
+                  THEN ss_sales_price ELSE NULL END) tue_sales,
+         sum(CASE WHEN d_day_name = 'Wednesday'
+                  THEN ss_sales_price ELSE NULL END) wed_sales,
+         sum(CASE WHEN d_day_name = 'Thursday'
+                  THEN ss_sales_price ELSE NULL END) thu_sales,
+         sum(CASE WHEN d_day_name = 'Friday'
+                  THEN ss_sales_price ELSE NULL END) fri_sales,
+         sum(CASE WHEN d_day_name = 'Saturday'
+                  THEN ss_sales_price ELSE NULL END) sat_sales
+  FROM store_sales, date_dim
+  WHERE d_date_sk = ss_sold_date_sk
+  GROUP BY d_week_seq, ss_store_sk)
+SELECT y.s_store_name1, y.s_store_id1, y.d_week_seq1,
+       y.sun_sales1 / x.sun_sales2,
+       y.mon_sales1 / x.mon_sales2,
+       y.tue_sales1 / x.tue_sales2,
+       y.wed_sales1 / x.wed_sales2,
+       y.thu_sales1 / x.thu_sales2,
+       y.fri_sales1 / x.fri_sales2,
+       y.sat_sales1 / x.sat_sales2
+FROM (SELECT s_store_name s_store_name1, wss.d_week_seq d_week_seq1,
+             s_store_id s_store_id1, sun_sales sun_sales1,
+             mon_sales mon_sales1, tue_sales tue_sales1,
+             wed_sales wed_sales1, thu_sales thu_sales1,
+             fri_sales fri_sales1, sat_sales sat_sales1
+      FROM wss, store, date_dim d
+      WHERE d.d_week_seq = wss.d_week_seq
+        AND ss_store_sk = s_store_sk
+        AND d_month_seq BETWEEN 1185 AND 1196) y,
+     (SELECT s_store_name s_store_name2, wss.d_week_seq d_week_seq2,
+             s_store_id s_store_id2, sun_sales sun_sales2,
+             mon_sales mon_sales2, tue_sales tue_sales2,
+             wed_sales wed_sales2, thu_sales thu_sales2,
+             fri_sales fri_sales2, sat_sales sat_sales2
+      FROM wss, store, date_dim d
+      WHERE d.d_week_seq = wss.d_week_seq
+        AND ss_store_sk = s_store_sk
+        AND d_month_seq BETWEEN 1197 AND 1208) x
+WHERE y.s_store_id1 = x.s_store_id2
+  AND y.d_week_seq1 = x.d_week_seq2 - 52
+ORDER BY y.s_store_name1, y.s_store_id1, y.d_week_seq1
+LIMIT 100
+"""
+
+# q64: items sold twice (store then again) across demographic transitions
+# (adapted: the generator has no c_first_sales_date_sk/c_first_shipto_
+# date_sk, so the d2/d3 date roles are dropped; income bands join through
+# hd as in spec)
+QUERIES[64] = """
+WITH cs_ui AS (
+  SELECT cs_item_sk,
+         sum(cs_ext_list_price) sale,
+         sum(cr_refunded_cash + cr_net_loss) refund
+  FROM catalog_sales, catalog_returns
+  WHERE cs_item_sk = cr_item_sk
+    AND cs_order_number = cr_order_number
+  GROUP BY cs_item_sk
+  HAVING sum(cs_ext_list_price) >
+         2 * sum(cr_refunded_cash + cr_net_loss)),
+ cross_sales AS (
+  SELECT i_product_name product_name, i_item_sk item_sk,
+         s_store_name store_name, s_zip store_zip,
+         ad1.ca_city b_city, ad1.ca_zip b_zip,
+         ad2.ca_city c_city, ad2.ca_zip c_zip,
+         d1.d_year syear,
+         count(*) cnt,
+         sum(ss_wholesale_cost) s1, sum(ss_list_price) s2,
+         sum(ss_coupon_amt) s3
+  FROM store_sales, store_returns, cs_ui, date_dim d1, store, customer,
+       customer_demographics cd1, customer_demographics cd2,
+       household_demographics hd1, household_demographics hd2,
+       customer_address ad1, customer_address ad2, income_band ib1,
+       income_band ib2, item
+  WHERE ss_store_sk = s_store_sk
+    AND ss_sold_date_sk = d1.d_date_sk
+    AND ss_customer_sk = c_customer_sk
+    AND ss_cdemo_sk = cd1.cd_demo_sk
+    AND ss_hdemo_sk = hd1.hd_demo_sk
+    AND ss_addr_sk = ad1.ca_address_sk
+    AND ss_item_sk = i_item_sk
+    AND ss_item_sk = sr_item_sk
+    AND ss_ticket_number = sr_ticket_number
+    AND ss_item_sk = cs_ui.cs_item_sk
+    AND c_current_cdemo_sk = cd2.cd_demo_sk
+    AND c_current_hdemo_sk = hd2.hd_demo_sk
+    AND c_current_addr_sk = ad2.ca_address_sk
+    AND hd1.hd_income_band_sk = ib1.ib_income_band_sk
+    AND hd2.hd_income_band_sk = ib2.ib_income_band_sk
+    AND cd1.cd_marital_status <> cd2.cd_marital_status
+    AND i_color IN ('purple', 'burlywood', 'indian', 'spring',
+                    'floral', 'medium')
+    AND i_current_price BETWEEN 64 AND 74
+  GROUP BY i_product_name, i_item_sk, s_store_name, s_zip, ad1.ca_city,
+           ad1.ca_zip, ad2.ca_city, ad2.ca_zip, d1.d_year)
+SELECT cs1.product_name, cs1.store_name, cs1.store_zip, cs1.b_city,
+       cs1.b_zip, cs1.c_city, cs1.c_zip, cs1.syear, cs1.cnt, cs1.s1,
+       cs1.s2, cs1.s3, cs2.s1 s1_2, cs2.s2 s2_2, cs2.s3 s3_2, cs2.syear
+         syear_2, cs2.cnt cnt_2
+FROM cross_sales cs1, cross_sales cs2
+WHERE cs1.item_sk = cs2.item_sk
+  AND cs1.syear = 1999
+  AND cs2.syear = 2000
+  AND cs2.cnt <= cs1.cnt
+  AND cs1.store_name = cs2.store_name
+  AND cs1.store_zip = cs2.store_zip
+ORDER BY cs1.product_name, cs1.store_name, cnt_2, cs1.s1, s1_2
+"""
+
+# q66: warehouse shipping pivot by month (adapted: catalog_sales has no
+# sold-time column in the generator, so the time_dim filter applies to
+# the web channel only; the catalog branch filters by ship mode + year)
+QUERIES[66] = """
+SELECT w_warehouse_name, w_warehouse_sq_ft, w_state, ship_carriers, year1,
+       sum(jan_sales) jan_sales, sum(feb_sales) feb_sales,
+       sum(mar_sales) mar_sales, sum(apr_sales) apr_sales,
+       sum(may_sales) may_sales, sum(jun_sales) jun_sales,
+       sum(jul_sales) jul_sales, sum(aug_sales) aug_sales,
+       sum(sep_sales) sep_sales, sum(oct_sales) oct_sales,
+       sum(nov_sales) nov_sales, sum(dec_sales) dec_sales,
+       sum(jan_net) jan_net, sum(feb_net) feb_net, sum(mar_net) mar_net
+FROM (SELECT w_warehouse_name, w_warehouse_sq_ft, w_state,
+             'DHL,BARIAN' ship_carriers, d_year year1,
+             sum(CASE WHEN d_moy = 1
+                      THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) jan_sales,
+             sum(CASE WHEN d_moy = 2
+                      THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) feb_sales,
+             sum(CASE WHEN d_moy = 3
+                      THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) mar_sales,
+             sum(CASE WHEN d_moy = 4
+                      THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) apr_sales,
+             sum(CASE WHEN d_moy = 5
+                      THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) may_sales,
+             sum(CASE WHEN d_moy = 6
+                      THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) jun_sales,
+             sum(CASE WHEN d_moy = 7
+                      THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) jul_sales,
+             sum(CASE WHEN d_moy = 8
+                      THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) aug_sales,
+             sum(CASE WHEN d_moy = 9
+                      THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) sep_sales,
+             sum(CASE WHEN d_moy = 10
+                      THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) oct_sales,
+             sum(CASE WHEN d_moy = 11
+                      THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) nov_sales,
+             sum(CASE WHEN d_moy = 12
+                      THEN ws_ext_sales_price * ws_quantity
+                      ELSE 0 END) dec_sales,
+             sum(CASE WHEN d_moy = 1
+                      THEN ws_net_paid * ws_quantity ELSE 0 END) jan_net,
+             sum(CASE WHEN d_moy = 2
+                      THEN ws_net_paid * ws_quantity ELSE 0 END) feb_net,
+             sum(CASE WHEN d_moy = 3
+                      THEN ws_net_paid * ws_quantity ELSE 0 END) mar_net
+      FROM web_sales, warehouse, date_dim, time_dim, ship_mode
+      WHERE ws_warehouse_sk = w_warehouse_sk
+        AND ws_sold_date_sk = d_date_sk
+        AND ws_sold_time_sk = t_time_sk
+        AND ws_ship_mode_sk = sm_ship_mode_sk
+        AND d_year = 2001
+        AND t_hour BETWEEN 8 AND 17
+        AND sm_carrier IN ('DHL', 'BARIAN')
+      GROUP BY w_warehouse_name, w_warehouse_sq_ft, w_state, d_year
+      UNION ALL
+      SELECT w_warehouse_name, w_warehouse_sq_ft, w_state,
+             'DHL,BARIAN' ship_carriers, d_year year1,
+             sum(CASE WHEN d_moy = 1
+                      THEN cs_sales_price * cs_quantity
+                      ELSE 0 END) jan_sales,
+             sum(CASE WHEN d_moy = 2
+                      THEN cs_sales_price * cs_quantity
+                      ELSE 0 END) feb_sales,
+             sum(CASE WHEN d_moy = 3
+                      THEN cs_sales_price * cs_quantity
+                      ELSE 0 END) mar_sales,
+             sum(CASE WHEN d_moy = 4
+                      THEN cs_sales_price * cs_quantity
+                      ELSE 0 END) apr_sales,
+             sum(CASE WHEN d_moy = 5
+                      THEN cs_sales_price * cs_quantity
+                      ELSE 0 END) may_sales,
+             sum(CASE WHEN d_moy = 6
+                      THEN cs_sales_price * cs_quantity
+                      ELSE 0 END) jun_sales,
+             sum(CASE WHEN d_moy = 7
+                      THEN cs_sales_price * cs_quantity
+                      ELSE 0 END) jul_sales,
+             sum(CASE WHEN d_moy = 8
+                      THEN cs_sales_price * cs_quantity
+                      ELSE 0 END) aug_sales,
+             sum(CASE WHEN d_moy = 9
+                      THEN cs_sales_price * cs_quantity
+                      ELSE 0 END) sep_sales,
+             sum(CASE WHEN d_moy = 10
+                      THEN cs_sales_price * cs_quantity
+                      ELSE 0 END) oct_sales,
+             sum(CASE WHEN d_moy = 11
+                      THEN cs_sales_price * cs_quantity
+                      ELSE 0 END) nov_sales,
+             sum(CASE WHEN d_moy = 12
+                      THEN cs_sales_price * cs_quantity
+                      ELSE 0 END) dec_sales,
+             sum(CASE WHEN d_moy = 1
+                      THEN cs_net_paid_inc_tax * cs_quantity
+                      ELSE 0 END) jan_net,
+             sum(CASE WHEN d_moy = 2
+                      THEN cs_net_paid_inc_tax * cs_quantity
+                      ELSE 0 END) feb_net,
+             sum(CASE WHEN d_moy = 3
+                      THEN cs_net_paid_inc_tax * cs_quantity
+                      ELSE 0 END) mar_net
+      FROM catalog_sales, warehouse, date_dim, ship_mode
+      WHERE cs_warehouse_sk = w_warehouse_sk
+        AND cs_sold_date_sk = d_date_sk
+        AND cs_ship_mode_sk = sm_ship_mode_sk
+        AND d_year = 2001
+        AND sm_carrier IN ('DHL', 'BARIAN')
+      GROUP BY w_warehouse_name, w_warehouse_sq_ft, w_state, d_year) x
+GROUP BY w_warehouse_name, w_warehouse_sq_ft, w_state, ship_carriers,
+         year1
+ORDER BY w_warehouse_name
+LIMIT 100
+"""
+
+# q67: 8-level ROLLUP with per-category rank
+QUERIES[67] = """
+SELECT *
+FROM (SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+             d_moy, s_store_id, sumsales,
+             rank() OVER (PARTITION BY i_category
+                          ORDER BY sumsales DESC) rk
+      FROM (SELECT i_category, i_class, i_brand, i_product_name, d_year,
+                   d_qoy, d_moy, s_store_id,
+                   sum(coalesce(ss_sales_price * ss_quantity, 0)) sumsales
+            FROM store_sales, date_dim, store, item
+            WHERE ss_sold_date_sk = d_date_sk
+              AND ss_item_sk = i_item_sk
+              AND ss_store_sk = s_store_sk
+              AND d_month_seq BETWEEN 1200 AND 1211
+            GROUP BY ROLLUP (i_category, i_class, i_brand,
+                             i_product_name, d_year, d_qoy, d_moy,
+                             s_store_id)) dw1) dw2
+WHERE rk <= 100
+ORDER BY i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_id, sumsales, rk
+LIMIT 100
+"""
+
+# q70: profitable states/counties with grouping()-ranked hierarchy and a
+# windowed top-5-state subquery
+QUERIES[70] = """
+SELECT sum(ss_net_profit) total_sum, s_state, s_county,
+       grouping(s_state) + grouping(s_county) lochierarchy,
+       rank() OVER (
+         PARTITION BY grouping(s_state) + grouping(s_county),
+                      CASE WHEN grouping(s_county) = 0
+                           THEN s_state END
+         ORDER BY sum(ss_net_profit) DESC) rank_within_parent
+FROM store_sales, date_dim d1, store
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ss_sold_date_sk
+  AND s_store_sk = ss_store_sk
+  AND s_state IN
+      (SELECT s_state
+       FROM (SELECT s_state s_state,
+                    rank() OVER (PARTITION BY s_state
+                                 ORDER BY sum(ss_net_profit) DESC)
+                      ranking
+             FROM store_sales, store, date_dim
+             WHERE d_month_seq BETWEEN 1200 AND 1211
+               AND d_date_sk = ss_sold_date_sk
+               AND s_store_sk = ss_store_sk
+             GROUP BY s_state) tmp1
+       WHERE ranking <= 5)
+GROUP BY ROLLUP (s_state, s_county)
+ORDER BY lochierarchy DESC,
+         CASE WHEN grouping(s_state) + grouping(s_county) = 0
+              THEN s_state END,
+         rank_within_parent
+LIMIT 100
+"""
+
+# q71: brand revenue by hour across channels (adapted: catalog_sales has
+# no sold-time column in the generator, so the union covers the web and
+# store channels)
+QUERIES[71] = """
+SELECT i_brand_id brand_id, i_brand brand, t_hour, t_minute,
+       sum(ext_price) ext_price
+FROM item,
+     (SELECT ws_ext_sales_price ext_price, ws_sold_date_sk sold_date_sk,
+             ws_item_sk sold_item_sk, ws_sold_time_sk time_sk
+      FROM web_sales, date_dim
+      WHERE d_date_sk = ws_sold_date_sk AND d_moy = 11 AND d_year = 1999
+      UNION ALL
+      SELECT ss_ext_sales_price ext_price, ss_sold_date_sk sold_date_sk,
+             ss_item_sk sold_item_sk, ss_sold_time_sk time_sk
+      FROM store_sales, date_dim
+      WHERE d_date_sk = ss_sold_date_sk AND d_moy = 11 AND d_year = 1999)
+     tmp, time_dim
+WHERE sold_item_sk = i_item_sk
+  AND i_manager_id = 1
+  AND time_sk = t_time_sk
+  AND (t_hour IN (8, 9, 19, 20))
+GROUP BY i_brand, i_brand_id, t_hour, t_minute
+ORDER BY ext_price DESC, i_brand_id, t_hour, t_minute
+"""
+
+# q72: the deep join tree — catalog sales vs inventory with promotions and
+# returns (BASELINE config 5's query shape)
+QUERIES[72] = """
+SELECT i_item_desc, w_warehouse_name, d1.d_week_seq,
+       sum(CASE WHEN p_promo_sk IS NULL THEN 1 ELSE 0 END) no_promo,
+       sum(CASE WHEN p_promo_sk IS NOT NULL THEN 1 ELSE 0 END) promo,
+       count(*) total_cnt
+FROM catalog_sales
+JOIN inventory ON (cs_item_sk = inv_item_sk)
+JOIN warehouse ON (w_warehouse_sk = inv_warehouse_sk)
+JOIN item ON (i_item_sk = cs_item_sk)
+JOIN customer_demographics ON (cs_bill_cdemo_sk = cd_demo_sk)
+JOIN household_demographics ON (cs_bill_hdemo_sk = hd_demo_sk)
+JOIN date_dim d1 ON (cs_sold_date_sk = d1.d_date_sk)
+JOIN date_dim d2 ON (inv_date_sk = d2.d_date_sk)
+JOIN date_dim d3 ON (cs_ship_date_sk = d3.d_date_sk)
+LEFT JOIN promotion ON (cs_promo_sk = p_promo_sk)
+LEFT JOIN catalog_returns ON (cr_item_sk = cs_item_sk
+                              AND cr_order_number = cs_order_number)
+WHERE d1.d_week_seq = d2.d_week_seq
+  AND inv_quantity_on_hand < cs_quantity
+  AND d3.d_date > d1.d_date + INTERVAL '5' DAY
+  AND hd_buy_potential = '>10000'
+  AND d1.d_year = 1999
+  AND cd_marital_status = 'D'
+GROUP BY i_item_desc, w_warehouse_name, d1.d_week_seq
+ORDER BY total_cnt DESC, i_item_desc, w_warehouse_name, d1.d_week_seq
+LIMIT 100
+"""
+
+# q75: year-over-year sales quantity decline by brand/class/category
+QUERIES[75] = """
+WITH all_sales AS (
+  SELECT d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id,
+         sum(sales_cnt) sales_cnt, sum(sales_amt) sales_amt
+  FROM (SELECT d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id,
+               cs_quantity - coalesce(cr_return_quantity, 0) sales_cnt,
+               cs_ext_sales_price
+                 - coalesce(cr_return_amount, 0.0) sales_amt
+        FROM catalog_sales
+        JOIN item ON i_item_sk = cs_item_sk
+        JOIN date_dim ON d_date_sk = cs_sold_date_sk
+        LEFT JOIN catalog_returns
+          ON cs_order_number = cr_order_number
+         AND cs_item_sk = cr_item_sk
+        WHERE i_category = 'Books'
+        UNION
+        SELECT d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id,
+               ss_quantity - coalesce(sr_return_quantity, 0) sales_cnt,
+               ss_ext_sales_price
+                 - coalesce(sr_return_amt, 0.0) sales_amt
+        FROM store_sales
+        JOIN item ON i_item_sk = ss_item_sk
+        JOIN date_dim ON d_date_sk = ss_sold_date_sk
+        LEFT JOIN store_returns
+          ON ss_ticket_number = sr_ticket_number
+         AND ss_item_sk = sr_item_sk
+        WHERE i_category = 'Books'
+        UNION
+        SELECT d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id,
+               ws_quantity - coalesce(wr_return_quantity, 0) sales_cnt,
+               ws_ext_sales_price
+                 - coalesce(wr_return_amt, 0.0) sales_amt
+        FROM web_sales
+        JOIN item ON i_item_sk = ws_item_sk
+        JOIN date_dim ON d_date_sk = ws_sold_date_sk
+        LEFT JOIN web_returns
+          ON ws_order_number = wr_order_number
+         AND ws_item_sk = wr_item_sk
+        WHERE i_category = 'Books') sales_detail
+  GROUP BY d_year, i_brand_id, i_class_id, i_category_id, i_manufact_id)
+SELECT prev_yr.d_year prev_year, curr_yr.d_year year1,
+       curr_yr.i_brand_id, curr_yr.i_class_id, curr_yr.i_category_id,
+       curr_yr.i_manufact_id, prev_yr.sales_cnt prev_yr_cnt,
+       curr_yr.sales_cnt curr_yr_cnt,
+       curr_yr.sales_cnt - prev_yr.sales_cnt sales_cnt_diff,
+       curr_yr.sales_amt - prev_yr.sales_amt sales_amt_diff
+FROM all_sales curr_yr, all_sales prev_yr
+WHERE curr_yr.i_brand_id = prev_yr.i_brand_id
+  AND curr_yr.i_class_id = prev_yr.i_class_id
+  AND curr_yr.i_category_id = prev_yr.i_category_id
+  AND curr_yr.i_manufact_id = prev_yr.i_manufact_id
+  AND curr_yr.d_year = 2002
+  AND prev_yr.d_year = 2001
+  AND cast(curr_yr.sales_cnt AS decimal(17,2))
+      / cast(prev_yr.sales_cnt AS decimal(17,2)) < 0.9
+ORDER BY sales_cnt_diff, sales_amt_diff
+LIMIT 100
+"""
+
+# q77: per-channel sales/returns/profit with ROLLUP(channel, id)
+QUERIES[77] = """
+WITH ss AS (
+  SELECT s_store_sk, sum(ss_ext_sales_price) sales,
+         sum(ss_net_profit) profit
+  FROM store_sales, date_dim, store
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23'
+                   AND DATE '2000-08-23' + INTERVAL '30' DAY
+    AND ss_store_sk = s_store_sk
+  GROUP BY s_store_sk),
+ sr AS (
+  SELECT s_store_sk, sum(sr_return_amt) returns_amt,
+         sum(sr_net_loss) profit_loss
+  FROM store_returns, date_dim, store
+  WHERE sr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23'
+                   AND DATE '2000-08-23' + INTERVAL '30' DAY
+    AND sr_store_sk = s_store_sk
+  GROUP BY s_store_sk),
+ cs AS (
+  SELECT cs_call_center_sk, sum(cs_ext_sales_price) sales,
+         sum(cs_net_profit) profit
+  FROM catalog_sales, date_dim
+  WHERE cs_sold_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23'
+                   AND DATE '2000-08-23' + INTERVAL '30' DAY
+  GROUP BY cs_call_center_sk),
+ cr AS (
+  SELECT cr_call_center_sk, sum(cr_return_amount) returns_amt,
+         sum(cr_net_loss) profit_loss
+  FROM catalog_returns, date_dim
+  WHERE cr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23'
+                   AND DATE '2000-08-23' + INTERVAL '30' DAY
+  GROUP BY cr_call_center_sk),
+ ws AS (
+  SELECT wp_web_page_sk, sum(ws_ext_sales_price) sales,
+         sum(ws_net_profit) profit
+  FROM web_sales, date_dim, web_page
+  WHERE ws_sold_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23'
+                   AND DATE '2000-08-23' + INTERVAL '30' DAY
+    AND ws_web_page_sk = wp_web_page_sk
+  GROUP BY wp_web_page_sk),
+ wr AS (
+  SELECT wr_web_page_sk, sum(wr_return_amt) returns_amt,
+         sum(wr_net_loss) profit_loss
+  FROM web_returns, date_dim, web_page
+  WHERE wr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23'
+                   AND DATE '2000-08-23' + INTERVAL '30' DAY
+    AND wr_web_page_sk = wp_web_page_sk
+  GROUP BY wr_web_page_sk)
+SELECT channel, id, sum(sales) sales, sum(returns_amt) returns_amt,
+       sum(profit) profit
+FROM (SELECT 'store channel' channel, ss.s_store_sk id, sales,
+             coalesce(returns_amt, 0) returns_amt,
+             profit - coalesce(profit_loss, 0) profit
+      FROM ss
+      LEFT JOIN sr ON ss.s_store_sk = sr.s_store_sk
+      UNION ALL
+      SELECT 'catalog channel' channel, cs_call_center_sk id, sales,
+             coalesce(returns_amt, 0) returns_amt,
+             profit - coalesce(profit_loss, 0) profit
+      FROM cs
+      LEFT JOIN cr ON cs.cs_call_center_sk = cr.cr_call_center_sk
+      UNION ALL
+      SELECT 'web channel' channel, ws.wp_web_page_sk id, sales,
+             coalesce(returns_amt, 0) returns_amt,
+             profit - coalesce(profit_loss, 0) profit
+      FROM ws
+      LEFT JOIN wr ON ws.wp_web_page_sk = wr.wr_web_page_sk) x
+GROUP BY ROLLUP (channel, id)
+ORDER BY channel, id
+LIMIT 100
+"""
+
+# q78: customers buying through one channel only (returnless sales ratios)
+QUERIES[78] = """
+WITH ws AS (
+  SELECT d_year ws_sold_year, ws_item_sk,
+         ws_bill_customer_sk ws_customer_sk,
+         sum(ws_quantity) ws_qty, sum(ws_wholesale_cost) ws_wc,
+         sum(ws_sales_price) ws_sp
+  FROM web_sales
+  LEFT JOIN web_returns ON wr_order_number = ws_order_number
+                       AND ws_item_sk = wr_item_sk
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+  WHERE wr_order_number IS NULL
+  GROUP BY d_year, ws_item_sk, ws_bill_customer_sk),
+ cs AS (
+  SELECT d_year cs_sold_year, cs_item_sk,
+         cs_bill_customer_sk cs_customer_sk,
+         sum(cs_quantity) cs_qty, sum(cs_wholesale_cost) cs_wc,
+         sum(cs_sales_price) cs_sp
+  FROM catalog_sales
+  LEFT JOIN catalog_returns ON cr_order_number = cs_order_number
+                           AND cs_item_sk = cr_item_sk
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+  WHERE cr_order_number IS NULL
+  GROUP BY d_year, cs_item_sk, cs_bill_customer_sk),
+ ss AS (
+  SELECT d_year ss_sold_year, ss_item_sk,
+         ss_customer_sk,
+         sum(ss_quantity) ss_qty, sum(ss_wholesale_cost) ss_wc,
+         sum(ss_sales_price) ss_sp
+  FROM store_sales
+  LEFT JOIN store_returns ON sr_ticket_number = ss_ticket_number
+                         AND ss_item_sk = sr_item_sk
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+  WHERE sr_ticket_number IS NULL
+  GROUP BY d_year, ss_item_sk, ss_customer_sk)
+SELECT ss_sold_year, ss_item_sk, ss_customer_sk,
+       round(cast(ss_qty AS double) /
+             (coalesce(ws_qty, 0) + coalesce(cs_qty, 0) + 1), 2) ratio,
+       ss_qty store_qty, ss_wc store_wholesale_cost,
+       ss_sp store_sales_price,
+       coalesce(ws_qty, 0) + coalesce(cs_qty, 0) other_chan_qty,
+       coalesce(ws_wc, 0) + coalesce(cs_wc, 0)
+         other_chan_wholesale_cost,
+       coalesce(ws_sp, 0) + coalesce(cs_sp, 0) other_chan_sales_price
+FROM ss
+LEFT JOIN ws ON ws_sold_year = ss_sold_year
+            AND ws_item_sk = ss_item_sk
+            AND ws_customer_sk = ss_customer_sk
+LEFT JOIN cs ON cs_sold_year = ss_sold_year
+            AND cs_item_sk = ss_item_sk
+            AND cs_customer_sk = ss_customer_sk
+WHERE (coalesce(ws_qty, 0) > 0 OR coalesce(cs_qty, 0) > 0)
+  AND ss_sold_year = 2000
+ORDER BY ss_sold_year, ss_item_sk, ss_customer_sk, ss_qty DESC,
+         ss_wc DESC, ss_sp DESC, other_chan_qty,
+         other_chan_wholesale_cost, other_chan_sales_price, ratio
+LIMIT 100
+"""
+
+# q80: 30-day sales minus returns per channel, ROLLUP(channel, id)
+QUERIES[80] = """
+WITH ssr AS (
+  SELECT s_store_id,
+         sum(ss_ext_sales_price) sales,
+         sum(coalesce(sr_return_amt, 0)) returns_amt,
+         sum(ss_net_profit - coalesce(sr_net_loss, 0)) profit
+  FROM store_sales
+  LEFT JOIN store_returns ON ss_item_sk = sr_item_sk
+                         AND ss_ticket_number = sr_ticket_number,
+       date_dim, store, item, promotion
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23'
+                   AND DATE '2000-08-23' + INTERVAL '30' DAY
+    AND ss_store_sk = s_store_sk
+    AND ss_item_sk = i_item_sk
+    AND i_current_price > 50
+    AND ss_promo_sk = p_promo_sk
+    AND p_channel_tv = 'N'
+  GROUP BY s_store_id),
+ csr AS (
+  SELECT cp_catalog_page_id,
+         sum(cs_ext_sales_price) sales,
+         sum(coalesce(cr_return_amount, 0)) returns_amt,
+         sum(cs_net_profit - coalesce(cr_net_loss, 0)) profit
+  FROM catalog_sales
+  LEFT JOIN catalog_returns ON cs_item_sk = cr_item_sk
+                           AND cs_order_number = cr_order_number,
+       date_dim, catalog_page, item, promotion
+  WHERE cs_sold_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23'
+                   AND DATE '2000-08-23' + INTERVAL '30' DAY
+    AND cs_catalog_page_sk = cp_catalog_page_sk
+    AND cs_item_sk = i_item_sk
+    AND i_current_price > 50
+    AND cs_promo_sk = p_promo_sk
+    AND p_channel_tv = 'N'
+  GROUP BY cp_catalog_page_id),
+ wsr AS (
+  SELECT web_site_sk,
+         sum(ws_ext_sales_price) sales,
+         sum(coalesce(wr_return_amt, 0)) returns_amt,
+         sum(ws_net_profit - coalesce(wr_net_loss, 0)) profit
+  FROM web_sales
+  LEFT JOIN web_returns ON ws_item_sk = wr_item_sk
+                       AND ws_order_number = wr_order_number,
+       date_dim, web_site, item, promotion
+  WHERE ws_sold_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23'
+                   AND DATE '2000-08-23' + INTERVAL '30' DAY
+    AND ws_web_site_sk = web_site.web_site_sk
+    AND ws_item_sk = i_item_sk
+    AND i_current_price > 50
+    AND ws_promo_sk = p_promo_sk
+    AND p_channel_tv = 'N'
+  GROUP BY web_site.web_site_sk)
+SELECT channel, id, sum(sales) sales, sum(returns_amt) returns_amt,
+       sum(profit) profit
+FROM (SELECT 'store channel' channel, s_store_id id, sales, returns_amt,
+             profit
+      FROM ssr
+      UNION ALL
+      SELECT 'catalog channel' channel, cp_catalog_page_id id, sales,
+             returns_amt, profit
+      FROM csr
+      UNION ALL
+      SELECT 'web channel' channel, web_site_sk id, sales, returns_amt,
+             profit
+      FROM wsr) x
+GROUP BY ROLLUP (channel, id)
+ORDER BY channel, id
+LIMIT 100
+"""
+
+# q83: returned items compared across the three return channels for
+# matched weeks
+QUERIES[83] = """
+WITH sr_items AS (
+  SELECT i_item_id item_id, sum(sr_return_quantity) sr_item_qty
+  FROM store_returns, item, date_dim
+  WHERE sr_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq IN
+                         (SELECT d_week_seq FROM date_dim
+                          WHERE d_date IN (DATE '2000-06-30',
+                                           DATE '2000-09-27',
+                                           DATE '2000-11-17')))
+    AND sr_returned_date_sk = d_date_sk
+  GROUP BY i_item_id),
+ cr_items AS (
+  SELECT i_item_id item_id, sum(cr_return_quantity) cr_item_qty
+  FROM catalog_returns, item, date_dim
+  WHERE cr_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq IN
+                         (SELECT d_week_seq FROM date_dim
+                          WHERE d_date IN (DATE '2000-06-30',
+                                           DATE '2000-09-27',
+                                           DATE '2000-11-17')))
+    AND cr_returned_date_sk = d_date_sk
+  GROUP BY i_item_id),
+ wr_items AS (
+  SELECT i_item_id item_id, sum(wr_return_quantity) wr_item_qty
+  FROM web_returns, item, date_dim
+  WHERE wr_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq IN
+                         (SELECT d_week_seq FROM date_dim
+                          WHERE d_date IN (DATE '2000-06-30',
+                                           DATE '2000-09-27',
+                                           DATE '2000-11-17')))
+    AND wr_returned_date_sk = d_date_sk
+  GROUP BY i_item_id)
+SELECT sr_items.item_id, sr_item_qty,
+       sr_item_qty / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0
+         * 100 sr_dev,
+       cr_item_qty,
+       cr_item_qty / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0
+         * 100 cr_dev,
+       wr_item_qty,
+       wr_item_qty / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0
+         * 100 wr_dev,
+       (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 average
+FROM sr_items, cr_items, wr_items
+WHERE sr_items.item_id = cr_items.item_id
+  AND sr_items.item_id = wr_items.item_id
+ORDER BY sr_items.item_id, sr_item_qty
+LIMIT 100
+"""
+
+# q84: customers in a city within an income band, through returns
+# (adapted: store_returns has no sr_cdemo_sk in the generator; the
+# returns linkage goes through sr_customer_sk instead)
+QUERIES[84] = """
+SELECT c_customer_id customer_id,
+       coalesce(c_last_name, '') || ', ' || coalesce(c_first_name, '')
+         customername
+FROM customer, customer_address, customer_demographics,
+     household_demographics, income_band, store_returns
+WHERE ca_city = 'Edgewood'
+  AND c_current_addr_sk = ca_address_sk
+  AND ib_lower_bound >= 38128
+  AND ib_upper_bound <= 38128 + 50000
+  AND ib_income_band_sk = hd_income_band_sk
+  AND cd_demo_sk = c_current_cdemo_sk
+  AND hd_demo_sk = c_current_hdemo_sk
+  AND sr_customer_sk = c_customer_sk
+ORDER BY c_customer_id
+LIMIT 100
+"""
+
+# q85: web return reasons by demographic/geographic slices (adapted: the
+# generator's web_returns has no refunded-cdemo column; demographics
+# join through the refunded customer's current cdemo)
+QUERIES[85] = """
+SELECT substr(r_reason_desc, 1, 20),
+       avg(ws_quantity), avg(wr_refunded_cash), avg(wr_net_loss)
+FROM web_sales, web_returns, web_page, customer_demographics cd1,
+     customer, customer_address, date_dim, reason
+WHERE ws_web_page_sk = wp_web_page_sk
+  AND ws_item_sk = wr_item_sk
+  AND ws_order_number = wr_order_number
+  AND ws_sold_date_sk = d_date_sk
+  AND d_year = 2000
+  AND wr_refunded_customer_sk = c_customer_sk
+  AND cd1.cd_demo_sk = c_current_cdemo_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND wr_reason_sk = r_reason_sk
+  AND ((cd1.cd_marital_status = 'M'
+        AND cd1.cd_education_status = 'Advanced Degree'
+        AND ws_sales_price BETWEEN 100.00 AND 150.00)
+    OR (cd1.cd_marital_status = 'S'
+        AND cd1.cd_education_status = 'College'
+        AND ws_sales_price BETWEEN 50.00 AND 100.00)
+    OR (cd1.cd_marital_status = 'W'
+        AND cd1.cd_education_status = '2 yr Degree'
+        AND ws_sales_price BETWEEN 150.00 AND 200.00))
+  AND ((ca_country = 'United States'
+        AND ca_state IN ('IN', 'OH', 'NJ')
+        AND ws_net_profit BETWEEN 100 AND 200)
+    OR (ca_country = 'United States'
+        AND ca_state IN ('WI', 'CT', 'KY')
+        AND ws_net_profit BETWEEN 150 AND 300)
+    OR (ca_country = 'United States'
+        AND ca_state IN ('LA', 'IA', 'AR')
+        AND ws_net_profit BETWEEN 50 AND 250))
+GROUP BY r_reason_desc
+ORDER BY substr(r_reason_desc, 1, 20), avg(ws_quantity),
+         avg(wr_refunded_cash), avg(wr_net_loss)
+LIMIT 100
+"""
+
+# q86: web sales margin hierarchy with grouping() rank
+QUERIES[86] = """
+SELECT sum(ws_net_paid) total_sum, i_category, i_class,
+       grouping(i_category) + grouping(i_class) lochierarchy,
+       rank() OVER (
+         PARTITION BY grouping(i_category) + grouping(i_class),
+                      CASE WHEN grouping(i_class) = 0
+                           THEN i_category END
+         ORDER BY sum(ws_net_paid) DESC) rank_within_parent
+FROM web_sales, date_dim d1, item
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ws_sold_date_sk
+  AND i_item_sk = ws_item_sk
+GROUP BY ROLLUP (i_category, i_class)
+ORDER BY lochierarchy DESC,
+         CASE WHEN grouping(i_category) + grouping(i_class) = 0
+              THEN i_category END,
+         rank_within_parent
+LIMIT 100
+"""
+
+# q95: web orders shipped from multiple warehouses with returns
+QUERIES[95] = """
+WITH ws_wh AS (
+  SELECT ws1.ws_order_number, ws1.ws_warehouse_sk wh1,
+         ws2.ws_warehouse_sk wh2
+  FROM web_sales ws1, web_sales ws2
+  WHERE ws1.ws_order_number = ws2.ws_order_number
+    AND ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+SELECT count(DISTINCT ws_order_number) order_count,
+       sum(ws_ext_ship_cost) total_shipping_cost,
+       sum(ws_net_profit) total_net_profit
+FROM web_sales ws1, date_dim, customer_address, web_site
+WHERE d_date BETWEEN DATE '1999-02-01'
+                 AND DATE '1999-02-01' + INTERVAL '60' DAY
+  AND ws1.ws_ship_date_sk = d_date_sk
+  AND ws1.ws_ship_addr_sk = ca_address_sk
+  AND ca_state = 'IL'
+  AND ws1.ws_web_site_sk = web_site_sk
+  AND ws1.ws_order_number IN (SELECT ws_order_number FROM ws_wh)
+  AND ws1.ws_order_number IN (SELECT wr_order_number
+                              FROM web_returns, ws_wh
+                              WHERE wr_order_number =
+                                    ws_wh.ws_order_number)
+"""
+
+# q97: store/catalog purchase overlap by customer-item pairs
+# (FULL OUTER JOIN counting)
+QUERIES[97] = """
+WITH ssci AS (
+  SELECT ss_customer_sk customer_sk, ss_item_sk item_sk
+  FROM store_sales, date_dim
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+  GROUP BY ss_customer_sk, ss_item_sk),
+ csci AS (
+  SELECT cs_bill_customer_sk customer_sk, cs_item_sk item_sk
+  FROM catalog_sales, date_dim
+  WHERE cs_sold_date_sk = d_date_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+  GROUP BY cs_bill_customer_sk, cs_item_sk)
+SELECT sum(CASE WHEN ssci.customer_sk IS NOT NULL
+                 AND csci.customer_sk IS NULL
+                THEN 1 ELSE 0 END) store_only,
+       sum(CASE WHEN ssci.customer_sk IS NULL
+                 AND csci.customer_sk IS NOT NULL
+                THEN 1 ELSE 0 END) catalog_only,
+       sum(CASE WHEN ssci.customer_sk IS NOT NULL
+                 AND csci.customer_sk IS NOT NULL
+                THEN 1 ELSE 0 END) store_and_catalog
+FROM ssci
+FULL OUTER JOIN csci ON ssci.customer_sk = csci.customer_sk
+                    AND ssci.item_sk = csci.item_sk
+LIMIT 100
+"""
+
+# q11: year-over-year growth, store vs web, reporting preferred flag
+QUERIES[11] = """
+WITH year_total AS (
+  SELECT c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name,
+         c_preferred_cust_flag customer_preferred_cust_flag,
+         d_year dyear,
+         sum(ss_ext_list_price - ss_ext_discount_amt) year_total,
+         's' sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk AND ss_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name,
+           c_preferred_cust_flag, d_year
+  UNION ALL
+  SELECT c_customer_id, c_first_name, c_last_name,
+         c_preferred_cust_flag, d_year,
+         sum(ws_ext_list_price - ws_ext_discount_amt),
+         'w' sale_type
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk
+    AND ws_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name,
+           c_preferred_cust_flag, d_year)
+SELECT t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name,
+       t_s_secyear.customer_preferred_cust_flag
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.sale_type = 's' AND t_w_firstyear.sale_type = 'w'
+  AND t_s_secyear.sale_type = 's' AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.dyear = 2001 AND t_s_secyear.dyear = 2002
+  AND t_w_firstyear.dyear = 2001 AND t_w_secyear.dyear = 2002
+  AND t_s_firstyear.year_total > 0 AND t_w_firstyear.year_total > 0
+  AND CASE WHEN t_w_firstyear.year_total > 0
+           THEN t_w_secyear.year_total / t_w_firstyear.year_total
+           ELSE 0.0 END >
+      CASE WHEN t_s_firstyear.year_total > 0
+           THEN t_s_secyear.year_total / t_s_firstyear.year_total
+           ELSE 0.0 END
+ORDER BY t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+         t_s_secyear.customer_last_name,
+         t_s_secyear.customer_preferred_cust_flag
+LIMIT 100
+"""

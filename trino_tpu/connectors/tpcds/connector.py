@@ -40,15 +40,19 @@ class TpcdsConnector:
     def table_names(self, schema: str):
         return list(TABLE_NAMES)
 
+    DISK_CACHE_MIN_SCALE = 1.0     # see tpch/connector.py
+
     def get_table(self, schema: str, table: str) -> TableData:
         scale = self.scale_for_schema(schema)
         if scale is None:
             raise KeyError(f"tpcds schema {schema!r} not found")
         if table not in TABLE_NAMES:
             raise KeyError(f"tpcds table {table!r} not found")
-        if scale not in self._cache:
-            self._cache[scale] = generate(scale)
-        return self._cache[scale][table]
+        from ..diskcache import get_or_generate
+        return get_or_generate(
+            f"tpcds_sf{scale:g}", table, self._cache.setdefault(scale, {}),
+            lambda: generate(scale), TableData,
+            use_disk=scale >= self.DISK_CACHE_MIN_SCALE)
 
     def get_table_schema(self, schema: str, table: str):
         """Scale-independent schema without data generation (see tpch)."""

@@ -40,15 +40,22 @@ class TpchConnector:
     def table_names(self, schema: str):
         return list(TABLE_NAMES)
 
+    # scales at/above this persist to the on-disk cache: generation there
+    # costs minutes (SF10 ~ the round-2 bench timeout) while tiny/0.01
+    # regenerates in milliseconds
+    DISK_CACHE_MIN_SCALE = 1.0
+
     def get_table(self, schema: str, table: str) -> TableData:
         scale = self.scale_for_schema(schema)
         if scale is None:
             raise KeyError(f"tpch schema {schema!r} not found")
         if table not in TABLE_NAMES:
             raise KeyError(f"tpch table {table!r} not found")
-        if scale not in self._cache:
-            self._cache[scale] = generate(scale)
-        return self._cache[scale][table]
+        from ..diskcache import get_or_generate
+        return get_or_generate(
+            f"tpch_sf{scale:g}", table, self._cache.setdefault(scale, {}),
+            lambda: generate(scale), TableData,
+            use_disk=scale >= self.DISK_CACHE_MIN_SCALE)
 
     def get_table_schema(self, schema: str, table: str):
         """Schema without materializing data (information_schema must not

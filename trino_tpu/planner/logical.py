@@ -190,6 +190,17 @@ class SetOpNode(PlanNode):
 
 
 @dataclass(frozen=True)
+class RemoteSourceNode(PlanNode):
+    """Consumes another fragment's output (sql/planner/plan/
+    RemoteSourceNode.java): the cut point the fragmenter leaves behind.
+    At schedule time the producing fragment's materialized output is
+    substituted here (broadcast distribution ships it inside the consumer
+    fragment; the executor never sees this node)."""
+    fragment_id: int
+    output: Tuple
+
+
+@dataclass(frozen=True)
 class OutputNode(PlanNode):
     """Root: names the result columns (sql/planner/plan/OutputNode.java)."""
     child: PlanNode
@@ -204,6 +215,23 @@ def children(node: PlanNode):
     if isinstance(node, (JoinNode, SetOpNode)):
         return (node.left, node.right)
     return ()
+
+
+def replace_nodes(root: PlanNode, mapping) -> PlanNode:
+    """Rebuild the (frozen) tree with `mapping[id(node)] -> new node`
+    substitutions applied; untouched subtrees keep their identity."""
+    import dataclasses as _dc
+    hit = mapping.get(id(root))
+    if hit is not None:
+        return hit
+    changes = {}
+    for f in _dc.fields(root):
+        v = getattr(root, f.name)
+        if isinstance(v, PlanNode):
+            nv = replace_nodes(v, mapping)
+            if nv is not v:
+                changes[f.name] = nv
+    return _dc.replace(root, **changes) if changes else root
 
 
 def explain_text(node: PlanNode, indent: int = 0, annotate=None) -> str:
@@ -242,6 +270,8 @@ def explain_text(node: PlanNode, indent: int = 0, annotate=None) -> str:
         line = (f"{pad}Unnest[col={node.array_col} -> "
                 f"{node.element_name}"
                 f"{', ordinality' if node.ordinality else ''}]")
+    elif isinstance(node, RemoteSourceNode):
+        line = f"{pad}RemoteSource[fragment {node.fragment_id}]"
     elif isinstance(node, OutputNode):
         line = f"{pad}Output[{', '.join(node.names)}]"
     else:

@@ -1566,13 +1566,41 @@ class Planner:
         pre_node = L.ProjectNode(rel.node, tuple(pre_exprs),
                                  tuple(pre_cols))
 
+        # grouping() calls (sql/analyzer's GroupingOperationRewriter role):
+        # each call's value is branch-static per grouping set, so the
+        # grouping-sets planner appends one literal column per call
+        grouping_calls: List[A.FunctionCall] = []
+        for item in q.select:
+            if item.expr is not None:
+                collect_grouping_calls(item.expr, grouping_calls)
+        if q.having is not None:
+            collect_grouping_calls(q.having, grouping_calls)
+        for ob in q.order_by:
+            collect_grouping_calls(ob.expr, grouping_calls)
+        grouping_specs = []
+        for call in grouping_calls:
+            idxs = []
+            for a in call.args:
+                for i, g_ast in enumerate(group_asts):
+                    if ast_equal(a, g_ast, q):
+                        idxs.append(i)
+                        break
+                else:
+                    raise AnalysisError(
+                        "grouping() arguments must be grouping keys")
+            grouping_specs.append(tuple(idxs))
+
         agg_out = tuple(
             [(f"gk{i}", e.dtype) for i, e in enumerate(group_irs)] +
-            [(s.out_name, s.out_dtype) for s in agg_specs])
+            [(s.out_name, s.out_dtype) for s in agg_specs] +
+            ([(f"$grouping{i}", BIGINT)
+              for i in range(len(grouping_specs))]
+             if q.grouping_sets else []))
         if q.grouping_sets:
             agg_node = self.plan_grouping_sets(
                 q.grouping_sets, pre_node, group_irs, agg_specs, scope,
-                agg_out, bool(distinct_args))
+                agg_out, bool(distinct_args),
+                grouping_specs=tuple(grouping_specs))
         else:
             strategy, domains, capacity = self.agg_strategy(
                 group_irs, scope, pre_node,
@@ -1606,6 +1634,15 @@ class Planner:
                     if ast_equal(node, g_ast, q):
                         c = post_scope.columns[i]
                         return ir.ColumnRef(c.index, c.dtype, c.name)
+                if isinstance(node, A.FunctionCall) and \
+                        node.name == "grouping":
+                    if not q.grouping_sets:
+                        return ir.Literal(0, BIGINT)
+                    for gi, gcall in enumerate(grouping_calls):
+                        if gcall is node or ast_equal(node, gcall, q):
+                            return ir.ColumnRef(
+                                n_keys + len(agg_specs) + gi, BIGINT)
+                    raise AnalysisError("grouping() call not analyzed")
                 if isinstance(node, A.FunctionCall) and \
                         node.name in AGG_NAMES:
                     kind, s1, s2 = call_slots[node]
@@ -1708,7 +1745,8 @@ class Planner:
                 post_exprs, names)
 
     def plan_grouping_sets(self, sets, pre_node, group_irs, agg_specs,
-                           scope, agg_out, any_distinct) -> L.PlanNode:
+                           scope, agg_out, any_distinct,
+                           grouping_specs=()) -> L.PlanNode:
         """ROLLUP/CUBE/GROUPING SETS: one aggregation per set over the
         shared pre-projection, aligned to the full key layout with NULL
         padding, concatenated with UNION ALL (the role of Trino's
@@ -1736,6 +1774,15 @@ class Planner:
                     exprs.append(ir.Literal(None, g.dtype))
             for j, s in enumerate(agg_specs):
                 exprs.append(ir.ColumnRef(len(set_idxs) + j, s.out_dtype))
+            # grouping() literals: bit j set = the call's j-th argument is
+            # aggregated away in this set (spi semantics of grouping())
+            in_set = set(set_idxs)
+            for arg_idxs in grouping_specs:
+                v = 0
+                for j, gi in enumerate(arg_idxs):
+                    if gi not in in_set:
+                        v |= 1 << (len(arg_idxs) - 1 - j)
+                exprs.append(ir.Literal(v, BIGINT))
             branches.append(L.ProjectNode(node, tuple(exprs), agg_out))
         current = branches[0]
         none_maps = (None,) * len(agg_out)
@@ -2153,6 +2200,18 @@ def as_equi(node: A.Node):
             isinstance(node.right, A.Identifier):
         return node.left.parts, node.right.parts
     return None
+
+
+def collect_grouping_calls(node: A.Node, out: list) -> None:
+    """Find grouping(...) calls (GroupingOperationRewriter's discovery
+    step); window arguments are excluded like collect_windows' are."""
+    from .analyzer import ast_children
+    if isinstance(node, A.FunctionCall) and node.name == "grouping":
+        if node not in out:
+            out.append(node)
+        return
+    for ch in ast_children(node):
+        collect_grouping_calls(ch, out)
 
 
 def ast_equal(a: A.Node, b: A.Node, q: A.Query) -> bool:

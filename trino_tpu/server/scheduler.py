@@ -30,6 +30,7 @@ import numpy as np
 
 from ..exec.chunked import ChunkAnalysis, analyze, merge_partials
 from ..planner import logical as L
+from ..planner.fragmenter import Fragment, fragment_plan
 from ..planner.optimizer import prune_plan
 from ..sql import ast_nodes as A
 from ..sql.parser import parse
@@ -129,14 +130,26 @@ class StageScheduler:
             return None
         rel = self.session.planner().plan_query(stmt)
         root = prune_plan(rel.node)
-        analysis = analyze(root, self.session.catalog, self.split_rows)
-        if analysis is None:
+        # eligibility pre-gate: something must be split-worthy, or local
+        # execution wins outright (coordinator-only queries, Trino-style)
+        from ..planner.fragmenter import _scan_rows, _subtree_nodes
+        if not any(isinstance(n, L.ScanNode) and
+                   _scan_rows(self.session.catalog, n) > self.split_rows
+                   for n in _subtree_nodes(root)):
             return None
-        return rel, root, analysis
+        return rel, root
 
     def execute(self, sql: str):
         """Distributed execution; returns QueryResult or None (fall back
-        to local)."""
+        to local).
+
+        Phased multi-stage execution (PipelinedQueryScheduler.java:164 +
+        PhasedExecutionSchedule): the fragmenter cuts heavy join build
+        sides into their own stages; build stages run first (distributed
+        when their driver table is large, else on the coordinator), each
+        materialized output broadcast into its consumers; the probe spine
+        then runs as the split-streamed SOURCE stage and the coordinator
+        merges in the FINAL stage."""
         t0 = time.monotonic()
         workers = self.state.active_nodes()
         if not workers:
@@ -144,7 +157,33 @@ class StageScheduler:
         planned = self.plan(sql)
         if planned is None:
             return None
-        rel, root, analysis = planned
+        rel, root = planned
+
+        frags = fragment_plan(root, self.session.catalog,
+                              min_build_rows=self.split_rows)
+        # the probe spine itself must be split-worthy BEFORE any build
+        # stage runs — otherwise distributed builds execute and the local
+        # fallback throws their work away
+        from ..planner.fragmenter import _scan_rows, _subtree_nodes
+        if not any(isinstance(n, L.ScanNode) and
+                   _scan_rows(self.session.catalog, n) > self.split_rows
+                   for n in _subtree_nodes(frags[-1].root)):
+            return None
+        self.stats["stages"] = self.stats.get("stages", 0) + len(frags) + 1
+        materialized: Dict[int, L.ValuesNode] = {}
+        for f in frags[:-1]:
+            plan_f = self._bind_remotes(f.root, materialized)
+            materialized[f.id] = self._run_build_stage(plan_f)
+            if self.failure_injector is not None:
+                self.failure_injector.maybe_fail("STAGE_BOUNDARY", sql)
+        root = self._bind_remotes(frags[-1].root, materialized)
+
+        analysis = analyze(root, self.session.catalog, self.split_rows)
+        if analysis is None:
+            return None
+        workers = self.state.active_nodes()
+        if not workers:      # every worker died during the build stages
+            return None
         partial_pages = self._run_source_stage(workers, analysis, root)
         if self.failure_injector is not None:
             # between-stage failure point: source outputs are already
@@ -154,6 +193,80 @@ class StageScheduler:
         result.elapsed_s = time.monotonic() - t0
         self.stats["queries"] += 1
         return result
+
+    # -- build stages ------------------------------------------------------
+
+    def _bind_remotes(self, plan: L.PlanNode, materialized) -> L.PlanNode:
+        from ..planner.fragmenter import _subtree_nodes
+        mapping = {id(n): materialized[n.fragment_id]
+                   for n in _subtree_nodes(plan)
+                   if isinstance(n, L.RemoteSourceNode)}
+        return L.replace_nodes(plan, mapping) if mapping else plan
+
+    def _run_build_stage(self, plan: L.PlanNode) -> L.ValuesNode:
+        """Execute one build fragment to completion and materialize its
+        output as a broadcastable ValuesNode (REPLICATED distribution).
+        Distributed over workers when the fragment's own driver table is
+        split-worthy, else executed on the coordinator's devices."""
+        from ..batch import batch_to_numpy
+        out_node = L.OutputNode(plan, tuple(n for n, _ in plan.output),
+                                plan.output)
+        analysis = analyze(out_node, self.session.catalog, self.split_rows)
+        workers = self.state.active_nodes()
+        if analysis is not None and workers:
+            pages = self._run_source_stage(workers, analysis, out_node)
+            batch = self._merge_pages(out_node, analysis, pages)
+        else:
+            ex = self.session.executor
+            batch = ex.run(plan)
+        arrays, valids = batch_to_numpy(batch)
+        return L.ValuesNode(arrays=tuple(arrays), valids=tuple(valids),
+                            num_rows=len(arrays[0]) if arrays else 0,
+                            fields=(), output=plan.output)
+
+    def _merge_pages(self, root: L.OutputNode, analysis: ChunkAnalysis,
+                     pages: List[dict]):
+        """Merge source-stage partial pages and run the rest of the
+        fragment — the FINAL step shared by build stages and the root
+        stage. Partial-agg states re-aggregate with merge functions;
+        concat-mode pages concatenate below the output node."""
+        from ..batch import batch_from_numpy
+        ex = self.session.executor
+        saved = dict(ex._subst)
+        try:
+            if analysis.merge_agg is not None:
+                partials = []
+                for p in pages:
+                    if p["rows"] == 0:
+                        continue
+                    arrs, vals = decode_columns(p)
+                    partials.append(batch_from_numpy(arrs, valids=vals))
+                merged = merge_partials(ex, analysis.merge_agg, partials) \
+                    if partials else self._empty_like(analysis.merge_agg)
+                ex._subst[id(analysis.merge_agg)] = merged
+            else:
+                cols = None
+                for p in pages:
+                    arrs, vals = decode_columns(p)
+                    if cols is None:
+                        cols = [[a] for a in arrs], [[v] for v in vals]
+                    else:
+                        for j, a in enumerate(arrs):
+                            cols[0][j].append(a)
+                            cols[1][j].append(vals[j])
+                if cols is not None:
+                    arrs = [np.concatenate(c) for c in cols[0]]
+                    vals = [np.concatenate(c) for c in cols[1]]
+                else:     # no pages at all: empty input to the remainder
+                    arrs = [np.zeros(0, dtype=dt.np_dtype)
+                            for _, dt in root.child.output]
+                    vals = [np.zeros(0, dtype=np.bool_) for _ in arrs]
+                ex._subst[id(root.child)] = batch_from_numpy(
+                    arrs, valids=vals)
+            return ex.run(root.child)
+        finally:
+            ex._subst.clear()
+            ex._subst.update(saved)
 
     # -- source stage ------------------------------------------------------
 
@@ -255,44 +368,12 @@ class StageScheduler:
 
     def _run_final_stage(self, rel, root: L.OutputNode,
                          analysis: ChunkAnalysis, pages: List[dict]):
-        from ..batch import batch_from_numpy
         from ..exec.session import QueryResult
         ex = self.session.executor
-        ex._subst.clear()
-        try:
-            if analysis.merge_agg is not None:
-                partials = []
-                for p in pages:
-                    arrs, vals = decode_columns(p)
-                    if p["rows"] == 0:
-                        continue
-                    partials.append(batch_from_numpy(arrs, valids=vals))
-                if partials:
-                    merged = merge_partials(ex, analysis.merge_agg,
-                                            partials)
-                else:    # all splits filtered out: empty partial
-                    merged = self._empty_like(analysis.merge_agg)
-                ex._subst[id(analysis.merge_agg)] = merged
-            else:
-                cols = None
-                for p in pages:
-                    arrs, vals = decode_columns(p)
-                    if cols is None:
-                        cols = [[a] for a in arrs], [[v] for v in vals]
-                    else:
-                        for j, a in enumerate(arrs):
-                            cols[0][j].append(a)
-                            cols[1][j].append(vals[j])
-                arrs = [np.concatenate(c) for c in cols[0]]
-                vals = [np.concatenate(c) for c in cols[1]]
-                ex._subst[id(root.child)] = batch_from_numpy(
-                    arrs, valids=vals)
-            batch = ex.run(root)
-            names, arrays, valids = ex.result_to_host(root, batch)
-            rows = self.session.decode_rows(rel, arrays, valids)
-            return QueryResult(names, rows, 0.0, ex.stats)
-        finally:
-            ex._subst.clear()
+        batch = self._merge_pages(root, analysis, pages)
+        names, arrays, valids = ex.result_to_host(root, batch)
+        rows = self.session.decode_rows(rel, arrays, valids)
+        return QueryResult(names, rows, 0.0, ex.stats)
 
     def _empty_like(self, agg: L.AggregateNode):
         from ..batch import batch_from_numpy

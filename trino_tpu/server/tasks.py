@@ -20,7 +20,6 @@ PartitionedOutputBuffer.java:42) reduced to its sequential-consumer core.
 from __future__ import annotations
 
 import base64
-import pickle
 import threading
 import traceback
 from dataclasses import dataclass, field
@@ -61,13 +60,44 @@ def decode_columns(payload: dict):
 
 
 def encode_fragment(root) -> str:
-    """Plan subtree -> wire form. Pickle is the Python-native analog of the
-    reference's Jackson-serialized PlanFragment JSON (same-trust cluster)."""
-    return base64.b64encode(pickle.dumps(root)).decode()
+    """Plan subtree -> wire form: a data-only JSON serde (server/serde.py),
+    the analog of the reference's Jackson-serialized PlanFragment — a
+    crafted POST body can at worst build a malformed plan, never run code."""
+    from . import serde
+    return serde.dumps(root)
 
 
 def decode_fragment(blob: str):
-    return pickle.loads(base64.b64decode(blob))
+    from . import serde
+    return serde.loads(blob)
+
+
+def _static_subtrees(root, driver) -> list:
+    """Maximal subtrees of `root` that do not contain the driver scan —
+    join build sides and friends, constant across splits. Bare scans and
+    values leaves are excluded (the scan cache already memoizes them)."""
+    from ..planner import logical as L
+    memo = {}
+
+    def contains(n) -> bool:
+        r = memo.get(id(n))
+        if r is None:
+            r = n is driver or any(contains(c) for c in L.children(n))
+            memo[id(n)] = r
+        return r
+
+    out = []
+
+    def walk(n):
+        for c in L.children(n):
+            if contains(c):
+                walk(c)
+            elif not isinstance(c, (L.ScanNode, L.ValuesNode)):
+                out.append(c)
+
+    if contains(root):
+        walk(root)
+    return out
 
 
 @dataclass(frozen=True)
@@ -136,12 +166,18 @@ class TaskManager:
 
     def cancel(self, task_id: str) -> None:
         task = self.tasks.get(task_id)
-        if task is not None and task.state in ("PENDING", "RUNNING"):
-            task.state = "CANCELED"
+        if task is not None:
+            with task.lock:
+                if task.state in ("PENDING", "RUNNING"):
+                    task.state = "CANCELED"
+
 
     def _run(self, task: WorkerTask) -> None:
         from ..batch import batch_from_numpy, batch_to_numpy, pad_capacity
-        task.state = "RUNNING"
+        with task.lock:
+            if task.state != "PENDING":   # canceled before the thread ran
+                return
+            task.state = "RUNNING"
         self.tasks_run += 1
         try:
             if self.injector is not None:
@@ -150,42 +186,68 @@ class TaskManager:
             root, driver_scan = fragment["root"], fragment["driver"]
             cap = pad_capacity(max(s.count for s in task.splits)) \
                 if task.splits else 1024
-            for split in task.splits:
-                if task.state == "CANCELED":
-                    return
-                data = self.catalog.get_table(split.catalog,
-                                              split.schema_name, split.table)
-                arrays = [np.asarray(data.columns[i])
-                          [split.start:split.start + split.count]
-                          for i in driver_scan.column_indices]
-                valids = None
-                if data.valids is not None:
-                    valids = [None if data.valids[i] is None else
-                              np.asarray(data.valids[i])
-                              [split.start:split.start + split.count]
-                              for i in driver_scan.column_indices]
-                chunk = batch_from_numpy(arrays, valids=valids,
-                                         capacity=cap)
-                with self._exec_lock:
-                    ex = self._executor
+            # The executor (and its _subst/pool state) is shared by every
+            # task on this worker, so the whole pin-builds + splits loop
+            # holds _exec_lock: build state pinned across splits must not
+            # be clobbered by a concurrent task's cleanup. Device work is
+            # serialized by the chip anyway (Trino's analog: one lookup
+            # source per build, drivers share it under memory context
+            # locking).
+            with self._exec_lock:
+                ex = self._executor
+                ex._subst.clear()
+                try:
+                    # pin maximal driver-free subtrees ONCE per task (join
+                    # build sides, HashBuilderOperator's build-once-probe-
+                    # many): else every split re-executes every build join
+                    for sub in _static_subtrees(root, driver_scan):
+                        ex._subst[id(sub)] = ex.run(sub)
+                    for split in task.splits:
+                        if task.state == "CANCELED":
+                            return
+                        data = self.catalog.get_table(
+                            split.catalog, split.schema_name, split.table)
+                        arrays = [np.asarray(data.columns[i])
+                                  [split.start:split.start + split.count]
+                                  for i in driver_scan.column_indices]
+                        valids = None
+                        if data.valids is not None:
+                            valids = [
+                                None if data.valids[i] is None else
+                                np.asarray(data.valids[i])
+                                [split.start:split.start + split.count]
+                                for i in driver_scan.column_indices]
+                        chunk = batch_from_numpy(arrays, valids=valids,
+                                                 capacity=cap)
+                        ex._subst[id(driver_scan)] = chunk
+                        try:
+                            out = ex.run(root)
+                        finally:
+                            ex._subst.pop(id(driver_scan), None)
+                            # per-split outputs die here; pinned builds
+                            # keep their reservations until task end
+                            ex.release_path_reservations(
+                                root, keep=ex._subst)
+                        arrs, vals = batch_to_numpy(out)
+                        page = encode_columns(arrs, vals)
+                        with task.lock:
+                            task.pages.append(page)
+                            task.splits_done += 1
+                finally:
                     ex._subst.clear()
-                    ex._subst[id(driver_scan)] = chunk
-                    try:
-                        out = ex.run(root)
-                    finally:
-                        ex._subst.clear()
-                        for b in ex._node_bytes.values():
-                            ex.pool.free(b)
-                        ex._node_bytes.clear()
-                    arrs, vals = batch_to_numpy(out)
-                page = encode_columns(arrs, vals)
-                with task.lock:
-                    task.pages.append(page)
-                    task.splits_done += 1
-            task.state = "FINISHED"
+                    for b in ex._node_bytes.values():
+                        ex.pool.free(b)
+                    ex._node_bytes.clear()
+            with task.lock:
+                # a cancel landing during the last split must not be
+                # overwritten by FINISHED
+                if task.state == "RUNNING":
+                    task.state = "FINISHED"
         except Exception as e:        # noqa: BLE001 — task failure boundary
             task.error = f"{type(e).__name__}: {e}\n" + traceback.format_exc()
-            task.state = "FAILED"
+            with task.lock:
+                if task.state != "CANCELED":
+                    task.state = "FAILED"
 
     def status_json(self, task: WorkerTask) -> dict:
         return {"taskId": task.task_id, "state": task.state,

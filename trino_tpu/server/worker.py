@@ -71,14 +71,22 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 return
             token = int(parts[4])
             with task.lock:
-                if token < len(task.pages):
+                # Advancing to `token` acknowledges every page below it
+                # (TaskResource.java:372's implicit-ack contract) — drop
+                # drained pages so a long-lived worker's memory stays flat;
+                # same-token retries after a fetch failure still succeed.
+                while task.acked < token and task.pages:
+                    task.pages.pop(0)
+                    task.acked += 1
+                idx = token - task.acked
+                total = task.acked + len(task.pages)
+                if 0 <= idx < len(task.pages):
                     self._send(200, {"token": token, "complete": False,
-                                     "page": task.pages[token]})
+                                     "page": task.pages[idx]})
                     return
                 done = task.state in ("FINISHED", "FAILED", "CANCELED")
                 self._send(200, {"token": token,
-                                 "complete": done and
-                                 token >= len(task.pages),
+                                 "complete": done and token >= total,
                                  "state": task.state, "error": task.error,
                                  "page": None})
             return
