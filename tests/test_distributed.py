@@ -9,6 +9,8 @@ oracle on the virtual 8-device CPU mesh from conftest.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from oracle import assert_rows_match, load_oracle, oracle_query
 from tpch_full import QUERIES
 from trino_tpu.exec.session import Session

@@ -8,6 +8,8 @@ results under the retry policy.
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from trino_tpu.client.client import Client, QueryError
 from trino_tpu.exec.session import Session
 from trino_tpu.server.coordinator import CoordinatorServer

@@ -7,6 +7,8 @@ without spilling (the reference's spill tests assert the same).
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from oracle import assert_rows_match
 from trino_tpu.exec.memory import ExceededMemoryLimitError
 from trino_tpu.exec.session import Session

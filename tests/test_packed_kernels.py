@@ -1,0 +1,117 @@
+"""Differential tests: packed (2-operand-sort) kernels vs the general
+kernels. The packed paths activate in production only above
+SORT_SMALL_ROWS (cheap-compile threshold), so no end-to-end test crosses
+them on CPU — these call the kernels directly on small inputs and also
+force the executor dispatch through them.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import trino_tpu.exec.executor as E
+from trino_tpu.batch import batch_from_numpy, batch_to_numpy
+from trino_tpu.ops.aggregate import (AggSpec, key_pack_plan,
+                                     packed_sort_group_aggregate,
+                                     sort_group_aggregate)
+from trino_tpu.ops.sort import sort_batch, sort_batch_packed, sort_pack_plan
+
+
+def rows_of(batch):
+    arrays, valids = batch_to_numpy(batch)
+    return [tuple(a[i].item() if v[i] else None
+                  for a, v in zip(arrays, valids))
+            for i in range(len(arrays[0]))]
+
+
+def rand_batch(n=4000, seed=0, with_nulls=True):
+    rng = np.random.default_rng(seed)
+    k1 = rng.integers(-50, 50, n).astype(np.int64)
+    k2 = rng.integers(0, 7, n).astype(np.int64)
+    v = rng.integers(-1000, 1000, n).astype(np.int64)
+    valids = None
+    if with_nulls:
+        valids = [rng.random(n) > 0.1, rng.random(n) > 0.2,
+                  rng.random(n) > 0.15]
+    return batch_from_numpy([k1, k2, v], valids=valids)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_packed_agg_matches_general(seed):
+    b = rand_batch(seed=seed)
+    aggs = (AggSpec("sum", 2), AggSpec("count", 2), AggSpec("min", 2),
+            AggSpec("max", 2), AggSpec("count_star", None))
+    plan = key_pack_plan(b, (0, 1))
+    assert plan is not None
+    kmins, bits = plan
+    got = packed_sort_group_aggregate(b, jnp.asarray(kmins), (0, 1),
+                                      bits, aggs, 1024)
+    want = sort_group_aggregate(b, (0, 1), aggs, 1024)
+    assert sorted(rows_of(got), key=repr) == \
+        sorted(rows_of(want), key=repr)
+
+
+def test_packed_agg_all_null_key():
+    n = 512
+    b = batch_from_numpy(
+        [np.zeros(n, dtype=np.int64), np.arange(n, dtype=np.int64)],
+        valids=[np.zeros(n, dtype=bool), None])
+    aggs = (AggSpec("sum", 1),)
+    plan = key_pack_plan(b, (0,))
+    kmins, bits = plan
+    got = packed_sort_group_aggregate(b, jnp.asarray(kmins), (0,), bits,
+                                      aggs, 64)
+    want = sort_group_aggregate(b, (0,), aggs, 64)
+    assert sorted(rows_of(got), key=repr) == \
+        sorted(rows_of(want), key=repr)
+
+
+@pytest.mark.parametrize("asc,nf", [(True, False), (True, True),
+                                    (False, False), (False, True)])
+def test_packed_sort_matches_general(asc, nf):
+    b = rand_batch(seed=3)
+    keys = ((0, asc, nf), (1, not asc, not nf))
+    plan = sort_pack_plan(b, keys)
+    assert plan is not None
+    kmins, bits = plan
+    got = sort_batch_packed(b, jnp.asarray(kmins), keys, bits, 100)
+    want = sort_batch(b, keys, 100)
+    assert rows_of(got) == rows_of(want)
+
+
+def test_pack_plan_refuses_wide_domains():
+    n = 64
+    b = batch_from_numpy(
+        [np.array([0, 1 << 60] * (n // 2), dtype=np.int64),
+         np.array([0, 1 << 60] * (n // 2), dtype=np.int64)])
+    assert key_pack_plan(b, (0, 1)) is None
+
+
+def test_executor_dispatch_through_packed(monkeypatch):
+    """Force the production dispatch (threshold crossed) end-to-end."""
+    monkeypatch.setattr(E, "SORT_SMALL_ROWS", 16)
+    from trino_tpu.exec.session import Session
+    s = Session(default_schema="tiny")
+    got = s.execute(
+        "SELECT l_returnflag, l_linestatus, sum(l_quantity) q, count(*)"
+        " FROM lineitem GROUP BY l_returnflag, l_linestatus"
+        " ORDER BY q DESC, l_returnflag, l_linestatus").rows
+    monkeypatch.setattr(E, "SORT_SMALL_ROWS", 1 << 40)
+    s2 = Session(default_schema="tiny")
+    want = s2.execute(
+        "SELECT l_returnflag, l_linestatus, sum(l_quantity) q, count(*)"
+        " FROM lineitem GROUP BY l_returnflag, l_linestatus"
+        " ORDER BY q DESC, l_returnflag, l_linestatus").rows
+    assert got == want
+
+
+def test_compact_gather_matches_sort():
+    b = rand_batch(seed=5)
+    import jax.numpy as jnp2
+    live = np.asarray(b.live).copy()
+    live[::3] = False
+    b = b.with_live(jnp2.asarray(live))
+    cap = 2048
+    got = E._compact_gather(b, cap)
+    want = E._compact_sort(b, cap)
+    assert rows_of(got) == rows_of(want)

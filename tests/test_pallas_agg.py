@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from trino_tpu.batch import batch_from_numpy
 from trino_tpu.ops.aggregate import AggSpec, direct_group_aggregate
 from trino_tpu.ops.pallas_agg import (direct_group_aggregate_mxu, supports)

@@ -10,6 +10,8 @@ import jax
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from trino_tpu import ir
 from trino_tpu.batch import batch_from_numpy
 from trino_tpu.ops.aggregate import AggSpec, direct_group_aggregate

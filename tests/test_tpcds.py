@@ -7,8 +7,10 @@ same queries over identical generated data.
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from oracle import assert_rows_match, load_oracle, oracle_query
-from tpcds_queries import QUERIES
+from tpcds_queries import ORACLE, QUERIES
 from trino_tpu.connectors.tpcds.connector import TABLE_NAMES
 from trino_tpu.exec.session import Session
 
@@ -42,5 +44,5 @@ def test_fact_nulls_present(session):
 def test_tpcds_query(session, oracle, qid):
     sql = QUERIES[qid]
     got = session.execute(sql).rows
-    want = oracle_query(oracle, sql)
+    want = oracle_query(oracle, ORACLE.get(qid, sql))
     assert_rows_match(got, want, rel_tol=1e-9, abs_tol=0.02, ordered=True)

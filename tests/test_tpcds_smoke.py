@@ -1,0 +1,32 @@
+"""Quick-tier TPC-DS smoke: a handful of representative queries against
+the sqlite oracle. The full 99-query sweep lives in test_tpcds.py (slow
+tier); this keeps star-schema join/agg coverage in the default gate.
+"""
+
+import pytest
+
+from oracle import assert_rows_match, load_oracle, oracle_query
+from tpcds_queries import ORACLE, QUERIES
+from trino_tpu.connectors.tpcds.connector import TABLE_NAMES
+from trino_tpu.exec.session import Session
+
+SMOKE = [q for q in (3, 7, 42, 52, 55, 96) if q in QUERIES]
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session(default_cat="tpcds", default_schema="tiny")
+
+
+@pytest.fixture(scope="module")
+def oracle(session):
+    conn = session.catalog.connector("tpcds")
+    return load_oracle([conn.get_table("tiny", t) for t in TABLE_NAMES])
+
+
+@pytest.mark.parametrize("qid", SMOKE)
+def test_tpcds_smoke(session, oracle, qid):
+    sql = QUERIES[qid]
+    got = session.execute(sql).rows
+    want = oracle_query(oracle, ORACLE.get(qid, sql))
+    assert_rows_match(got, want, rel_tol=1e-9, abs_tol=0.02, ordered=True)

@@ -12,6 +12,8 @@ kernel.
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from oracle import assert_rows_match, load_oracle, oracle_query
 from tpch_full import QUERIES
 from trino_tpu.exec.session import Session

@@ -2037,7 +2037,7 @@ QUERIES[47] = """
 WITH v1 AS (
   SELECT i_category, i_brand, s_store_name, d_year, d_moy,
          sum(ss_sales_price) sum_sales,
-         avg(sum(ss_sales_price)) OVER (
+         avg(cast(sum(ss_sales_price) AS double)) OVER (
            PARTITION BY i_category, i_brand, s_store_name,
                         d_year) avg_monthly_sales,
          rank() OVER (
@@ -2264,7 +2264,7 @@ QUERIES[57] = """
 WITH v1 AS (
   SELECT i_category, i_brand, cc_name, d_year, d_moy,
          sum(cs_sales_price) sum_sales,
-         avg(sum(cs_sales_price)) OVER (
+         avg(cast(sum(cs_sales_price) AS double)) OVER (
            PARTITION BY i_category, i_brand, cc_name, d_year)
            avg_monthly_sales,
          rank() OVER (
@@ -3228,3 +3228,572 @@ ORDER BY t_s_secyear.customer_id, t_s_secyear.customer_first_name,
          t_s_secyear.customer_preferred_cust_flag
 LIMIT 100
 """
+
+# ---------------------------------------------------------------------------
+# Oracle overrides: sqlite has no ROLLUP/grouping(), so these queries get a
+# hand-expanded UNION ALL equivalent (same technique as
+# test_grouping_sets.py). `c IS NULL, c` in ORDER BY emulates Trino's
+# NULLS LAST default for rollup NULL rows.
+# ---------------------------------------------------------------------------
+
+ORACLE = {}
+
+ORACLE[22] = """
+WITH base AS (
+  SELECT i_product_name, i_brand, i_class, i_category,
+         inv_quantity_on_hand q
+  FROM inventory, date_dim, item
+  WHERE inv_date_sk = d_date_sk
+    AND inv_item_sk = i_item_sk
+    AND d_month_seq BETWEEN 1200 AND 1211)
+SELECT * FROM (
+  SELECT i_product_name, i_brand, i_class, i_category, avg(q) qoh
+  FROM base GROUP BY i_product_name, i_brand, i_class, i_category
+  UNION ALL
+  SELECT i_product_name, i_brand, i_class, NULL, avg(q)
+  FROM base GROUP BY i_product_name, i_brand, i_class
+  UNION ALL
+  SELECT i_product_name, i_brand, NULL, NULL, avg(q)
+  FROM base GROUP BY i_product_name, i_brand
+  UNION ALL
+  SELECT i_product_name, NULL, NULL, NULL, avg(q)
+  FROM base GROUP BY i_product_name
+  UNION ALL
+  SELECT NULL, NULL, NULL, NULL, avg(q) FROM base)
+ORDER BY qoh, i_product_name IS NULL, i_product_name,
+         i_brand IS NULL, i_brand, i_class IS NULL, i_class,
+         i_category IS NULL, i_category
+LIMIT 100
+"""
+
+ORACLE[18] = """
+WITH base AS (
+  SELECT i_item_id, ca_country, ca_state, ca_county,
+         CAST(cs_quantity AS REAL) q, CAST(cs_list_price AS REAL) lp,
+         CAST(cs_coupon_amt AS REAL) ca, CAST(cs_sales_price AS REAL) sp,
+         CAST(cs_net_profit AS REAL) np, CAST(c_birth_year AS REAL) by2,
+         CAST(cd1.cd_dep_count AS REAL) dc
+  FROM catalog_sales, customer_demographics cd1,
+       customer_demographics cd2, customer, customer_address, date_dim,
+       item
+  WHERE cs_sold_date_sk = d_date_sk
+    AND cs_item_sk = i_item_sk
+    AND cs_bill_cdemo_sk = cd1.cd_demo_sk
+    AND cs_bill_customer_sk = c_customer_sk
+    AND cd1.cd_gender = 'F' AND cd1.cd_education_status = 'Unknown'
+    AND c_current_cdemo_sk = cd2.cd_demo_sk
+    AND c_current_addr_sk = ca_address_sk
+    AND c_birth_month IN (1, 6, 8, 9, 12, 2)
+    AND d_year = 1998)
+SELECT * FROM (
+  SELECT i_item_id, ca_country, ca_state, ca_county, avg(q), avg(lp),
+         avg(ca), avg(sp), avg(np), avg(by2), avg(dc)
+  FROM base GROUP BY i_item_id, ca_country, ca_state, ca_county
+  UNION ALL
+  SELECT i_item_id, ca_country, ca_state, NULL, avg(q), avg(lp),
+         avg(ca), avg(sp), avg(np), avg(by2), avg(dc)
+  FROM base GROUP BY i_item_id, ca_country, ca_state
+  UNION ALL
+  SELECT i_item_id, ca_country, NULL, NULL, avg(q), avg(lp), avg(ca),
+         avg(sp), avg(np), avg(by2), avg(dc)
+  FROM base GROUP BY i_item_id, ca_country
+  UNION ALL
+  SELECT i_item_id, NULL, NULL, NULL, avg(q), avg(lp), avg(ca),
+         avg(sp), avg(np), avg(by2), avg(dc)
+  FROM base GROUP BY i_item_id
+  UNION ALL
+  SELECT NULL, NULL, NULL, NULL, avg(q), avg(lp), avg(ca), avg(sp),
+         avg(np), avg(by2), avg(dc)
+  FROM base)
+ORDER BY ca_country IS NULL, ca_country, ca_state IS NULL, ca_state,
+         ca_county IS NULL, ca_county, i_item_id IS NULL, i_item_id
+LIMIT 100
+"""
+
+ORACLE[5] = """
+WITH ssr AS (
+  SELECT s_store_id,
+         sum(sales_price) sales, sum(profit) profit,
+         sum(return_amt) returns_amt, sum(net_loss) profit_loss
+  FROM (SELECT ss_store_sk store_sk, ss_sold_date_sk date_sk,
+               ss_ext_sales_price sales_price, ss_net_profit profit,
+               0 return_amt, 0 net_loss
+        FROM store_sales
+        UNION ALL
+        SELECT sr_store_sk, sr_returned_date_sk, 0, 0, sr_return_amt,
+               sr_net_loss
+        FROM store_returns) salesreturns, date_dim, store
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-06'
+    AND store_sk = s_store_sk
+  GROUP BY s_store_id),
+ csr AS (
+  SELECT cp_catalog_page_id,
+         sum(sales_price) sales, sum(profit) profit,
+         sum(return_amt) returns_amt, sum(net_loss) profit_loss
+  FROM (SELECT cs_catalog_page_sk page_sk, cs_sold_date_sk date_sk,
+               cs_ext_sales_price sales_price, cs_net_profit profit,
+               0 return_amt, 0 net_loss
+        FROM catalog_sales
+        UNION ALL
+        SELECT cr_catalog_page_sk, cr_returned_date_sk, 0, 0,
+               cr_return_amount, cr_net_loss
+        FROM catalog_returns) salesreturns, date_dim, catalog_page
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-06'
+    AND page_sk = cp_catalog_page_sk
+  GROUP BY cp_catalog_page_id),
+ wsr AS (
+  SELECT web_name,
+         sum(sales_price) sales, sum(profit) profit,
+         sum(return_amt) returns_amt, sum(net_loss) profit_loss
+  FROM (SELECT ws_web_site_sk wsr_web_site_sk, ws_sold_date_sk date_sk,
+               ws_ext_sales_price sales_price, ws_net_profit profit,
+               0 return_amt, 0 net_loss
+        FROM web_sales
+        UNION ALL
+        SELECT ws.ws_web_site_sk, wr.wr_returned_date_sk, 0, 0,
+               wr.wr_return_amt, wr.wr_net_loss
+        FROM web_returns wr
+        LEFT JOIN web_sales ws
+          ON wr.wr_item_sk = ws.ws_item_sk
+         AND wr.wr_order_number = ws.ws_order_number) salesreturns,
+       date_dim, web_site
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-06'
+    AND wsr_web_site_sk = web_site_sk
+  GROUP BY web_name),
+ x AS (
+  SELECT 'store channel' channel, s_store_id id, sales, returns_amt,
+         profit, profit_loss
+  FROM ssr
+  UNION ALL
+  SELECT 'catalog channel', cp_catalog_page_id, sales, returns_amt,
+         profit, profit_loss
+  FROM csr
+  UNION ALL
+  SELECT 'web channel', web_name, sales, returns_amt, profit,
+         profit_loss
+  FROM wsr)
+SELECT * FROM (
+  SELECT channel, id, sum(sales) sales, sum(returns_amt) returns_amt,
+         sum(profit - profit_loss) profit
+  FROM x GROUP BY channel, id
+  UNION ALL
+  SELECT channel, NULL, sum(sales), sum(returns_amt),
+         sum(profit - profit_loss)
+  FROM x GROUP BY channel
+  UNION ALL
+  SELECT NULL, NULL, sum(sales), sum(returns_amt),
+         sum(profit - profit_loss)
+  FROM x)
+ORDER BY channel IS NULL, channel, id IS NULL, id
+LIMIT 100
+"""
+
+ORACLE[77] = """
+WITH ss AS (
+  SELECT s_store_sk, sum(ss_ext_sales_price) sales,
+         sum(ss_net_profit) profit
+  FROM store_sales, date_dim, store
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-22'
+    AND ss_store_sk = s_store_sk
+  GROUP BY s_store_sk),
+ sr AS (
+  SELECT s_store_sk, sum(sr_return_amt) returns_amt,
+         sum(sr_net_loss) profit_loss
+  FROM store_returns, date_dim, store
+  WHERE sr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-22'
+    AND sr_store_sk = s_store_sk
+  GROUP BY s_store_sk),
+ cs AS (
+  SELECT cs_call_center_sk, sum(cs_ext_sales_price) sales,
+         sum(cs_net_profit) profit
+  FROM catalog_sales, date_dim
+  WHERE cs_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-22'
+  GROUP BY cs_call_center_sk),
+ cr AS (
+  SELECT cr_call_center_sk, sum(cr_return_amount) returns_amt,
+         sum(cr_net_loss) profit_loss
+  FROM catalog_returns, date_dim
+  WHERE cr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-22'
+  GROUP BY cr_call_center_sk),
+ ws AS (
+  SELECT wp_web_page_sk, sum(ws_ext_sales_price) sales,
+         sum(ws_net_profit) profit
+  FROM web_sales, date_dim, web_page
+  WHERE ws_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-22'
+    AND ws_web_page_sk = wp_web_page_sk
+  GROUP BY wp_web_page_sk),
+ wr AS (
+  SELECT wr_web_page_sk, sum(wr_return_amt) returns_amt,
+         sum(wr_net_loss) profit_loss
+  FROM web_returns, date_dim, web_page
+  WHERE wr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-22'
+    AND wr_web_page_sk = wp_web_page_sk
+  GROUP BY wr_web_page_sk),
+ x AS (
+  SELECT 'store channel' channel, ss.s_store_sk id, sales,
+         COALESCE(returns_amt, 0) returns_amt,
+         profit - COALESCE(profit_loss, 0) profit
+  FROM ss LEFT JOIN sr ON ss.s_store_sk = sr.s_store_sk
+  UNION ALL
+  SELECT 'catalog channel', cs_call_center_sk, sales,
+         COALESCE(returns_amt, 0), profit - COALESCE(profit_loss, 0)
+  FROM cs LEFT JOIN cr ON cs.cs_call_center_sk = cr.cr_call_center_sk
+  UNION ALL
+  SELECT 'web channel', ws.wp_web_page_sk, sales,
+         COALESCE(returns_amt, 0), profit - COALESCE(profit_loss, 0)
+  FROM ws LEFT JOIN wr ON ws.wp_web_page_sk = wr.wr_web_page_sk)
+SELECT * FROM (
+  SELECT channel, id, sum(sales) sales, sum(returns_amt) returns_amt,
+         sum(profit) profit
+  FROM x GROUP BY channel, id
+  UNION ALL
+  SELECT channel, NULL, sum(sales), sum(returns_amt), sum(profit)
+  FROM x GROUP BY channel
+  UNION ALL
+  SELECT NULL, NULL, sum(sales), sum(returns_amt), sum(profit) FROM x)
+ORDER BY channel IS NULL, channel, id IS NULL, id
+LIMIT 100
+"""
+
+ORACLE[80] = """
+WITH ssr AS (
+  SELECT s_store_id,
+         sum(ss_ext_sales_price) sales,
+         sum(COALESCE(sr_return_amt, 0)) returns_amt,
+         sum(ss_net_profit - COALESCE(sr_net_loss, 0)) profit
+  FROM store_sales
+  LEFT JOIN store_returns ON ss_item_sk = sr_item_sk
+                         AND ss_ticket_number = sr_ticket_number,
+       date_dim, store, item, promotion
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-22'
+    AND ss_store_sk = s_store_sk
+    AND ss_item_sk = i_item_sk
+    AND i_current_price > 50
+    AND ss_promo_sk = p_promo_sk
+    AND p_channel_tv = 'N'
+  GROUP BY s_store_id),
+ csr AS (
+  SELECT cp_catalog_page_id,
+         sum(cs_ext_sales_price) sales,
+         sum(COALESCE(cr_return_amount, 0)) returns_amt,
+         sum(cs_net_profit - COALESCE(cr_net_loss, 0)) profit
+  FROM catalog_sales
+  LEFT JOIN catalog_returns ON cs_item_sk = cr_item_sk
+                           AND cs_order_number = cr_order_number,
+       date_dim, catalog_page, item, promotion
+  WHERE cs_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-22'
+    AND cs_catalog_page_sk = cp_catalog_page_sk
+    AND cs_item_sk = i_item_sk
+    AND i_current_price > 50
+    AND cs_promo_sk = p_promo_sk
+    AND p_channel_tv = 'N'
+  GROUP BY cp_catalog_page_id),
+ wsr AS (
+  SELECT web_site_sk,
+         sum(ws_ext_sales_price) sales,
+         sum(COALESCE(wr_return_amt, 0)) returns_amt,
+         sum(ws_net_profit - COALESCE(wr_net_loss, 0)) profit
+  FROM web_sales
+  LEFT JOIN web_returns ON ws_item_sk = wr_item_sk
+                       AND ws_order_number = wr_order_number,
+       date_dim, web_site, item, promotion
+  WHERE ws_sold_date_sk = d_date_sk
+    AND d_date BETWEEN '2000-08-23' AND '2000-09-22'
+    AND ws_web_site_sk = web_site.web_site_sk
+    AND ws_item_sk = i_item_sk
+    AND i_current_price > 50
+    AND ws_promo_sk = p_promo_sk
+    AND p_channel_tv = 'N'
+  GROUP BY web_site.web_site_sk),
+ x AS (
+  SELECT 'store channel' channel, s_store_id id, sales, returns_amt,
+         profit
+  FROM ssr
+  UNION ALL
+  SELECT 'catalog channel', cp_catalog_page_id, sales, returns_amt,
+         profit
+  FROM csr
+  UNION ALL
+  SELECT 'web channel', web_site_sk, sales, returns_amt, profit
+  FROM wsr)
+SELECT * FROM (
+  SELECT channel, id, sum(sales) sales, sum(returns_amt) returns_amt,
+         sum(profit) profit
+  FROM x GROUP BY channel, id
+  UNION ALL
+  SELECT channel, NULL, sum(sales), sum(returns_amt), sum(profit)
+  FROM x GROUP BY channel
+  UNION ALL
+  SELECT NULL, NULL, sum(sales), sum(returns_amt), sum(profit) FROM x)
+ORDER BY channel IS NULL, channel, id IS NULL, id
+LIMIT 100
+"""
+
+ORACLE[36] = """
+WITH base AS (
+  SELECT i_category, i_class, ss_net_profit np, ss_ext_sales_price sp
+  FROM store_sales, date_dim d1, item, store
+  WHERE d1.d_year = 2001
+    AND d1.d_date_sk = ss_sold_date_sk
+    AND i_item_sk = ss_item_sk
+    AND s_store_sk = ss_store_sk
+    AND s_state = 'TN'),
+ g AS (
+  SELECT sum(np) / sum(sp) gross_margin, i_category, i_class,
+         0 lochierarchy
+  FROM base GROUP BY i_category, i_class
+  UNION ALL
+  SELECT sum(np) / sum(sp), i_category, NULL, 1
+  FROM base GROUP BY i_category
+  UNION ALL
+  SELECT sum(np) / sum(sp), NULL, NULL, 2 FROM base)
+SELECT gross_margin, i_category, i_class, lochierarchy,
+       rank() OVER (
+         PARTITION BY lochierarchy,
+                      CASE WHEN lochierarchy = 0 THEN i_category END
+         ORDER BY gross_margin ASC) rank_within_parent
+FROM g
+ORDER BY lochierarchy DESC,
+         (CASE WHEN lochierarchy = 0 THEN i_category END) IS NULL,
+         CASE WHEN lochierarchy = 0 THEN i_category END,
+         rank_within_parent
+LIMIT 100
+"""
+
+ORACLE[86] = """
+WITH base AS (
+  SELECT i_category, i_class, ws_net_paid np
+  FROM web_sales, date_dim d1, item
+  WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+    AND d1.d_date_sk = ws_sold_date_sk
+    AND i_item_sk = ws_item_sk),
+ g AS (
+  SELECT sum(np) total_sum, i_category, i_class, 0 lochierarchy
+  FROM base GROUP BY i_category, i_class
+  UNION ALL
+  SELECT sum(np), i_category, NULL, 1 FROM base GROUP BY i_category
+  UNION ALL
+  SELECT sum(np), NULL, NULL, 2 FROM base)
+SELECT total_sum, i_category, i_class, lochierarchy,
+       rank() OVER (
+         PARTITION BY lochierarchy,
+                      CASE WHEN lochierarchy = 0 THEN i_category END
+         ORDER BY total_sum DESC) rank_within_parent
+FROM g
+ORDER BY lochierarchy DESC,
+         (CASE WHEN lochierarchy = 0 THEN i_category END) IS NULL,
+         CASE WHEN lochierarchy = 0 THEN i_category END,
+         rank_within_parent
+LIMIT 100
+"""
+
+ORACLE[70] = """
+WITH base AS (
+  SELECT s_state, s_county, ss_net_profit np
+  FROM store_sales, date_dim d1, store
+  WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+    AND d1.d_date_sk = ss_sold_date_sk
+    AND s_store_sk = ss_store_sk
+    AND s_state IN
+        (SELECT s_state
+         FROM (SELECT s_state,
+                      rank() OVER (PARTITION BY s_state
+                                   ORDER BY sum(ss_net_profit) DESC)
+                        ranking
+               FROM store_sales, store, date_dim
+               WHERE d_month_seq BETWEEN 1200 AND 1211
+                 AND d_date_sk = ss_sold_date_sk
+                 AND s_store_sk = ss_store_sk
+               GROUP BY s_state) tmp1
+         WHERE ranking <= 5)),
+ g AS (
+  SELECT sum(np) total_sum, s_state, s_county, 0 lochierarchy
+  FROM base GROUP BY s_state, s_county
+  UNION ALL
+  SELECT sum(np), s_state, NULL, 1 FROM base GROUP BY s_state
+  UNION ALL
+  SELECT sum(np), NULL, NULL, 2 FROM base)
+SELECT total_sum, s_state, s_county, lochierarchy,
+       rank() OVER (
+         PARTITION BY lochierarchy,
+                      CASE WHEN lochierarchy = 0 THEN s_state END
+         ORDER BY total_sum DESC) rank_within_parent
+FROM g
+ORDER BY lochierarchy DESC,
+         (CASE WHEN lochierarchy = 0 THEN s_state END) IS NULL,
+         CASE WHEN lochierarchy = 0 THEN s_state END,
+         rank_within_parent
+LIMIT 100
+"""
+
+ORACLE[14] = """
+WITH cross_items AS (
+  SELECT i_item_sk ss_item_sk
+  FROM item,
+       (SELECT iss.i_brand_id brand_id, iss.i_class_id class_id,
+               iss.i_category_id category_id
+        FROM store_sales, item iss, date_dim d1
+        WHERE ss_item_sk = iss.i_item_sk
+          AND ss_sold_date_sk = d1.d_date_sk
+          AND d1.d_year BETWEEN 1999 AND 2001
+        INTERSECT
+        SELECT ics.i_brand_id, ics.i_class_id, ics.i_category_id
+        FROM catalog_sales, item ics, date_dim d2
+        WHERE cs_item_sk = ics.i_item_sk
+          AND cs_sold_date_sk = d2.d_date_sk
+          AND d2.d_year BETWEEN 1999 AND 2001
+        INTERSECT
+        SELECT iws.i_brand_id, iws.i_class_id, iws.i_category_id
+        FROM web_sales, item iws, date_dim d3
+        WHERE ws_item_sk = iws.i_item_sk
+          AND ws_sold_date_sk = d3.d_date_sk
+          AND d3.d_year BETWEEN 1999 AND 2001) x
+  WHERE i_brand_id = brand_id AND i_class_id = class_id
+    AND i_category_id = category_id),
+ avg_sales AS (
+  SELECT avg(quantity * list_price) average_sales
+  FROM (SELECT ss_quantity quantity, ss_list_price list_price
+        FROM store_sales, date_dim
+        WHERE ss_sold_date_sk = d_date_sk
+          AND d_year BETWEEN 1999 AND 2001
+        UNION ALL
+        SELECT cs_quantity, cs_list_price
+        FROM catalog_sales, date_dim
+        WHERE cs_sold_date_sk = d_date_sk
+          AND d_year BETWEEN 1999 AND 2001
+        UNION ALL
+        SELECT ws_quantity, ws_list_price
+        FROM web_sales, date_dim
+        WHERE ws_sold_date_sk = d_date_sk
+          AND d_year BETWEEN 1999 AND 2001) x),
+ y AS (
+  SELECT 'store' channel, i_brand_id, i_class_id, i_category_id,
+         sum(ss_quantity * ss_list_price) sales, count(*) number_sales
+  FROM store_sales, item, date_dim
+  WHERE ss_item_sk IN (SELECT ss_item_sk FROM cross_items)
+    AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 11
+  GROUP BY i_brand_id, i_class_id, i_category_id
+  HAVING sum(ss_quantity * ss_list_price) >
+         (SELECT average_sales FROM avg_sales)
+  UNION ALL
+  SELECT 'catalog', i_brand_id, i_class_id, i_category_id,
+         sum(cs_quantity * cs_list_price), count(*)
+  FROM catalog_sales, item, date_dim
+  WHERE cs_item_sk IN (SELECT ss_item_sk FROM cross_items)
+    AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 11
+  GROUP BY i_brand_id, i_class_id, i_category_id
+  HAVING sum(cs_quantity * cs_list_price) >
+         (SELECT average_sales FROM avg_sales)
+  UNION ALL
+  SELECT 'web', i_brand_id, i_class_id, i_category_id,
+         sum(ws_quantity * ws_list_price), count(*)
+  FROM web_sales, item, date_dim
+  WHERE ws_item_sk IN (SELECT ss_item_sk FROM cross_items)
+    AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 11
+  GROUP BY i_brand_id, i_class_id, i_category_id
+  HAVING sum(ws_quantity * ws_list_price) >
+         (SELECT average_sales FROM avg_sales))
+SELECT * FROM (
+  SELECT channel, i_brand_id, i_class_id, i_category_id,
+         sum(sales) sum_sales, sum(number_sales) number_sales
+  FROM y GROUP BY channel, i_brand_id, i_class_id, i_category_id
+  UNION ALL
+  SELECT channel, i_brand_id, i_class_id, NULL, sum(sales),
+         sum(number_sales)
+  FROM y GROUP BY channel, i_brand_id, i_class_id
+  UNION ALL
+  SELECT channel, i_brand_id, NULL, NULL, sum(sales),
+         sum(number_sales)
+  FROM y GROUP BY channel, i_brand_id
+  UNION ALL
+  SELECT channel, NULL, NULL, NULL, sum(sales), sum(number_sales)
+  FROM y GROUP BY channel
+  UNION ALL
+  SELECT NULL, NULL, NULL, NULL, sum(sales), sum(number_sales) FROM y)
+ORDER BY channel IS NULL, channel, i_brand_id IS NULL, i_brand_id,
+         i_class_id IS NULL, i_class_id, i_category_id IS NULL,
+         i_category_id
+LIMIT 100
+"""
+
+ORACLE[67] = """
+WITH base AS (
+  SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_id,
+         COALESCE(ss_sales_price * ss_quantity, 0) sp
+  FROM store_sales, date_dim, store, item
+  WHERE ss_sold_date_sk = d_date_sk
+    AND ss_item_sk = i_item_sk
+    AND ss_store_sk = s_store_sk
+    AND d_month_seq BETWEEN 1200 AND 1211),
+ dw1 AS (
+  SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_id, sum(sp) sumsales
+  FROM base GROUP BY i_category, i_class, i_brand, i_product_name,
+                     d_year, d_qoy, d_moy, s_store_id
+  UNION ALL
+  SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, NULL, sum(sp)
+  FROM base GROUP BY i_category, i_class, i_brand, i_product_name,
+                     d_year, d_qoy, d_moy
+  UNION ALL
+  SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         NULL, NULL, sum(sp)
+  FROM base GROUP BY i_category, i_class, i_brand, i_product_name,
+                     d_year, d_qoy
+  UNION ALL
+  SELECT i_category, i_class, i_brand, i_product_name, d_year, NULL,
+         NULL, NULL, sum(sp)
+  FROM base GROUP BY i_category, i_class, i_brand, i_product_name,
+                     d_year
+  UNION ALL
+  SELECT i_category, i_class, i_brand, i_product_name, NULL, NULL,
+         NULL, NULL, sum(sp)
+  FROM base GROUP BY i_category, i_class, i_brand, i_product_name
+  UNION ALL
+  SELECT i_category, i_class, i_brand, NULL, NULL, NULL, NULL, NULL,
+         sum(sp)
+  FROM base GROUP BY i_category, i_class, i_brand
+  UNION ALL
+  SELECT i_category, i_class, NULL, NULL, NULL, NULL, NULL, NULL,
+         sum(sp)
+  FROM base GROUP BY i_category, i_class
+  UNION ALL
+  SELECT i_category, NULL, NULL, NULL, NULL, NULL, NULL, NULL, sum(sp)
+  FROM base GROUP BY i_category
+  UNION ALL
+  SELECT NULL, NULL, NULL, NULL, NULL, NULL, NULL, NULL, sum(sp)
+  FROM base)
+SELECT * FROM (
+  SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_id, sumsales,
+         rank() OVER (PARTITION BY i_category
+                      ORDER BY sumsales DESC) rk
+  FROM dw1) dw2
+WHERE rk <= 100
+ORDER BY i_category IS NULL, i_category, i_class IS NULL, i_class,
+         i_brand IS NULL, i_brand, i_product_name IS NULL,
+         i_product_name, d_year IS NULL, d_year, d_qoy IS NULL, d_qoy,
+         d_moy IS NULL, d_moy, s_store_id IS NULL, s_store_id,
+         sumsales, rk
+LIMIT 100
+"""
+
+# q49's oracle: sqlite CAST(... AS decimal) keeps INTEGER affinity, so the
+# ratio divisions must cast to REAL explicitly or they integer-divide into
+# a sea of rank ties.
+ORACLE[49] = QUERIES[49].replace("AS decimal(15,4))", "AS REAL)")

@@ -13,6 +13,24 @@ from ..types import TypeKind
 from .tpch.datagen import TableData
 
 
+def _remap_codes(target_field: Field, src_field: Optional[Field],
+                 codes: np.ndarray):
+    """Translate VARCHAR codes from `src_field`'s pool into
+    `target_field`'s, extending the target pool with unseen strings.
+    Returns (remapped codes, updated target Field)."""
+    pool = list(target_field.dictionary or ())
+    index = {s: j for j, s in enumerate(pool)}
+    src_pool = (src_field.dictionary or ()) if src_field else ()
+    remap = np.zeros(max(len(src_pool), 1), dtype=np.int32)
+    for j, s in enumerate(src_pool):
+        if s not in index:
+            index[s] = len(pool)
+            pool.append(s)
+        remap[j] = index[s]
+    return remap[np.asarray(codes, dtype=np.int32)], Field(
+        target_field.name, target_field.dtype, dictionary=tuple(pool))
+
+
 class MemoryConnector:
     name = "memory"
 
@@ -65,17 +83,7 @@ class MemoryConnector:
             add = np.asarray(arrays[i])
             fld = tf
             if tf.dtype.kind is TypeKind.VARCHAR:
-                pool = list(tf.dictionary or ())
-                index = {s: j for j, s in enumerate(pool)}
-                src_pool = nf.dictionary or ()
-                remap = np.zeros(max(len(src_pool), 1), dtype=np.int32)
-                for j, s in enumerate(src_pool):
-                    if s not in index:
-                        index[s] = len(pool)
-                        pool.append(s)
-                    remap[j] = index[s]
-                add = remap[add.astype(np.int32)]
-                fld = Field(tf.name, tf.dtype, dictionary=tuple(pool))
+                add, fld = _remap_codes(tf, nf, add)
             elif add.dtype != old.dtype:
                 add = add.astype(old.dtype)
             new_cols.append(np.concatenate([old, add]))
@@ -98,3 +106,51 @@ class MemoryConnector:
         if key not in self._tables:
             raise KeyError(f"memory table {schema}.{table} not found")
         return self._tables[key]
+
+    # ---- mutation (the MergeWriterOperator / row-change tier) -----------
+
+    def delete_rows(self, schema: str, name: str,
+                    ids: np.ndarray) -> int:
+        """Drop rows by position (row-id + delete-mask scheme, the
+        reference's row-change paradigm reduced to the in-memory case)."""
+        t = self.get_table(schema, name)
+        keep = np.ones(t.num_rows, dtype=np.bool_)
+        keep[np.asarray(ids, dtype=np.int64)] = False
+        cols = [np.asarray(c)[keep] for c in t.columns]
+        valids = None
+        if t.valids is not None:
+            valids = [None if v is None else np.asarray(v)[keep]
+                      for v in t.valids]
+        self._tables[(schema, name)] = TableData(
+            t.name, t.schema, cols, primary_key=(), valids=valids)
+        return int((~keep).sum())
+
+    def update_rows(self, schema: str, name: str, ids: np.ndarray,
+                    updates: dict) -> int:
+        """Overwrite columns at row positions. `updates` maps column name
+        -> (values, valid, field); VARCHAR values arrive as codes in the
+        field's pool and are remapped into (and extend) the stored
+        pool."""
+        t = self.get_table(schema, name)
+        ids = np.asarray(ids, dtype=np.int64)
+        cols = [np.asarray(c).copy() for c in t.columns]
+        valids = [np.ones(t.num_rows, dtype=np.bool_)
+                  if t.valids is None or t.valids[i] is None
+                  else np.asarray(t.valids[i]).copy()
+                  for i in range(len(cols))]
+        fields = list(t.schema.fields)
+        for col_name, (vals, valid, src_field) in updates.items():
+            i = t.schema.index_of(col_name)
+            tf = fields[i]
+            vals = np.asarray(vals)
+            if tf.dtype.kind is TypeKind.VARCHAR:
+                vals, fields[i] = _remap_codes(tf, src_field, vals)
+            else:
+                vals = vals.astype(cols[i].dtype)
+            cols[i][ids] = vals
+            valids[i][ids] = np.ones(len(ids), dtype=np.bool_) \
+                if valid is None else np.asarray(valid)
+        self._tables[(schema, name)] = TableData(
+            t.name, Schema(tuple(fields)), cols, primary_key=(),
+            valids=valids)
+        return len(ids)

@@ -18,6 +18,7 @@ Adaptive fallbacks (SURVEY.md §7 hard part 1):
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -88,11 +89,27 @@ class Executor:
                 return out
         return self.run(root.child)
 
+    # TRINO_TPU_TRACE_NODES=1 prints per-node dispatch timings to stderr
+    # (async dispatch time; sync waits inside a node attribute to it) —
+    # the printf tier of EXPLAIN ANALYZE, usable when a query never
+    # finishes
+    TRACE = bool(os.environ.get("TRINO_TPU_TRACE_NODES"))
+
     def run(self, node: L.PlanNode) -> Batch:
         sub = self._subst.get(id(node))
         if sub is not None:
             return sub
-        if self.profile:
+        if self.TRACE:
+            import sys
+            import time as _t
+            t0 = _t.monotonic()
+            print(f"[trace] > {type(node).__name__}", file=sys.stderr,
+                  flush=True)
+            out = self.dispatch(node)
+            print(f"[trace] < {type(node).__name__} "
+                  f"{_t.monotonic() - t0:.1f}s", file=sys.stderr,
+                  flush=True)
+        elif self.profile:
             import time
             t0 = time.monotonic()
             out = self.dispatch(node)
@@ -152,7 +169,17 @@ class Executor:
         if isinstance(node, L.SortNode):
             keys = tuple((k.index, k.ascending, k.nulls_first)
                          for k in node.keys)
-            return sort_batch(self.run(node.child), keys, node.limit)
+            child = self.run(node.child)
+            # at scale, pack ORDER BY keys into one int64 so the sort
+            # stays 2-operand (see SORT_SMALL_ROWS)
+            if keys and child.capacity > SORT_SMALL_ROWS:
+                from ..ops.sort import sort_batch_packed, sort_pack_plan
+                plan = sort_pack_plan(child, keys)
+                if plan is not None:
+                    kmins, bits = plan
+                    return sort_batch_packed(child, jnp.asarray(kmins),
+                                             keys, bits, node.limit)
+            return sort_batch(child, keys, node.limit)
         if isinstance(node, L.LimitNode):
             return limit_batch(self.run(node.child),
                                jnp.asarray(node.count, dtype=jnp.int64))
@@ -355,9 +382,24 @@ class Executor:
             return direct_group_aggregate(child, node.group_keys,
                                           node.key_domains, aggs)
         capacity = node.out_capacity
+        # big inputs: pack all keys into one int64 so the sort has 2
+        # operands — the general kernel's 2-per-key operand count makes
+        # XLA TPU compiles explode at scale (see SORT_COMPILE_BUDGET)
+        pack = None
+        if not any(a.distinct for a in aggs) and node.group_keys and \
+                child.capacity > SORT_SMALL_ROWS:
+            from ..ops.aggregate import (key_pack_plan,
+                                         packed_sort_group_aggregate)
+            pack = key_pack_plan(child, node.group_keys)
         while True:
-            out = sort_group_aggregate(child, node.group_keys, aggs,
-                                       capacity)
+            if pack is not None:
+                kmins, bits = pack
+                out = packed_sort_group_aggregate(
+                    child, jnp.asarray(kmins), node.group_keys, bits,
+                    aggs, capacity)
+            else:
+                out = sort_group_aggregate(child, node.group_keys, aggs,
+                                           capacity)
             n_groups = int(out.live.sum())
             if n_groups < capacity or capacity >= child.capacity:
                 break
@@ -454,8 +496,16 @@ class Executor:
     # itself is cheap (ascending-index gathers are quasi-sequential HBM)
     COMPACT_SHRINK = 2
 
-    def maybe_compact(self, batch: Batch) -> Batch:
-        live = int(jnp.sum(batch.live))
+    def maybe_compact(self, batch: Batch,
+                      live: Optional[int] = None) -> Batch:
+        """Compact when live rows shrank enough. `live` should be passed
+        when the caller already synced a row count (join totals): the
+        device round trip for jnp.sum is ~60ms over a tunneled chip, so
+        every avoidable sync matters to end-to-end latency."""
+        if live is None:
+            if batch.capacity < (1 << 16):
+                return batch          # too small for compaction to pay
+            live = int(jnp.sum(batch.live))
         new_cap = bucket_capacity(live)
         if new_cap * self.COMPACT_SHRINK <= batch.capacity:
             self.stats.dynamic_filter_compactions += 1
@@ -476,7 +526,7 @@ class Executor:
         if node.build_unique:
             out = self.try_unique_join(node, probe, build, domain)
             if out is not None:
-                return self.maybe_compact(out)
+                return out            # already compacted (fused sync)
             # planner's uniqueness proof was wrong — degrade gracefully
             self.stats.join_fallbacks += 1
         cap = probe.capacity
@@ -491,8 +541,10 @@ class Executor:
                 self.stats.join_domain_fallbacks += 1
                 continue
             if total <= cap:
-                return self.maybe_compact(out) if node.kind == "inner" \
-                    else out
+                # `total` IS the live row count: reuse it instead of
+                # paying a second device sync inside maybe_compact
+                return self.maybe_compact(out, live=total) \
+                    if node.kind == "inner" else out
             cap = bucket_capacity(total)  # coarse: caches across runs
             self.stats.join_expansion_retries += 1
 
@@ -503,28 +555,42 @@ class Executor:
         network); dense LUT / sorted probing remain for membership and
         wide-row fallbacks. None = build had duplicate keys (caller
         expands)."""
-        # the multi-operand sort stops compiling around ~48M x 11 operands
-        # (TPU AOT compiler OOM); above the gate the dense-LUT/gather path
-        # carries the join
-        merge_ok = (probe.capacity + build.capacity) * \
-            max(1, len(probe.columns) + len(build.columns)) <= (1 << 28)
+        # Compile-cost gate for the multi-operand merge sort, measured in
+        # SORT OPERAND-ELEMENTS (rows x sort operands, where each column
+        # contributes data+valid operands). Measured on v5e: ~240M
+        # operand-elements compile in ~2 min, ~190M in the merge kernel
+        # ran past 10 MINUTES (its flood scans compound the sort), while
+        # <64M compiles in tens of seconds. Above the gate the dense-LUT
+        # /gather path carries the join: it compiles in seconds at any
+        # size (9.4s at 60M measured) and runs at gather speed.
+        n_sort_ops = 2 * (len(probe.columns) + len(build.columns)) + 4
+        merge_ok = n_sort_ops <= MAX_SORT_OPERANDS and \
+            (probe.capacity + build.capacity) <= SORT_SMALL_ROWS
+        # every branch fuses (dup[, oob], live-count) into ONE device
+        # fetch, then compacts with the known count — one tunnel round
+        # trip per join instead of three
         if node.kind in ("inner", "left") and merge_ok and \
                 len(probe.columns) <= 63 and len(build.columns) <= 63:
             out, dup = join_unique_build_merge(
                 probe, build, node.left_keys, node.right_keys, node.kind)
-            return out if int(dup) == 0 else None
+            dup, live = (int(v) for v in np.asarray(jnp.stack(
+                [dup, jnp.sum(out.live, dtype=dup.dtype)])))
+            return self.maybe_compact(out, live=live) if dup == 0 else None
         if domain is not None:
             out, dup, oob = join_unique_build_dense(
                 probe, build, node.left_keys, node.right_keys,
                 node.kind, domain)
-            dup, oob = (int(v) for v in np.asarray(
-                jnp.stack([dup, oob])))
+            dup, oob, live = (int(v) for v in np.asarray(jnp.stack(
+                [dup, oob, jnp.sum(out.live, dtype=dup.dtype)])))
             if oob == 0:
-                return out if dup == 0 else None
+                return self.maybe_compact(out, live=live) \
+                    if dup == 0 else None
             self.stats.join_domain_fallbacks += 1
         out, dup = join_unique_build(probe, build, node.left_keys,
                                      node.right_keys, node.kind)
-        return out if int(dup) == 0 else None
+        dup, live = (int(v) for v in np.asarray(jnp.stack(
+            [dup, jnp.sum(out.live, dtype=dup.dtype)])))
+        return self.maybe_compact(out, live=live) if dup == 0 else None
 
     def apply_dynamic_filter(self, node: L.JoinNode, probe: Batch,
                              build: Batch) -> Batch:
@@ -553,11 +619,12 @@ class Executor:
             pk = probe.columns[pk_i]
             keep = pk.valid & (pk.data >= kmin) & (pk.data <= kmax)
             probe = probe.with_live(probe.live & keep)
-        live = int(jnp.sum(probe.live))
-        new_cap = pad_capacity(live)
-        if new_cap * 4 <= probe.capacity:
-            self.stats.dynamic_filter_compactions += 1
-            probe = compact_batch(probe, new_cap)
+        if probe.capacity >= (1 << 16):   # small probes: skip the sync
+            live = int(jnp.sum(probe.live))
+            new_cap = pad_capacity(live)
+            if new_cap * 4 <= probe.capacity:
+                self.stats.dynamic_filter_compactions += 1
+                probe = compact_batch(probe, new_cap)
         return probe
 
     def run_mark_join(self, node: L.JoinNode, probe: Batch,
@@ -645,20 +712,24 @@ class Executor:
     def validate_key_ranges(self, batch: Batch, keys: tuple) -> None:
         if len(keys) <= 1:
             return
+        stats = []                     # one fused device fetch, not 2/key
         for ki in keys[1:]:
-            hi = int(jnp.max(jnp.where(batch.live,
-                                       batch.columns[ki].data, 0)))
-            lo = int(jnp.min(jnp.where(batch.live,
-                                       batch.columns[ki].data, 0)))
-            if lo < 0 or hi >= (1 << 31):
+            masked = jnp.where(batch.live, batch.columns[ki].data, 0)
+            stats.append(jnp.max(masked).astype(jnp.int64))
+            stats.append(jnp.min(masked).astype(jnp.int64))
+        vals = np.asarray(jnp.stack(stats))
+        for j in range(0, len(vals), 2):
+            if vals[j + 1] < 0 or vals[j] >= (1 << 31):
                 raise RuntimeError(
                     "multi-column join key outside packable range")
 
     def result_to_host(self, root: L.OutputNode, batch: Batch):
         """Compact + return (names, columns, valids) on host. Selective
         results compact on device first so the host fetch moves live rows,
-        not padded capacity (a 60M-capacity TopN result is 10 rows)."""
-        if batch.columns:
+        not padded capacity (a 60M-capacity TopN result is 10 rows).
+        Small batches skip the live-count probe: its device sync costs a
+        tunnel round trip and the fetch moves little data anyway."""
+        if batch.columns and batch.capacity >= (1 << 16):
             live = int(jnp.sum(batch.live))
             new_cap = bucket_capacity(live)
             if new_cap * 4 <= batch.capacity:
@@ -693,14 +764,38 @@ def remap_codes(batch: Batch, remaps) -> Batch:
     return Batch(tuple(cols), batch.live)
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
+# XLA TPU compile cost for lax.sort blows up in BOTH dimensions
+# (measured v5e): rows x operands — 60M x 4 operands = 119s, 60M x 12 =
+# 385s — and operand count alone: a 1.57M x 22-operand sort ran past 8
+# MINUTES while a 22-argument non-sort kernel compiled in 1.4s. So big
+# sorts must stay under an operand-element budget AND a hard operand
+# cap; above either, sort the minimum (keys + index) and move payload
+# columns with gathers (~1.6s per 60M column at runtime, compile in
+# seconds).
+SORT_COMPILE_BUDGET = 1 << 26
+MAX_SORT_OPERANDS = 12
+# rows below which a multi-operand sort still compiles in seconds;
+# above it every sort should be (packed key, index) or argsort+gather
+SORT_SMALL_ROWS = 1 << 19
+
+
 def compact_batch(batch: Batch, new_capacity: int) -> Batch:
-    """Move live rows (in order) into a smaller-capacity batch — ONE
-    multi-operand stable sort by deadness, then free slicing. A
-    gather-based compaction costs ~1.6s per 60M column on v5e (XLA TPU
-    gather is ~0.3GB/s regardless of index locality) while the sort
-    network moves all columns at once in ~0.7s (SURVEY.md §7 hard part 1).
+    """Move live rows (in order) into a smaller-capacity batch.
+    Small shapes: ONE multi-operand stable sort by deadness + free
+    slicing (the fastest primitive on TPU is the sort network,
+    SURVEY.md §7 hard part 1). Large shapes: 2-operand argsort of
+    deadness + per-column gathers, trading gather runtime for a compile
+    that finishes (SORT_COMPILE_BUDGET).
     Caller guarantees new_capacity >= live count."""
+    n_operands = 2 + 2 * len(batch.columns)
+    if batch.capacity <= SORT_SMALL_ROWS and \
+            n_operands <= MAX_SORT_OPERANDS:
+        return _compact_sort(batch, new_capacity)
+    return _compact_gather(batch, new_capacity)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _compact_sort(batch: Batch, new_capacity: int) -> Batch:
     operands = [(~batch.live).astype(jnp.int8)]
     for c in batch.columns:
         operands.append(c.data)
@@ -712,6 +807,15 @@ def compact_batch(batch: Batch, new_capacity: int) -> Batch:
         cols.append(Column(out[1 + 2 * i][:new_capacity],
                            out[2 + 2 * i][:new_capacity]))
     return Batch(tuple(cols), out[-1][:new_capacity])
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _compact_gather(batch: Batch, new_capacity: int) -> Batch:
+    idx = jnp.argsort(~batch.live, stable=True)[:new_capacity]
+    cols = tuple(Column(jnp.take(c.data, idx, axis=0),
+                        jnp.take(c.valid, idx, axis=0))
+                 for c in batch.columns)
+    return Batch(cols, jnp.take(batch.live, idx, axis=0))
 
 
 @jax.jit

@@ -88,6 +88,8 @@ class Session:
             return self.execute_show(stmt, t0)
         if isinstance(stmt, A.SetSession):
             return self.execute_set_session(stmt, t0)
+        if isinstance(stmt, (A.Update, A.Delete, A.MergeInto)):
+            return self.execute_dml(stmt, t0)
         if isinstance(stmt, (A.CreateTable, A.DropTable, A.InsertInto)):
             return self.execute_ddl(stmt, t0)
         raise NotImplementedError(type(stmt).__name__)
@@ -260,6 +262,204 @@ class Session:
         n = self.catalog.connector(cat).insert(sch, tbl, arrays, valids,
                                                fields)
         # stored table changed: refresh any cached scans
+        self.executor._scan_cache.clear()
+        return QueryResult(["rows"], [(n,)], time.monotonic() - t0)
+
+    # ---- UPDATE / DELETE / MERGE (row-id + delete-mask scheme) ----------
+
+    def _register_shadow(self, conn, sch: str, tbl: str) -> str:
+        """Copy of the target with a hidden $rowid column, registered
+        under a reserved name — mutations are planned as ordinary queries
+        over it (reference: the merge row-change paradigm routes rows by
+        target row id, MergeWriterOperator.java)."""
+        import numpy as np
+        from ..batch import Field, Schema
+        from ..connectors.tpch.datagen import TableData
+        from ..types import BIGINT
+        t = conn.get_table(sch, tbl)
+        cols = list(t.columns) + [np.arange(t.num_rows, dtype=np.int64)]
+        valids = None if t.valids is None else list(t.valids) + [None]
+        fields = tuple(t.schema.fields) + (Field("$rowid", BIGINT),)
+        shadow = f"{tbl}$dml"
+        conn.drop_table(sch, shadow, if_exists=True)
+        conn.create_table(sch, shadow,
+                          TableData(tbl, Schema(fields), cols,
+                                    valids=valids))
+        return shadow
+
+    def _dml_conn(self, cat: str):
+        conn = self.catalog.connector(cat)
+        if not hasattr(conn, "delete_rows"):
+            from ..planner.analyzer import AnalysisError
+            raise AnalysisError(
+                f"connector {cat!r} does not support row-level DML")
+        return conn
+
+    @staticmethod
+    def _sql_type_name(dt) -> str:
+        if dt.kind is TypeKind.DECIMAL:
+            return f"decimal({dt.precision},{dt.scale})"
+        return dt.kind.value
+
+    def _coerced_assignments(self, conn, sch, tbl, assignments):
+        """Validate assignment targets and wrap each value in a cast to
+        the column's declared type — the stored representation must be
+        the target column's, not the expression's (e.g. a scale-1
+        decimal literal written to a decimal(10,2) column)."""
+        from ..planner.analyzer import AnalysisError
+        schema = conn.get_table(sch, tbl).schema
+        names = {f.name for f in schema.fields}
+        out = []
+        for col, expr in assignments:
+            if col not in names:
+                raise AnalysisError(
+                    f"UPDATE target column {col!r} does not exist")
+            dt = schema.field(col).dtype
+            if dt.kind is not TypeKind.VARCHAR:
+                expr = A.CastExpr(expr, self._sql_type_name(dt))
+            out.append((col, expr))
+        return out
+
+    def execute_dml(self, stmt, t0) -> QueryResult:
+        import numpy as np
+        from ..planner.analyzer import AnalysisError
+        if isinstance(stmt, A.MergeInto):
+            return self.execute_merge(stmt, t0)
+        cat, sch, tbl = self.resolve_table(stmt.table)
+        conn = self._dml_conn(cat)
+        assignments = self._coerced_assignments(
+            conn, sch, tbl, stmt.assignments) \
+            if isinstance(stmt, A.Update) else ()
+        shadow = self._register_shadow(conn, sch, tbl)
+        try:
+            items = [A.SelectItem(A.Identifier(("$rowid",)), "$rowid")]
+            if isinstance(stmt, A.Update):
+                for j, (_, expr) in enumerate(assignments):
+                    items.append(A.SelectItem(expr, f"$v{j}"))
+            q = A.Query(select=tuple(items), distinct=False,
+                        relation=A.TableRef((cat, sch, shadow),
+                                            alias=tbl),
+                        where=stmt.where, group_by=(), having=None,
+                        order_by=(), limit=None)
+            fields, arrays, valids = self.query_to_columns(q)
+            ids = np.asarray(arrays[0], dtype=np.int64)
+            if isinstance(stmt, A.Delete):
+                n = conn.delete_rows(sch, tbl, ids)
+            else:
+                updates = {col: (arrays[1 + j], valids[1 + j],
+                                 fields[1 + j])
+                           for j, (col, _) in enumerate(assignments)}
+                n = conn.update_rows(sch, tbl, ids, updates)
+        finally:
+            conn.drop_table(sch, shadow, if_exists=True)
+        self.executor._scan_cache.clear()
+        return QueryResult(["rows"], [(n,)], time.monotonic() - t0)
+
+    def execute_merge(self, stmt: "A.MergeInto", t0) -> QueryResult:
+        """MERGE: matched rows route to UPDATE/DELETE, unmatched source
+        rows to INSERT — both decided against the pre-merge table state
+        (the reference's RowChangeProcessor semantics). Supported shape:
+        at most one WHEN MATCHED and one WHEN NOT MATCHED clause."""
+        import numpy as np
+        from ..planner.analyzer import AnalysisError
+        cat, sch, tbl = self.resolve_table(stmt.target)
+        conn = self._dml_conn(cat)
+        alias = stmt.target_alias or tbl
+        matched = [c for c in stmt.clauses if c.matched]
+        unmatched = [c for c in stmt.clauses if not c.matched]
+        if len(matched) > 1 or len(unmatched) > 1:
+            raise AnalysisError(
+                "MERGE supports one WHEN MATCHED and one "
+                "WHEN NOT MATCHED clause")
+        if unmatched and unmatched[0].action != "insert":
+            raise AnalysisError("WHEN NOT MATCHED requires INSERT")
+        shadow = self._register_shadow(conn, sch, tbl)
+        n = 0
+        try:
+            tref = A.TableRef((cat, sch, shadow), alias=alias)
+            if matched:
+                mc = matched[0]
+                massign = self._coerced_assignments(
+                    conn, sch, tbl, mc.assignments)
+                items = [A.SelectItem(A.Identifier((alias, "$rowid")),
+                                      "$rowid")]
+                for j, (_, expr) in enumerate(massign):
+                    items.append(A.SelectItem(expr, f"$v{j}"))
+                q = A.Query(select=tuple(items), distinct=False,
+                            relation=A.Join("inner", stmt.source, tref,
+                                            stmt.on),
+                            where=mc.condition, group_by=(),
+                            having=None, order_by=(), limit=None)
+                fields, arrays, valids = self.query_to_columns(q)
+                ids = np.asarray(arrays[0], dtype=np.int64)
+                if len(np.unique(ids)) != len(ids):
+                    raise RuntimeError(
+                        "MERGE: one target row matched more than one "
+                        "source row")
+                if mc.action == "delete":
+                    n += conn.delete_rows(sch, tbl, ids)
+                elif mc.action == "update":
+                    updates = {col: (arrays[1 + j], valids[1 + j],
+                                     fields[1 + j])
+                               for j, (col, _) in enumerate(massign)}
+                    n += conn.update_rows(sch, tbl, ids, updates)
+                else:
+                    raise AnalysisError(
+                        "WHEN MATCHED requires UPDATE or DELETE")
+            if unmatched:
+                ic = unmatched[0]
+                sub = A.Query(select=(A.SelectItem(A.NumberLit("1"),
+                                                   "x"),),
+                              distinct=False, relation=tref,
+                              where=stmt.on, group_by=(), having=None,
+                              order_by=(), limit=None)
+                where: A.Node = A.ExistsPredicate(sub, negated=True)
+                if ic.condition is not None:
+                    where = A.BinaryOp("and", where, ic.condition)
+                # coerce each inserted value to its target column type
+                tschema = conn.get_table(sch, tbl).schema
+                inames = [c.lower() for c in ic.insert_columns] or \
+                    [f.name for f in tschema.fields]
+                if len(inames) != len(ic.insert_values):
+                    raise AnalysisError(
+                        "MERGE INSERT column/value count mismatch")
+                ivalues = []
+                for cname, e in zip(inames, ic.insert_values):
+                    if cname not in {f.name for f in tschema.fields}:
+                        raise AnalysisError(
+                            f"MERGE INSERT column {cname!r} does not "
+                            f"exist")
+                    dt = tschema.field(cname).dtype
+                    if dt.kind is not TypeKind.VARCHAR:
+                        e = A.CastExpr(e, self._sql_type_name(dt))
+                    ivalues.append(e)
+                items = tuple(A.SelectItem(e, f"$c{j}") for j, e in
+                              enumerate(ivalues))
+                q2 = A.Query(select=items, distinct=False,
+                             relation=stmt.source, where=where,
+                             group_by=(), having=None, order_by=(),
+                             limit=None)
+                fields, arrays, valids = self.query_to_columns(q2)
+                target = conn.get_table(sch, tbl)
+                by_name = dict(zip(inames, range(len(inames))))
+                n_ins = len(arrays[0]) if arrays else 0
+                full_arrays, full_valids, full_fields = [], [], []
+                for f in target.schema.fields:
+                    j = by_name.get(f.name)
+                    if j is None:     # unmentioned column: NULL
+                        full_arrays.append(
+                            np.zeros(n_ins, dtype=f.dtype.np_dtype))
+                        full_valids.append(
+                            np.zeros(n_ins, dtype=np.bool_))
+                        full_fields.append(f)
+                    else:
+                        full_arrays.append(np.asarray(arrays[j]))
+                        full_valids.append(valids[j])
+                        full_fields.append(fields[j])
+                n += conn.insert(sch, tbl, full_arrays, full_valids,
+                                 full_fields)
+        finally:
+            conn.drop_table(sch, shadow, if_exists=True)
         self.executor._scan_cache.clear()
         return QueryResult(["rows"], [(n,)], time.monotonic() - t0)
 

@@ -199,6 +199,29 @@ def sort_group_aggregate(batch: Batch, key_indices: tuple, aggs: tuple,
         diff = diff | (op != jnp.roll(op, 1))
     first = jnp.arange(n) == 0
     boundary = live_s & (first | diff)
+
+    # distinct markers: first occurrence of each distinct valid value
+    # within a group (the distinct column participates in the sort, so
+    # duplicates are adjacent — Trino: MarkDistinct + filtered accumulator)
+    distinct_fresh = {}
+    for di in distinct_cols:
+        p = distinct_pos[di]
+        dvinv_s, ddata_s = sorted_ops[p], sorted_ops[p + 1]
+        distinct_fresh[di] = boundary | \
+            (ddata_s != jnp.roll(ddata_s, 1)) | \
+            (dvinv_s != jnp.roll(dvinv_s, 1))
+    return _grouped_reduce(batch, key_indices, aggs, out_capacity, perm,
+                           live_s, boundary, distinct_fresh)
+
+
+def _grouped_reduce(batch: Batch, key_indices: tuple, aggs: tuple,
+                    out_capacity: int, perm, live_s, boundary,
+                    distinct_fresh) -> Batch:
+    """Shared segment machinery for the sorted aggregation kernels: given
+    the sort permutation and group boundaries, locate segment extents and
+    reduce every aggregate — used by both the general multi-operand kernel
+    and the packed 2-operand kernel (traced inside their jits)."""
+    n = batch.capacity
     seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1      # 0-based group id
     num_groups = boundary.sum()
 
@@ -218,9 +241,9 @@ def sort_group_aggregate(batch: Batch, key_indices: tuple, aggs: tuple,
                         jnp.clip(next_start - 1, 0, n - 1), n - 1)
 
     out_cols = []
+    rep = perm[start_c]                   # representative row per group
     for ki in key_indices:
         col = batch.columns[ki]
-        rep = perm[start_c]               # representative row per group
         out_cols.append(Column(data=col.data[rep],
                                valid=col.valid[rep] & group_live))
 
@@ -241,14 +264,7 @@ def sort_group_aggregate(batch: Batch, key_indices: tuple, aggs: tuple,
         data_s = col.data[perm]
         valid_s = col.valid[perm] & live_s
         if spec.distinct:
-            # first occurrence of each distinct valid value within a group:
-            # the distinct column participates in the sort, so duplicates
-            # are adjacent (Trino: MarkDistinct + filtered accumulator)
-            pos = distinct_pos[spec.arg_index]
-            dvinv_s, ddata_s = sorted_ops[pos], sorted_ops[pos + 1]
-            fresh = boundary | (ddata_s != jnp.roll(ddata_s, 1)) | \
-                (dvinv_s != jnp.roll(dvinv_s, 1))
-            marker = valid_s & fresh
+            marker = valid_s & distinct_fresh[spec.arg_index]
             if spec.func == "count":
                 out_cols.append(Column(data=seg_total(
                     marker.astype(jnp.int64)), valid=group_live))
@@ -278,6 +294,80 @@ def sort_group_aggregate(batch: Batch, key_indices: tuple, aggs: tuple,
             state = jnp.where(group_live, scanned[end_pos], ident)
         out_cols.append(Column(data=state, valid=group_live & (cnt > 0)))
     return Batch(columns=tuple(out_cols), live=group_live)
+
+
+# --------------------------------------------------------------------------
+# packed sort strategy — range-compressed keys, 2-operand sort
+# --------------------------------------------------------------------------
+
+def key_pack_plan(batch: Batch, key_indices: tuple):
+    """Measure per-key [min, max] on device (ONE fused fetch) and derive a
+    static packing layout: key i occupies ceil(log2(span+3)) bits; slot 0
+    and the top slot stay free for NULL placement and direction
+    reversal. Returns (kmins host array, bits tuple) or None if the
+    combined width exceeds 62 bits or a key isn't integer-typed.
+
+    Why: XLA TPU compile cost for lax.sort is dominated by OPERAND COUNT
+    (measured v5e: 2 operands ~40s, 4 ~170s, 6 ~460s, nearly flat in
+    rows). Collapsing any number of integer keys into ONE int64 keeps
+    every big sort at (packed, index) — the same range-compression idea
+    as BigintGroupByHash's dense path, applied to the sort domain."""
+    import numpy as np
+    stats = []
+    for ki in key_indices:
+        col = batch.columns[ki]
+        if not jnp.issubdtype(col.data.dtype, jnp.integer) and \
+                col.data.dtype != jnp.bool_:
+            return None
+        m = batch.live & col.valid
+        data = col.data.astype(jnp.int64)
+        big = jnp.iinfo(jnp.int64)
+        stats.append(jnp.min(jnp.where(m, data, big.max)))
+        stats.append(jnp.max(jnp.where(m, data, big.min)))
+    vals = np.asarray(jnp.stack(stats))
+    kmins, bits = [], []
+    total = 0
+    for i in range(len(key_indices)):
+        lo, hi = int(vals[2 * i]), int(vals[2 * i + 1])
+        if hi < lo:                 # all-NULL key column
+            lo, hi = 0, 0
+        b = max(2, int(hi - lo + 3).bit_length())
+        kmins.append(lo)
+        bits.append(b)
+        total += b
+    if total > 62:
+        return None
+    return np.asarray(kmins, dtype=np.int64), tuple(bits)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def packed_sort_group_aggregate(batch: Batch, kmins, key_indices: tuple,
+                                key_bits: tuple, aggs: tuple,
+                                out_capacity: int) -> Batch:
+    """sort_group_aggregate with all keys packed into one int64 (see
+    key_pack_plan). Dead rows pack to int64.max so they sort last; group
+    keys are read back from representative rows (gathers at G positions,
+    not N). No DISTINCT support (callers route distinct to the general
+    kernel)."""
+    n = batch.capacity
+    packed = jnp.zeros(n, dtype=jnp.int64)
+    for j, (ki, b) in enumerate(zip(key_indices, key_bits)):
+        col = batch.columns[ki]
+        norm = col.data.astype(jnp.int64) - kmins[j] + 1
+        norm = jnp.where(col.valid, norm, 0)      # NULL slot
+        packed = (packed << b) | norm
+    packed = jnp.where(batch.live, packed,
+                       jnp.iinfo(jnp.int64).max)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    packed_s, perm = jax.lax.sort((packed, idx), num_keys=1,
+                                  is_stable=True)
+    live_s = batch.live[perm]
+
+    first = jnp.arange(n) == 0
+    diff = packed_s != jnp.roll(packed_s, 1)
+    boundary = live_s & (first | diff)
+    return _grouped_reduce(batch, key_indices, aggs, out_capacity, perm,
+                           live_s, boundary, {})
 
 
 # --------------------------------------------------------------------------

@@ -60,6 +60,45 @@ def sort_batch(batch: Batch, keys: tuple, limit) -> Batch:
     return Batch(columns=cols, live=live)
 
 
+def sort_pack_plan(batch: Batch, keys: tuple):
+    """Range-compress integer ORDER BY keys into one int64 (direction and
+    null placement baked into the rank encoding) so the big sort is
+    always (packed, index) — measurement and bit layout shared with the
+    aggregation kernels (ops.aggregate.key_pack_plan; the +3 slack there
+    keeps the DESC rank range clear of the nulls-first slot 0 and the
+    ASC range clear of the nulls-last slot 2^b - 1)."""
+    from .aggregate import key_pack_plan
+    return key_pack_plan(batch, tuple(idx for idx, _, _ in keys))
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def sort_batch_packed(batch: Batch, kmins, keys: tuple, key_bits: tuple,
+                      limit) -> Batch:
+    """sort_batch via one packed int64 key (see sort_pack_plan): rank
+    within each key's field realizes ASC/DESC + null placement; dead
+    rows pack to int64.max. The sort itself is 2 operands at any key
+    count."""
+    n = batch.capacity
+    packed = jnp.zeros(n, dtype=jnp.int64)
+    for j, ((idx, asc, nf), b) in enumerate(zip(keys, key_bits)):
+        col = batch.columns[idx]
+        span_max = (1 << b) - 1
+        norm = col.data.astype(jnp.int64) - kmins[j] + 1
+        rank = norm if asc else (span_max - 1) - norm
+        null_slot = 0 if nf else span_max
+        rank = jnp.where(col.valid, rank, null_slot)
+        packed = (packed << b) | rank
+    packed = jnp.where(batch.live, packed, jnp.iinfo(jnp.int64).max)
+    idx_arr = jnp.arange(n, dtype=jnp.int32)
+    _, perm = jax.lax.sort((packed, idx_arr), num_keys=1, is_stable=True)
+    cols = tuple(Column(data=c.data[perm], valid=c.valid[perm])
+                 for c in batch.columns)
+    live = batch.live[perm]
+    if limit is not None:
+        live = live & (jnp.arange(n) < limit)
+    return Batch(columns=cols, live=live)
+
+
 @jax.jit
 def limit_batch(batch: Batch, count: jax.Array) -> Batch:
     """Keep the first `count` live rows (in current order)."""

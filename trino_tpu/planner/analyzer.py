@@ -272,11 +272,10 @@ class ExpressionLowerer:
             arg = self.lower(node.arg)
             vals = [self.lower(v) for v in node.values]
             if arg.dtype.kind is TypeKind.VARCHAR:
-                strings = {v.value for v in vals
-                           if isinstance(v, _StringConst)}
-                if len(strings) != len(vals):
+                if not all(isinstance(v, _StringConst) for v in vals):
                     raise AnalysisError("IN on varchar requires string "
                                         "literals")
+                strings = {v.value for v in vals}   # duplicates are fine
                 pred = self.dict_lut(arg, lambda s: s in strings)
             else:
                 lits = []

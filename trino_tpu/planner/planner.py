@@ -1143,10 +1143,23 @@ class Planner:
         if q.order_by:
             plain_from = self._plain_from
             proj = rel.node
-            can_hide = (not has_agg and not q.distinct and
-                        isinstance(proj, L.ProjectNode) and
-                        plain_from is not None and
-                        plain_from[0] is proj.child)
+            lower_hidden = None
+            if (not has_agg and not q.distinct and
+                    isinstance(proj, L.ProjectNode) and
+                    plain_from is not None and
+                    plain_from[0] is proj.child):
+                _, from_scope, wslots = plain_from
+                lower_hidden = ExpressionLowerer(
+                    from_scope, planner=self, window_slots=wslots).lower
+            else:
+                # aggregation: the post-agg rewrite closure lowers
+                # ORDER BY expressions over (group keys, agg slots,
+                # grouping() columns)
+                post_agg = getattr(self, "_post_agg", None)
+                if not q.distinct and post_agg is not None and \
+                        post_agg[0] is rel.node:
+                    lower_hidden = post_agg[1]
+            can_hide = lower_hidden is not None
             idxs = []
             for item in q.order_by:
                 try:
@@ -1157,14 +1170,11 @@ class Planner:
                     idx = None
                 idxs.append(idx)
             if any(i is None for i in idxs):
-                _, from_scope, wslots = plain_from
-                lowerer = ExpressionLowerer(from_scope, planner=self,
-                                            window_slots=wslots)
                 exprs = list(proj.exprs)
                 out_cols = list(proj.output)
                 for k, item in enumerate(q.order_by):
                     if idxs[k] is None:
-                        e = materialize_string(lowerer.lower(item.expr))
+                        e = materialize_string(lower_hidden(item.expr))
                         exprs.append(e)
                         out_cols.append((f"$sort{len(out_cols)}", e.dtype))
                         idxs[k] = len(out_cols) - 1
@@ -1741,6 +1751,10 @@ class Planner:
 
         post_node = L.ProjectNode(current, tuple(post_exprs),
                                   tuple(out_cols))
+        # ORDER BY may reference aggregation-scope expressions not in the
+        # select list (e.g. CASE over grouping() keys); keep the rewrite
+        # closure so the caller can lower them as hidden sort columns
+        self._post_agg = (post_node, rewrite)
         return (PlannedRelation(post_node, Scope(final_scope)),
                 post_exprs, names)
 
@@ -1877,12 +1891,14 @@ class Planner:
             return self.plan_in_subquery(rel, c)
         if isinstance(c, A.BinaryOp) and c.op in ("=", "<>", "<", "<=",
                                                   ">", ">="):
-            if isinstance(c.right, A.ScalarSubquery):
-                return self.plan_correlated_scalar(rel, c.op, c.left,
-                                                   c.right.query)
-            if isinstance(c.left, A.ScalarSubquery):
-                return self.plan_correlated_scalar(rel, flip(c.op), c.right,
-                                                   c.left.query)
+            # the scalar subquery may sit anywhere in the comparison
+            # (e.g. price > 1.2 * (SELECT avg ...)); decorrelate it and
+            # re-lower the whole predicate with the subquery's value
+            # column spliced in
+            subs: List[A.ScalarSubquery] = []
+            collect_scalar_subqueries(c, subs)
+            if len(subs) == 1:
+                return self.plan_correlated_scalar(rel, c, subs[0])
         if isinstance(c, A.BinaryOp) and c.op == "or":
             return self.plan_disjunctive_exists(rel, c)
         return None
@@ -2077,12 +2093,14 @@ class Planner:
             null_aware=c.negated)
         return PlannedRelation(node, outer.scope)
 
-    def plan_correlated_scalar(self, outer: PlannedRelation, op: str,
-                               outer_ast: A.Node,
-                               subq: A.Query) -> PlannedRelation:
-        """expr <op> (SELECT agg(...) FROM ... WHERE corr) ->
-        group the subquery by its correlation keys, join, filter.
+    def plan_correlated_scalar(self, outer: PlannedRelation,
+                               conjunct: A.Node,
+                               sub: A.ScalarSubquery) -> PlannedRelation:
+        """Predicate containing (SELECT agg(...) FROM ... WHERE corr) ->
+        group the subquery by its correlation keys, join, re-lower the
+        whole predicate over outer ++ value column.
         (TransformCorrelatedScalarSubquery + aggregation decorrelation.)"""
+        subq = sub.query
         if len(subq.select) != 1 or subq.select[0].expr is None:
             raise AnalysisError("scalar subquery must select one expression")
         if not contains_aggregate(subq.select[0].expr):
@@ -2111,8 +2129,11 @@ class Planner:
         agg_rel, _, _ = self.plan_aggregation(synth, inner)
 
         k = len(corr)
+        # LEFT join: outer rows with an empty correlated group survive
+        # with a NULL value column (SQL scalar-subquery-over-empty
+        # semantics); see the marker handling below
         join = self.make_join(
-            "inner", outer.node, agg_rel.node,
+            "left", outer.node, agg_rel.node,
             tuple(o for o, _ in corr), tuple(range(k)), None, True,
             probe_fields=[self._scope_field(outer.scope, o)
                           for o, _ in corr],
@@ -2121,10 +2142,35 @@ class Planner:
         out = join.output
         n_outer = len(outer.node.output)
         val_name, val_t = agg_rel.node.output[k]
-        val_ref = ir.ColumnRef(n_outer + k, val_t, val_name)
-        outer_e = ExpressionLowerer(outer.scope, planner=self).lower(
-            outer_ast)
-        pred = ir.Compare(op, outer_e, val_ref)
+        # splice the subquery's value column into the predicate: replace
+        # the ScalarSubquery AST with a hidden identifier bound to it,
+        # then lower the whole conjunct (arithmetic around the subquery
+        # included) over outer ++ value.
+        # Empty-group semantics: the LEFT join leaves the value NULL for
+        # outer rows with no correlated group — correct for sum/avg/min/
+        # max (NULL over empty) and for comparisons (unknown filters the
+        # row); a BARE count is 0 over an empty group, so it coalesces.
+        marker: A.Node = A.Identifier(("$corrval",))
+        sel = subq.select[0].expr
+        bare_count = isinstance(sel, A.FunctionCall) and \
+            sel.name == "count"
+        if not bare_count:
+            for node_ in walk_ast(sel):
+                if isinstance(node_, A.FunctionCall) and \
+                        node_.name == "count":
+                    raise AnalysisError(
+                        "correlated scalar subquery mixing count() into "
+                        "a larger expression is not supported (empty "
+                        "groups would need per-expression evaluation)")
+        if bare_count:
+            marker = A.FunctionCall("coalesce",
+                                    (marker, A.NumberLit("0")))
+        pred_ast = ast_replace(conjunct, sub, marker)
+        scope2 = Scope(list(outer.scope.columns) +
+                       [ScopeColumn(None, "$corrval", val_t,
+                                    n_outer + k, None)])
+        low = ExpressionLowerer(scope2, planner=self)
+        pred = low.to_bool(low.lower(pred_ast))
         node = L.FilterNode(join, pred, out)
         # visible scope stays the outer's; joined agg columns are hidden
         return PlannedRelation(node, outer.scope)
@@ -2200,6 +2246,50 @@ def as_equi(node: A.Node):
             isinstance(node.right, A.Identifier):
         return node.left.parts, node.right.parts
     return None
+
+
+def walk_ast(node: A.Node):
+    from .analyzer import ast_children
+    yield node
+    for ch in ast_children(node):
+        yield from walk_ast(ch)
+
+
+def collect_scalar_subqueries(node: A.Node, out: list) -> None:
+    """Find ScalarSubquery nodes in a predicate (not descending into
+    nested queries — each subquery is handled at its own level)."""
+    from .analyzer import ast_children
+    if isinstance(node, A.ScalarSubquery):
+        out.append(node)
+        return
+    if isinstance(node, (A.Query, A.SetOp)):
+        return
+    for ch in ast_children(node):
+        collect_scalar_subqueries(ch, out)
+
+
+def ast_replace(root: A.Node, target: A.Node, replacement: A.Node) -> A.Node:
+    """Rebuild an AST with `target` (by identity) swapped for
+    `replacement`; untouched subtrees keep their identity."""
+    import dataclasses as _dc
+    if root is target:
+        return replacement
+    if not _dc.is_dataclass(root):
+        return root
+    changes = {}
+    for f in _dc.fields(root):
+        v = getattr(root, f.name)
+        if isinstance(v, A.Node):
+            nv = ast_replace(v, target, replacement)
+            if nv is not v:
+                changes[f.name] = nv
+        elif isinstance(v, tuple) and any(isinstance(x, A.Node)
+                                          for x in v):
+            nv = tuple(ast_replace(x, target, replacement)
+                       if isinstance(x, A.Node) else x for x in v)
+            if any(a is not b for a, b in zip(nv, v)):
+                changes[f.name] = nv
+    return _dc.replace(root, **changes) if changes else root
 
 
 def collect_grouping_calls(node: A.Node, out: list) -> None:

@@ -323,3 +323,42 @@ class DropTable(Node):
 class InsertInto(Node):
     table: Tuple[str, ...]
     query: Node                     # Query | Values
+
+
+@dataclass(frozen=True)
+class Update(Node):
+    """UPDATE t SET c = expr, ... [WHERE pred]."""
+    table: Tuple[str, ...]
+    assignments: Tuple              # ((column_name, expr_ast), ...)
+    where: Optional[Node]
+
+
+@dataclass(frozen=True)
+class Delete(Node):
+    """DELETE FROM t [WHERE pred]."""
+    table: Tuple[str, ...]
+    where: Optional[Node]
+
+
+@dataclass(frozen=True)
+class MergeInto(Node):
+    """MERGE INTO target [alias] USING source [alias] ON cond
+    WHEN [NOT] MATCHED [AND cond] THEN UPDATE SET ... | DELETE |
+    INSERT (...) VALUES (...).
+    Clause order is significant (first matching clause wins, like the
+    reference's MergeProcessorOperator row routing)."""
+    target: Tuple[str, ...]
+    target_alias: Optional[str]
+    source: Node                    # relation AST (TableRef / derived)
+    on: Node
+    clauses: Tuple                  # tuple[MergeClause, ...]
+
+
+@dataclass(frozen=True)
+class MergeClause(Node):
+    matched: bool
+    condition: Optional[Node]       # the AND condition, if any
+    action: str                     # 'update' | 'delete' | 'insert'
+    assignments: Tuple = ()         # update: ((column_name, expr), ...)
+    insert_columns: Tuple = ()      # insert: (column_name, ...)
+    insert_values: Tuple = ()       # insert: (expr, ...)

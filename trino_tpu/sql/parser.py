@@ -99,6 +99,15 @@ class Parser:
             self.expect_kw("INTO")
             table = tuple(self.qualified_name())
             node = A.InsertInto(table, self.parse_query())
+        elif self.accept_kw("UPDATE"):
+            node = self.parse_update()
+        elif self.accept_kw("DELETE"):
+            self.expect_kw("FROM")
+            table = tuple(self.qualified_name())
+            where = self.parse_expr() if self.accept_kw("WHERE") else None
+            node = A.Delete(table, where)
+        elif self.accept_kw("MERGE"):
+            node = self.parse_merge()
         else:
             node = self.parse_query()
         self.accept_op(";")
@@ -405,6 +414,73 @@ class Parser:
         return A.OrderItem(expr, asc, nulls_first)
 
     # ---- relations --------------------------------------------------------
+
+    def parse_update(self) -> A.Node:
+        table = tuple(self.qualified_name())
+        self.expect_kw("SET")
+        assignments = []
+        while True:
+            col = self.qualified_name()[-1].lower()
+            self.expect_op("=")
+            assignments.append((col, self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        where = self.parse_expr() if self.accept_kw("WHERE") else None
+        return A.Update(table, tuple(assignments), where)
+
+    def parse_merge(self) -> A.Node:
+        self.expect_kw("INTO")
+        target = tuple(self.qualified_name())
+        target_alias = None
+        self.accept_kw("AS")
+        if self.peek().kind in ("name", "qident") and \
+                not self.at_kw("USING"):
+            target_alias = self.qualified_name()[0].lower()
+        self.expect_kw("USING")
+        source = self.table_primary()
+        self.expect_kw("ON")
+        on = self.parse_expr()
+        clauses = []
+        while self.accept_kw("WHEN"):
+            matched = not self.accept_kw("NOT")
+            self.expect_kw("MATCHED")
+            cond = self.parse_expr() if self.accept_kw("AND") else None
+            self.expect_kw("THEN")
+            if self.accept_kw("UPDATE"):
+                self.expect_kw("SET")
+                assignments = []
+                while True:
+                    col = self.qualified_name()[-1].lower()
+                    self.expect_op("=")
+                    assignments.append((col, self.parse_expr()))
+                    if not self.accept_op(","):
+                        break
+                clauses.append(A.MergeClause(matched, cond, "update",
+                                             tuple(assignments)))
+            elif self.accept_kw("DELETE"):
+                clauses.append(A.MergeClause(matched, cond, "delete"))
+            else:
+                self.expect_kw("INSERT")
+                cols = []
+                if self.accept_op("("):
+                    while True:
+                        cols.append(self.qualified_name()[-1].lower())
+                        if not self.accept_op(","):
+                            break
+                    self.expect_op(")")
+                self.expect_kw("VALUES")
+                self.expect_op("(")
+                vals = [self.parse_expr()]
+                while self.accept_op(","):
+                    vals.append(self.parse_expr())
+                self.expect_op(")")
+                clauses.append(A.MergeClause(matched, cond, "insert",
+                                             insert_columns=tuple(cols),
+                                             insert_values=tuple(vals)))
+        if not clauses:
+            self.fail("MERGE requires at least one WHEN clause")
+        return A.MergeInto(target, target_alias, source, on,
+                           tuple(clauses))
 
     def parse_relation(self) -> A.Node:
         left = self.join_chain()
