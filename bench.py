@@ -227,7 +227,29 @@ def numpy_q5(tables, chunk=1 << 26):
 # ---------------------------------------------------------------------------
 
 def q5_tables(scale: float, seed: int = 19920101):
-    """The q5 columns only, same shapes/distributions as datagen.py."""
+    """The q5 columns only, same shapes/distributions as datagen.py.
+    Persisted through the on-disk table cache (connectors/diskcache.py)
+    so generation cost is paid once per machine, not per bench run."""
+    from trino_tpu.connectors.diskcache import load_table, save_table
+    from trino_tpu.connectors.tpch.datagen import TableData as _TD
+    dataset = f"bench_q5_sf{scale:g}_s{seed}"
+    names = ["region", "nation", "supplier", "customer", "orders",
+             "lineitem"]
+    cached = {}
+    for nm in names:
+        t = load_table(dataset, nm, _TD)
+        if t is None:
+            break
+        cached[nm] = t
+    else:
+        return cached
+    tables = _q5_tables_generate(scale, seed)
+    for t in tables.values():
+        save_table(dataset, t)
+    return tables
+
+
+def _q5_tables_generate(scale: float, seed: int = 19920101):
     from trino_tpu.batch import Field, Schema
     from trino_tpu.connectors.tpch.datagen import (ENDDATE, NATIONS,
                                                    REGIONS, STARTDATE,

@@ -125,3 +125,48 @@ def test_replace_starts_with_strpos(session, oracle):
         "SELECT n_name FROM nation WHERE starts_with(n_name, 'I') "
         "ORDER BY n_name").rows
     assert got == [("INDIA",), ("INDONESIA",), ("IRAN",), ("IRAQ",)]
+
+
+def test_date_trunc(session):
+    r = session.execute("""
+        SELECT date_trunc('year', DATE '1994-07-15'),
+               date_trunc('quarter', DATE '1994-07-15'),
+               date_trunc('month', DATE '1994-07-15'),
+               date_trunc('week', DATE '1994-07-15'),
+               date_trunc('day', DATE '1994-07-15')""").rows[0]
+    assert [str(x) for x in r] == ["1994-01-01", "1994-07-01",
+                                   "1994-07-01", "1994-07-11",
+                                   "1994-07-15"]
+    grouped = session.execute("""
+        SELECT date_trunc('month', o_orderdate) m, count(*) c
+        FROM orders GROUP BY date_trunc('month', o_orderdate)
+        ORDER BY m LIMIT 3""").rows
+    assert all(str(m).endswith("-01") for m, _ in grouped)
+
+
+def test_split_part_and_regexp_like(session):
+    r = session.execute("""
+        SELECT count(*) FROM customer
+        WHERE split_part(c_phone, '-', 1) = '25'""").rows[0][0]
+    r2 = session.execute("""
+        SELECT count(*) FROM customer
+        WHERE regexp_like(c_phone, '^25-')""").rows[0][0]
+    assert r == r2
+    assert r > 0
+
+
+def test_approx_distinct_and_bool_aggs(session):
+    rows = session.execute("""
+        SELECT approx_distinct(o_custkey),
+               count(DISTINCT o_custkey),
+               bool_and(o_totalprice > 0),
+               bool_or(o_totalprice > 100000000),
+               bool_and(o_orderkey > 0)
+        FROM orders""").rows[0]
+    assert rows[0] == rows[1]
+    assert rows[2] is True and rows[3] is False and rows[4] is True
+    grouped = session.execute("""
+        SELECT o_orderstatus, approx_distinct(o_clerk),
+               bool_or(o_totalprice > 200000)
+        FROM orders GROUP BY o_orderstatus ORDER BY o_orderstatus""").rows
+    assert len(grouped) >= 2

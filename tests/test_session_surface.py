@@ -130,3 +130,57 @@ def test_system_runtime_queries():
         assert isinstance(nodes, list)
     finally:
         coord.stop()
+
+
+def test_join_distribution_property_flips_plan():
+    s = Session(default_schema="tiny")
+    sql = ("SELECT c_name FROM customer c JOIN orders o"
+           " ON c.c_custkey = o.o_custkey LIMIT 1")
+    auto = s.execute("EXPLAIN " + sql).rows
+    assert any("dist=broadcast" in r[0] for r in auto), auto
+    s.execute("SET SESSION join_distribution_type = 'partitioned'")
+    forced = s.execute("EXPLAIN " + sql).rows
+    assert any("dist=partitioned" in r[0] for r in forced), forced
+    # stats flip: a 0-byte threshold pushes every build to partitioned
+    s.execute("SET SESSION join_distribution_type = 'auto'")
+    s.execute("SET SESSION broadcast_join_threshold_mb = 0")
+    tiny = s.execute("EXPLAIN " + sql).rows
+    assert any("dist=partitioned" in r[0] for r in tiny), tiny
+
+
+def test_query_deadline_enforced():
+    import pytest as _pytest
+    from trino_tpu.exec.executor import QueryDeadlineError
+    s = Session(default_schema="tiny")
+    s.execute("SET SESSION query_max_run_time_s = 0.000001")
+    with _pytest.raises(QueryDeadlineError):
+        s.execute("SELECT count(*) FROM lineitem, orders"
+                  " WHERE l_orderkey = o_orderkey")
+    s.execute("SET SESSION query_max_run_time_s = 0")
+    r = s.execute("SELECT count(*) FROM nation")
+    assert r.rows[0][0] == 25
+
+
+def test_scan_cache_lru_eviction():
+    s = Session(default_schema="tiny")
+    s.execute("SET SESSION scan_cache_max_mb = 0")
+    for t in ("nation", "region", "supplier", "customer", "orders"):
+        s.execute(f"SELECT count(*) FROM {t}")
+        # a zero budget keeps at most the current table resident
+        assert len(s.executor._scan_cache) <= 1
+    # results stay correct with continuous eviction
+    assert s.execute("SELECT count(*) FROM nation").rows[0][0] == 25
+    s.execute("SET SESSION scan_cache_max_mb = 1024")
+    s.execute("SELECT count(*) FROM nation")
+    s.execute("SELECT count(*) FROM region")
+    assert len(s.executor._scan_cache) == 2
+
+
+def test_dynamic_filtering_toggle():
+    s = Session(default_schema="tiny")
+    sql = ("SELECT count(*) FROM lineitem, orders"
+           " WHERE l_orderkey = o_orderkey AND o_orderkey < 100")
+    want = s.execute(sql).rows
+    s.execute("SET SESSION dynamic_filtering = false")
+    got = s.execute(sql).rows
+    assert got == want

@@ -37,7 +37,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..batch import Batch, batch_from_numpy, batch_to_numpy, pad_capacity
+from ..batch import (Batch, Column, batch_from_numpy, batch_to_numpy,
+                     bucket_capacity, pad_capacity)
 from ..planner import logical as L
 
 # partial-state merge functions (HashAggregationOperator's
@@ -186,6 +187,101 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
         return executor.run(root)
     finally:
         executor._subst.clear()
+
+
+# --------------------------------------------------------------------------
+# streaming-build join: build sides bigger than device memory
+# --------------------------------------------------------------------------
+
+def streaming_build_join(executor, node: L.JoinNode,
+                         probe: Batch) -> Optional[Batch]:
+    """Inner/semi/anti unique-build join whose BUILD side streams from
+    host in chunks (PartitionedConsumption.java's partition-at-a-time
+    idea, reshaped for the dense-LUT kernel).
+
+    TPU shape: the LUT is DOMAIN-sized no matter how many build rows
+    exist, so the build only ever occupies one chunk of HBM at a time —
+    each chunk scatters its global row ids into a persistent LUT. Probe
+    lookups then yield global row ids; matched rows compact, and build
+    payload columns are gathered HOST-side (numpy fancy-indexing over the
+    mmap'd table) at the compacted size, so the full build never
+    materializes on device. Requires: single int key with known domain,
+    build = Scan or Filter(Scan) (the planner's pruned-scan shape), and a
+    planner uniqueness proof. Returns None when the shape doesn't apply
+    (caller uses the resident-build path)."""
+    import jax.numpy as jnp
+
+    if node.kind not in ("inner", "semi", "anti") or \
+            node.build_key_domain is None or not node.build_unique or \
+            len(node.right_keys) != 1 or node.residual is not None or \
+            node.null_aware:
+        return None
+    build_root = node.right
+    pred = None
+    if isinstance(build_root, L.FilterNode):
+        pred = executor.fold_scalars(build_root.predicate)
+        scan = build_root.child
+    else:
+        scan = build_root
+    if not isinstance(scan, L.ScanNode):
+        return None
+
+    data = executor.catalog.get_table(scan.catalog, scan.schema_name,
+                                      scan.table)
+    chunk_rows = executor.spill_chunk_rows or data.num_rows
+    domain = node.build_key_domain
+    key_in_scan = node.right_keys[0]
+
+    from ..ops.join import build_lut_chunk
+    lut = jnp.full(domain + 1, -1, dtype=jnp.int32)
+    cap = pad_capacity(min(chunk_rows, data.num_rows))
+    for start in range(0, data.num_rows, chunk_rows):
+        arrays = [np.asarray(data.columns[i])[start:start + chunk_rows]
+                  for i in scan.column_indices]
+        valids = None
+        if data.valids is not None:
+            valids = [None if data.valids[i] is None else
+                      np.asarray(data.valids[i])[start:start + chunk_rows]
+                      for i in scan.column_indices]
+        chunk = batch_from_numpy(arrays, valids=valids, capacity=cap)
+        if pred is not None:
+            from ..ops.project import apply_filter
+            chunk = apply_filter(chunk, pred)
+        lut = build_lut_chunk(lut, chunk, key_in_scan, domain, start)
+        executor.stats.agg_spill_chunks += 1
+
+    # probe: global row ids out of the LUT
+    pk = probe.columns[node.left_keys[0]]
+    p_idx = jnp.where(pk.valid, jnp.clip(pk.data, 0, domain - 1), domain)
+    src = lut[p_idx]
+    matched = (src >= 0) & pk.valid & probe.live & \
+        (pk.data >= 0) & (pk.data < domain)
+    if node.kind == "semi":
+        return probe.with_live(probe.live & matched)
+    if node.kind == "anti":
+        return probe.with_live(probe.live & ~matched)
+
+    live = int(jnp.sum(matched))
+    new_cap = bucket_capacity(live)
+    from .executor import _compact_gather
+    probe_plus = Batch(probe.columns + (Column(
+        src, matched),), probe.live & matched)
+    compacted = _compact_gather(probe_plus, new_cap)
+    src_host = np.asarray(compacted.columns[-1].data)
+    src_ok = np.asarray(compacted.columns[-1].valid) & \
+        np.asarray(compacted.live)
+    src_host = np.where(src_ok, src_host, 0)
+
+    # host-side payload gather from the table's mmap'd columns
+    out_cols = list(compacted.columns[:-1])
+    for j, ti in enumerate(scan.column_indices):
+        col_np = np.asarray(data.columns[ti])[src_host]
+        valid_np = src_ok.copy()
+        if data.valids is not None and data.valids[ti] is not None:
+            valid_np &= np.asarray(data.valids[ti])[src_host]
+        out_cols.append(Column(jnp.asarray(col_np),
+                               jnp.asarray(valid_np)))
+    return Batch(tuple(out_cols), compacted.live)
 
 
 def merge_partials(executor, node: L.AggregateNode,

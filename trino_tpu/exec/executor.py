@@ -53,10 +53,15 @@ class ExecStats:
     mxu_agg_calls: int = 0
 
 
+class QueryDeadlineError(RuntimeError):
+    """query_max_run_time_s exceeded (QUERY_MAX_RUN_TIME's role)."""
+
+
 class Executor:
     def __init__(self, catalog: Catalog):
+        from collections import OrderedDict
         self.catalog = catalog
-        self._scan_cache: Dict[Tuple[str, str, str, tuple], Batch] = {}
+        self._scan_cache: "OrderedDict[tuple, Batch]" = OrderedDict()
         self._scalar_cache: Dict[object, object] = {}
         self.stats = ExecStats()
         self.profile = False           # EXPLAIN ANALYZE per-node timing
@@ -71,6 +76,15 @@ class Executor:
         # many rows (the spill-to-host analog; None = off)
         self.spill_chunk_rows: Optional[int] = None
         self.enable_mxu_agg = False    # Pallas MXU aggregation (opt-in)
+        # session-property knobs (exec/session.py wires these per query)
+        self.enable_dynamic_filtering = True
+        self.enable_merge_join = True
+        self.deadline: Optional[float] = None     # time.monotonic() cutoff
+        self.scan_cache_max_bytes = 24 << 30      # LRU cap (device bytes)
+        self._scan_cache_bytes: Dict[tuple, int] = {}
+        # build sides estimated above this stream chunk-wise through the
+        # dense LUT instead of materializing on device (0/None = off)
+        self.stream_build_bytes: Optional[int] = None
 
     # ------------------------------------------------------------------
 
@@ -99,6 +113,11 @@ class Executor:
         sub = self._subst.get(id(node))
         if sub is not None:
             return sub
+        if self.deadline is not None:
+            import time as _t
+            if _t.monotonic() > self.deadline:
+                raise QueryDeadlineError(
+                    "query exceeded query_max_run_time_s")
         if self.TRACE:
             import sys
             import time as _t
@@ -320,17 +339,31 @@ class Executor:
     def run_scan(self, node: L.ScanNode) -> Batch:
         key = (node.catalog, node.schema_name, node.table,
                node.column_indices)
-        if key not in self._scan_cache:
-            data = self.catalog.get_table(node.catalog, node.schema_name,
-                                          node.table)
-            arrays = [data.columns[i] for i in node.column_indices]
-            valids = None
-            if data.valids is not None:
-                valids = [data.valids[i] for i in node.column_indices]
-            self._scan_cache[key] = batch_from_numpy(arrays, valids=valids)
-            self.stats.scans += 1
-            self.stats.rows_scanned += data.num_rows
-        return self._scan_cache[key]
+        hit = self._scan_cache.get(key)
+        if hit is not None:
+            self._scan_cache.move_to_end(key)     # LRU touch
+            return hit
+        data = self.catalog.get_table(node.catalog, node.schema_name,
+                                      node.table)
+        arrays = [data.columns[i] for i in node.column_indices]
+        valids = None
+        if data.valids is not None:
+            valids = [data.valids[i] for i in node.column_indices]
+        batch = batch_from_numpy(arrays, valids=valids)
+        self.stats.scans += 1
+        self.stats.rows_scanned += data.num_rows
+        # bounded scan cache: evict least-recently-scanned tables so a
+        # long-lived server's device memory stays flat (the round-2 cache
+        # pinned every table ever scanned)
+        from .memory import batch_bytes
+        b = batch_bytes(batch)
+        total = sum(self._scan_cache_bytes.values())
+        while self._scan_cache and total + b > self.scan_cache_max_bytes:
+            old_key, _ = self._scan_cache.popitem(last=False)
+            total -= self._scan_cache_bytes.pop(old_key, 0)
+        self._scan_cache[key] = batch
+        self._scan_cache_bytes[key] = b
+        return batch
 
     def run_window(self, node: L.WindowNode) -> Batch:
         from ..ops.window import WinSpec, window_compute
@@ -514,8 +547,115 @@ class Executor:
 
     def run_join(self, node: L.JoinNode) -> Batch:
         probe = self.run(node.left)
+        # oversized build sides stream chunk-wise into the dense LUT
+        # instead of materializing on device (spill tier v2; the decision
+        # must precede running the build child)
+        if self.stream_build_bytes:
+            est = self._estimate_build_bytes(node.right)
+            if est is not None and est > self.stream_build_bytes:
+                from .chunked import streaming_build_join
+                out = streaming_build_join(self, node, probe)
+                if out is not None:
+                    return out
         build = self.run(node.right)
-        self.validate_key_ranges(build, node.right_keys)
+        # >2-column keys (or values past 2^31) overflow the kernels'
+        # fixed 32-bit-per-column packing: range-compress both sides'
+        # keys into ONE appended int64 column (shared min/max so equality
+        # is preserved), run the join single-key, strip the extras after
+        packed = self.pack_join_keys(probe, build, node.left_keys,
+                                     node.right_keys)
+        if packed is not None:
+            probe2, build2, pk, bk = packed
+            import dataclasses as _dc
+            residual2 = node.residual
+            if residual2 is not None:
+                # kernel layout gains the packed column after the probe
+                # columns: shift build-side references right by one
+                n_probe = len(probe.columns)
+
+                def _shift(e):
+                    if isinstance(e, ir.ColumnRef) and \
+                            e.index >= n_probe:
+                        return ir.ColumnRef(e.index + 1, e.dtype, e.name)
+                    return None
+                residual2 = ir.transform(node.residual, _shift)
+            node2 = _dc.replace(node, left_keys=pk, right_keys=bk,
+                                residual=residual2,
+                                build_key_domain=None)
+            out = self._run_join_inner(node2, probe2, build2)
+            return _strip_packed_columns(out, node, len(probe.columns),
+                                         len(build.columns))
+        return self._run_join_inner(node, probe, build)
+
+    def _estimate_build_bytes(self, node: L.PlanNode) -> Optional[int]:
+        """Size of a Scan/Filter(Scan) build side, for the streaming
+        decision (shape must match streaming_build_join's support)."""
+        scan = node.child if isinstance(node, L.FilterNode) else node
+        if not isinstance(scan, L.ScanNode):
+            return None
+        try:
+            rows = self.catalog.get_table(scan.catalog, scan.schema_name,
+                                          scan.table).num_rows
+        except Exception:        # noqa: BLE001 — stats probe only
+            return None
+        return rows * max(1, len(scan.column_indices)) * 8
+
+    def pack_join_keys(self, probe: Batch, build: Batch, pkeys, bkeys):
+        """None when the fixed 32-bit packing is safe (<=2 in-range
+        columns); else (probe', build', probe_keys', build_keys') with
+        one range-compressed key column appended to each side."""
+        if len(pkeys) <= 1:
+            return None
+        if len(pkeys) == 2:
+            # the fixed packing is fine when trailing key values fit 31
+            # bits — ONE fused fetch for the check
+            import numpy as np
+            stats = []
+            for side, keys in ((build, bkeys), (probe, pkeys)):
+                for ki in keys[1:]:
+                    col = side.columns[ki]
+                    m = side.live & col.valid
+                    d = col.data.astype(jnp.int64)
+                    stats.append(jnp.min(jnp.where(m, d, 0)))
+                    stats.append(jnp.max(jnp.where(m, d, 0)))
+            vals = np.asarray(jnp.stack(stats))
+            if all(0 <= int(vals[i]) and int(vals[i + 1]) < (1 << 31)
+                   for i in range(0, len(vals), 2)):
+                return None
+        import numpy as np
+        stats = []
+        big = jnp.iinfo(jnp.int64)
+        for side, keys in ((probe, pkeys), (build, bkeys)):
+            for ki in keys:
+                col = side.columns[ki]
+                m = side.live & col.valid
+                d = col.data.astype(jnp.int64)
+                stats.append(jnp.min(jnp.where(m, d, big.max)))
+                stats.append(jnp.max(jnp.where(m, d, big.min)))
+        vals = np.asarray(jnp.stack(stats))
+        k = len(pkeys)
+        kmins, bits, total = [], [], 0
+        for i in range(k):
+            lo = min(int(vals[2 * i]), int(vals[2 * (k + i)]))
+            hi = max(int(vals[2 * i + 1]), int(vals[2 * (k + i) + 1]))
+            if hi < lo:
+                lo, hi = 0, 0
+            b = max(2, int(hi - lo + 3).bit_length())
+            kmins.append(lo)
+            bits.append(b)
+            total += b
+        if total > 62:
+            raise RuntimeError(
+                "multi-column join key spans exceed 62 packed bits")
+        kmins_d = jnp.asarray(np.asarray(kmins, dtype=np.int64))
+        bits = tuple(bits)
+        probe2 = _append_packed_key(probe, kmins_d, pkeys, bits)
+        build2 = _append_packed_key(build, kmins_d, bkeys, bits)
+        return (probe2, build2, (len(probe.columns),),
+                (len(build.columns),))
+
+    def _run_join_inner(self, node: L.JoinNode, probe: Batch,
+                        build: Batch) -> Batch:
         probe = self.apply_dynamic_filter(node, probe, build)
         if node.kind == "mark":
             return self.run_mark_join(node, probe, build)
@@ -564,7 +704,8 @@ class Executor:
         # /gather path carries the join: it compiles in seconds at any
         # size (9.4s at 60M measured) and runs at gather speed.
         n_sort_ops = 2 * (len(probe.columns) + len(build.columns)) + 4
-        merge_ok = n_sort_ops <= MAX_SORT_OPERANDS and \
+        merge_ok = self.enable_merge_join and \
+            n_sort_ops <= MAX_SORT_OPERANDS and \
             (probe.capacity + build.capacity) <= SORT_SMALL_ROWS
         # every branch fuses (dup[, oob], live-count) into ONE device
         # fetch, then compacts with the known count — one tunnel round
@@ -577,15 +718,41 @@ class Executor:
                 [dup, jnp.sum(out.live, dtype=dup.dtype)])))
             return self.maybe_compact(out, live=live) if dup == 0 else None
         if domain is not None:
-            out, dup, oob = join_unique_build_dense(
-                probe, build, node.left_keys, node.right_keys,
-                node.kind, domain)
-            dup, oob, live = (int(v) for v in np.asarray(jnp.stack(
-                [dup, oob, jnp.sum(out.live, dtype=dup.dtype)])))
-            if oob == 0:
-                return self.maybe_compact(out, live=live) \
-                    if dup == 0 else None
-            self.stats.join_domain_fallbacks += 1
+            if node.kind == "inner" and probe.capacity > SORT_SMALL_ROWS:
+                # two-phase: probe the LUT, THEN decide — a selective
+                # join compacts matched rows before paying per-column
+                # build gathers at full probe capacity (gathers are the
+                # dense join's whole cost)
+                from ..ops.join import dense_join_compacted, dense_probe
+                src, matched, dup, oob, live = dense_probe(
+                    probe, build, node.left_keys, node.right_keys,
+                    domain)
+                dup, oob, live = (int(v) for v in np.asarray(jnp.stack(
+                    [dup, oob, live])))
+                if oob == 0:
+                    if dup != 0:
+                        return None
+                    new_cap = bucket_capacity(live)
+                    if new_cap * self.COMPACT_SHRINK <= probe.capacity:
+                        self.stats.dynamic_filter_compactions += 1
+                        return dense_join_compacted(
+                            probe, src, matched, build, node.left_keys,
+                            node.right_keys, new_cap)
+                    out, dup2, oob2 = join_unique_build_dense(
+                        probe, build, node.left_keys, node.right_keys,
+                        node.kind, domain)
+                    return out
+                self.stats.join_domain_fallbacks += 1
+            else:
+                out, dup, oob = join_unique_build_dense(
+                    probe, build, node.left_keys, node.right_keys,
+                    node.kind, domain)
+                dup, oob, live = (int(v) for v in np.asarray(jnp.stack(
+                    [dup, oob, jnp.sum(out.live, dtype=dup.dtype)])))
+                if oob == 0:
+                    return self.maybe_compact(out, live=live) \
+                        if dup == 0 else None
+                self.stats.join_domain_fallbacks += 1
         out, dup = join_unique_build(probe, build, node.left_keys,
                                      node.right_keys, node.kind)
         dup, live = (int(v) for v in np.asarray(jnp.stack(
@@ -605,6 +772,8 @@ class Executor:
         Skipped for anti joins (they keep non-matching rows), left joins
         (outer rows survive), and mark joins (non-matching rows carry
         mark=false)."""
+        if not self.enable_dynamic_filtering:
+            return probe
         if node.kind in ("anti", "left", "mark") or node.null_aware:
             return probe
         for pk_i, bk_i in zip(node.left_keys, node.right_keys):
@@ -709,20 +878,6 @@ class Executor:
         live = probe.live & (mark if node.kind == "semi" else ~mark)
         return probe.with_live(live)
 
-    def validate_key_ranges(self, batch: Batch, keys: tuple) -> None:
-        if len(keys) <= 1:
-            return
-        stats = []                     # one fused device fetch, not 2/key
-        for ki in keys[1:]:
-            masked = jnp.where(batch.live, batch.columns[ki].data, 0)
-            stats.append(jnp.max(masked).astype(jnp.int64))
-            stats.append(jnp.min(masked).astype(jnp.int64))
-        vals = np.asarray(jnp.stack(stats))
-        for j in range(0, len(vals), 2):
-            if vals[j + 1] < 0 or vals[j] >= (1 << 31):
-                raise RuntimeError(
-                    "multi-column join key outside packable range")
-
     def result_to_host(self, root: L.OutputNode, batch: Batch):
         """Compact + return (names, columns, valids) on host. Selective
         results compact on device first so the host fetch moves live rows,
@@ -792,6 +947,39 @@ def compact_batch(batch: Batch, new_capacity: int) -> Batch:
             n_operands <= MAX_SORT_OPERANDS:
         return _compact_sort(batch, new_capacity)
     return _compact_gather(batch, new_capacity)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _append_packed_key(batch: Batch, kmins, keys: tuple,
+                       bits: tuple) -> Batch:
+    """Append one int64 column packing the key columns by shared range
+    compression (see pack_join_keys); valid = AND of the key validities,
+    so NULL keys keep their never-match semantics."""
+    packed = jnp.zeros(batch.capacity, dtype=jnp.int64)
+    valid = jnp.ones(batch.capacity, dtype=jnp.bool_)
+    for j, (ki, b) in enumerate(zip(keys, bits)):
+        col = batch.columns[ki]
+        norm = col.data.astype(jnp.int64) - kmins[j] + 1
+        packed = (packed << b) | jnp.where(col.valid, norm, 0)
+        valid = valid & col.valid
+    return Batch(batch.columns + (Column(packed, valid),), batch.live)
+
+
+def _strip_packed_columns(out: Batch, node: L.JoinNode, n_probe: int,
+                          n_build: int) -> Batch:
+    """Remove the appended key columns so the output matches
+    node.output."""
+    cols = list(out.columns)
+    if node.kind in ("inner", "left"):
+        # layout: probe cols + packed_p + build cols + packed_b
+        del cols[n_probe + 1 + n_build]
+        del cols[n_probe]
+    elif node.kind == "mark":
+        # probe cols + packed_p + mark
+        del cols[n_probe]
+    else:                               # semi/anti: probe cols + packed
+        del cols[n_probe]
+    return Batch(tuple(cols), out.live)
 
 
 @functools.partial(jax.jit, static_argnums=(1,))

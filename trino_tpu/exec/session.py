@@ -42,15 +42,38 @@ class QueryResult:
 
 # session properties (SystemSessionProperties.java:61's role); each entry:
 # name -> (default, parser)
+def _bool(v):
+    return str(v).lower() in ("true", "1")
+
+
 SESSION_PROPERTY_DEFAULTS = {
-    "distributed": (False, lambda v: str(v).lower() in ("true", "1")),
+    "distributed": (False, _bool),
     "query_max_rows": (10_000_000, int),
     # per-query memory limit (memory/MemoryPool reserve path)
     "query_max_memory_mb": (64 << 10, int),
     # bounded-memory aggregation chunk size, 0 = off (spill analog)
     "spill_chunk_rows": (0, int),
     # Pallas MXU one-pass aggregation kernel (ops/pallas_agg.py)
-    "mxu_agg": (False, lambda v: str(v).lower() in ("true", "1")),
+    "mxu_agg": (False, _bool),
+    # join distribution (SystemSessionProperties JOIN_DISTRIBUTION_TYPE):
+    # AUTO picks by estimated build bytes against the threshold
+    "join_distribution_type": ("auto", lambda v: str(v).lower()),
+    "broadcast_join_threshold_mb": (32, int),
+    # wall-clock budget; exceeded -> QueryDeadlineError (QUERY_MAX_RUN_TIME)
+    "query_max_run_time_s": (0.0, float),
+    # build-side min/max pruning of probe scans (ENABLE_DYNAMIC_FILTERING)
+    "dynamic_filtering": (True, _bool),
+    # gather-free sort-merge unique join at small shapes (compile-cost
+    # gated regardless; this disables it outright)
+    "merge_join": (True, _bool),
+    # device bytes the scan cache may pin before LRU eviction
+    "scan_cache_max_mb": (24 << 10, int),
+    # distributed runtime knobs (execution/scheduler tier)
+    "split_rows": (250_000, int),
+    "task_retries": (2, int),
+    # build sides estimated above this stream chunk-wise through the
+    # dense LUT with host-side payload gathers (spill tier v2; 0 = off)
+    "stream_build_min_kb": (0, int),
 }
 
 
@@ -67,7 +90,8 @@ class Session:
         self.tracer = NOOP          # swap for utils.tracing.Tracer()
 
     def planner(self) -> Planner:
-        return Planner(self.catalog, self.default_cat, self.default_schema)
+        return Planner(self.catalog, self.default_cat, self.default_schema,
+                       properties=self.properties)
 
     def plan(self, sql: str):
         stmt = parse(sql)
@@ -94,9 +118,23 @@ class Session:
             return self.execute_ddl(stmt, t0)
         raise NotImplementedError(type(stmt).__name__)
 
+    def _apply_executor_properties(self, t0: float) -> None:
+        """Push session properties into the executor for this query
+        (SystemSessionProperties -> TaskContext wiring, collapsed)."""
+        ex = self.executor
+        ex.enable_dynamic_filtering = self.properties["dynamic_filtering"]
+        ex.enable_merge_join = self.properties["merge_join"]
+        ex.scan_cache_max_bytes = \
+            self.properties["scan_cache_max_mb"] << 20
+        max_s = self.properties["query_max_run_time_s"]
+        ex.deadline = (t0 + max_s) if max_s else None
+        kb = self.properties["stream_build_min_kb"]
+        ex.stream_build_bytes = (kb << 10) if kb else None
+
     def execute_query(self, stmt, t0) -> QueryResult:
         # spans mirror the reference's: planner / fragment-plan / execute
         # (SqlQueryExecution.java:473,501)
+        self._apply_executor_properties(t0)
         with self.tracer.span("plan"):
             rel = self.planner().plan_query(stmt)
         root = rel.node

@@ -146,6 +146,88 @@ def join_unique_build_dense(probe: Batch, build: Batch, probe_keys: tuple,
             dup, oob)
 
 
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def build_lut_chunk(lut: jax.Array, chunk: Batch, key_idx: int,
+                    domain: int, start) -> jax.Array:
+    """Scatter one build chunk's GLOBAL row ids into a persistent dense
+    LUT (streaming-build join, exec/chunked.py): the LUT is domain-sized
+    regardless of build row count, so arbitrarily large build sides
+    stream through one chunk of HBM."""
+    key = chunk.columns[key_idx]
+    ok = chunk.live & key.valid
+    idx = jnp.where(ok, jnp.clip(key.data, 0, domain - 1), domain)
+    rows = (jnp.arange(chunk.capacity, dtype=jnp.int64) +
+            start).astype(jnp.int32)
+    return lut.at[idx].max(rows, mode="drop")
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def dense_probe(probe: Batch, build: Batch, probe_keys: tuple,
+                build_keys: tuple, domain: int):
+    """Phase 1 of the two-phase dense join: LUT build + probe lookup
+    only. Returns (src row indices, matched mask, dup, oob, match
+    count) — ONE gather at probe capacity; the caller decides whether
+    to compact before paying the per-column build gathers (phase 2)."""
+    pk, pk_valid = _combined_key(probe, probe_keys)
+    bk, bk_valid = _combined_key(build, build_keys)
+    b_ok = build.live & bk_valid
+    oob = jnp.sum(b_ok & ((bk < 0) | (bk >= domain)))
+    lut, dup = _dense_row_lut(bk, b_ok, domain)
+    p_idx = jnp.where(pk_valid, jnp.clip(pk, 0, domain - 1), domain)
+    src = lut[p_idx]
+    matched = (src >= 0) & pk_valid & probe.live & \
+        (pk >= 0) & (pk < domain)
+    return src, matched, dup, oob, jnp.sum(matched, dtype=jnp.int64)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6))
+def dense_join_compacted(probe: Batch, src: jax.Array,
+                         matched: jax.Array, build: Batch,
+                         probe_keys: tuple, build_keys: tuple,
+                         new_capacity: int) -> Batch:
+    """Phase 2 (selective inner join): compact matched probe rows first
+    (argsort of the match mask), then gather probe AND build payload
+    columns at the compacted capacity only. For a 60M-capacity probe
+    with a few-percent match rate this replaces several 60M-row gathers
+    with ~matched-size ones — gathers are the whole cost of the dense
+    join on TPU.
+
+    `matched` MUST be phase 1's mask: it carries the key-validity and
+    domain-range checks (src >= 0 alone is not sufficient — the LUT's
+    dead-row sink slot holds a real row id, so NULL-key probes would
+    join spuriously and overflow new_capacity)."""
+    order = jnp.argsort(~matched, stable=True)[:new_capacity]
+    live = matched[order]
+    src_c = jnp.clip(src[order], 0, build.capacity - 1)
+
+    cols = []
+    for c in probe.columns:
+        cols.append(Column(data=c.data[order], valid=c.valid[order]))
+    bkey = build_keys[0] if len(build_keys) == 1 else None
+    pack_valids = len(build.columns) <= 63
+    vbits = None
+    if pack_valids:
+        vword = jnp.zeros(build.capacity, dtype=jnp.int64)
+        for i, col in enumerate(build.columns):
+            if i == bkey:
+                continue
+            vword = vword | (col.valid.astype(jnp.int64) << i)
+        vbits = vword[src_c]
+    for i, col in enumerate(build.columns):
+        if i == bkey:
+            # matched rows' build key == probe key (single-key joins)
+            pk = probe.columns[probe_keys[0]]
+            cols.append(Column(
+                data=jnp.where(live, pk.data[order], 0).astype(
+                    col.data.dtype),
+                valid=live))
+            continue
+        valid = ((vbits >> i) & 1).astype(jnp.bool_) if pack_valids \
+            else col.valid[src_c]
+        cols.append(Column(data=col.data[src_c], valid=valid & live))
+    return Batch(columns=tuple(cols), live=live)
+
+
 def _flood_first(vals: jax.Array, boundary: jax.Array) -> jax.Array:
     """Inclusive segmented scan keeping each segment's FIRST value —
     log-depth elementwise passes, no gathers."""

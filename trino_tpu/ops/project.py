@@ -110,6 +110,18 @@ def _to_comparable(expr: ir.Expr, data: jax.Array, target) -> jax.Array:
 # --------------------------------------------------------------------------
 
 
+def days_from_civil(y: jax.Array, m: jax.Array, d) -> jax.Array:
+    """Inverse of civil_from_days (Hinnant), for date_trunc
+    reconstruction."""
+    y = y - (m <= 2)
+    era = y // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
 def civil_from_days(days: jax.Array):
     z = days.astype(jnp.int64) + 719468
     # floor division is already era-correct for negative z (the C++ original
@@ -346,8 +358,32 @@ def eval_expr(expr: ir.Expr, batch: Batch):
 
     if isinstance(expr, ir.ExtractField):
         d, v = eval_expr(expr.arg, batch)
-        if expr.arg.dtype.kind is TypeKind.TIMESTAMP:
-            micros_in_day = 86_400_000_000
+        is_ts = expr.arg.dtype.kind is TypeKind.TIMESTAMP
+        micros_in_day = 86_400_000_000
+        if expr.part.startswith('trunc_'):
+            unit = expr.part[len('trunc_'):]
+            if unit in ('hour', 'minute', 'second'):   # timestamp only
+                step = {'hour': 3_600_000_000, 'minute': 60_000_000,
+                        'second': 1_000_000}[unit]
+                return d - d % step, v
+            days = d // micros_in_day if is_ts else d
+            if unit == 'day':
+                out = days
+            elif unit == 'week':
+                # epoch day 0 = Thursday; Monday-based weeks (ISO)
+                out = days - (days + 3) % 7
+            else:
+                year, month, _day = civil_from_days(days)
+                if unit == 'month':
+                    out = days_from_civil(year, month, 1)
+                elif unit == 'quarter':
+                    q_month = ((month - 1) // 3) * 3 + 1
+                    out = days_from_civil(year, q_month, 1)
+                else:                                  # year
+                    out = days_from_civil(year, jnp.ones_like(month), 1)
+            out = out.astype(d.dtype)
+            return (out * micros_in_day if is_ts else out), v
+        if is_ts:
             days = d // micros_in_day
             rem = d - days * micros_in_day
             if expr.part == 'hour':

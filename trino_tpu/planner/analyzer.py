@@ -68,7 +68,14 @@ AGG_NAMES = {"sum", "avg", "count", "min", "max",
              # a post-aggregation finalizer (AccumulatorCompiler's
              # VarianceState, operator/aggregation/VarianceAggregation)
              "stddev", "stddev_samp", "stddev_pop",
-             "variance", "var_samp", "var_pop"}
+             "variance", "var_samp", "var_pop",
+             # approx_distinct computes the EXACT distinct count through
+             # the sort kernel's dedup — on TPU the sort network makes
+             # exactness cheaper than per-group HLL register scatters,
+             # and 0% error is within the reference's 2.3% contract
+             # (ApproximateCountDistinctAggregation)
+             "approx_distinct",
+             "bool_and", "bool_or", "every"}
 
 VARIANCE_AGGS = {"stddev", "stddev_samp", "stddev_pop",
                  "variance", "var_samp", "var_pop"}
@@ -474,6 +481,42 @@ class ExpressionLowerer:
             pool = self.pool_of(args[0])
             return ir.DictValueMap(
                 args[0], tuple(s.find(needle) + 1 for s in pool), BIGINT)
+        if name == "split_part":
+            if len(args) != 3 or not isinstance(args[1], _StringConst) \
+                    or not isinstance(args[2], ir.Literal):
+                raise AnalysisError(
+                    "split_part(col, 'delim', n) with literal delim/n")
+            delim, idx = args[1].value, int(args[2].value)
+            if idx < 1:
+                raise AnalysisError("split_part index starts at 1")
+
+            def part(s, d=delim, i=idx):
+                fields = s.split(d)
+                return fields[i - 1] if i <= len(fields) else ""
+            return self.dict_transform(args[0], part)
+        if name == "regexp_like":
+            if len(args) != 2 or not isinstance(args[1], _StringConst):
+                raise AnalysisError(
+                    "regexp_like(col, 'pattern') with a literal pattern")
+            import re as _re
+            pat = _re.compile(args[1].value)
+            return self.dict_lut(args[0],
+                                 lambda s: pat.search(s) is not None)
+        if name == "date_trunc":
+            if len(args) != 2 or not isinstance(args[0], _StringConst):
+                raise AnalysisError(
+                    "date_trunc('unit', x) with a literal unit")
+            unit = args[0].value.lower()
+            x = args[1]
+            kinds = ("year", "quarter", "month", "week", "day")
+            if x.dtype.kind is TypeKind.TIMESTAMP:
+                kinds = kinds + ("hour", "minute", "second")
+            if x.dtype.kind not in (TypeKind.DATE, TypeKind.TIMESTAMP) \
+                    or unit not in kinds:
+                raise AnalysisError(
+                    f"date_trunc unit {unit!r} unsupported for "
+                    f"{x.dtype.kind.value}")
+            return ir.ExtractField(f"trunc_{unit}", x, x.dtype)
         if name in ("year", "month", "day"):
             if len(args) != 1 or args[0].dtype.kind not in (
                     TypeKind.DATE, TypeKind.TIMESTAMP):

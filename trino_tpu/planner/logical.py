@@ -253,7 +253,8 @@ def explain_text(node: PlanNode, indent: int = 0, annotate=None) -> str:
                 f"{list(node.group_keys)}, {aggs}]")
     elif isinstance(node, JoinNode):
         line = (f"{pad}Join[{node.kind}, probe={list(node.left_keys)}, "
-                f"build={list(node.right_keys)}]")
+                f"build={list(node.right_keys)}, "
+                f"dist={node.distribution}]")
     elif isinstance(node, WindowNode):
         fns = ", ".join(s.func for s in node.specs)
         line = (f"{pad}Window[partition={list(node.partition_by)}, "

@@ -64,10 +64,11 @@ class PlannedRelation:
 
 class Planner:
     def __init__(self, catalog: Catalog, default_catalog: str = "tpch",
-                 default_schema: str = "tiny"):
+                 default_schema: str = "tiny", properties=None):
         self.catalog = catalog
         self.default_catalog = default_catalog
         self.default_schema = default_schema
+        self.properties = properties or {}
         self.ctes: Dict[str, A.Query] = {}   # WITH-bound names, lexically scoped
         # (from_node, from_scope, window_slots) of the latest plain select —
         # lets ORDER BY lower hidden sort expressions over the FROM scope
@@ -877,11 +878,18 @@ class Planner:
             output = tuple(probe_node.output)
         # DetermineJoinDistributionType.java:51's choice, by estimated
         # build bytes: small builds replicate over the mesh (all_gather),
-        # large ones hash-repartition both sides (all_to_all)
-        build_bytes = self.estimate_rows(build_node) * \
-            max(1, len(build_node.output)) * 8
-        distribution = "broadcast" if build_bytes < (32 << 20) \
-            else "partitioned"
+        # large ones hash-repartition both sides (all_to_all). The
+        # session can force either (join_distribution_type).
+        forced = self.properties.get("join_distribution_type", "auto")
+        if forced in ("broadcast", "partitioned"):
+            distribution = forced
+        else:
+            threshold_mb = self.properties.get(
+                "broadcast_join_threshold_mb", 32)
+            build_bytes = self.estimate_rows(build_node) * \
+                max(1, len(build_node.output)) * 8
+            distribution = "broadcast" \
+                if build_bytes < (threshold_mb << 20) else "partitioned"
         if extra:
             build_key_domain = None    # remapped varchar keys can be -1
         return L.JoinNode(kind, probe_node, build_node,
@@ -1504,6 +1512,12 @@ class Planner:
         call_slots: Dict[A.FunctionCall, Tuple[str, int, int]] = {}
 
         def add_arg(e: ir.Expr) -> int:
+            # reuse identical pre-projection expressions: DISTINCT
+            # aggregates over the same argument must share one sort
+            # column (count(DISTINCT x) + approx_distinct(x))
+            for i, prev in enumerate(pre_exprs):
+                if prev == e:
+                    return i
             pre_exprs.append(e)
             pre_cols.append((f"a{len(pre_exprs)}", e.dtype))
             return len(pre_exprs) - 1
@@ -1526,16 +1540,26 @@ class Planner:
             # min/max DISTINCT == plain min/max; sum/count DISTINCT need
             # the sort kernel's duplicate-elimination (one distinct column
             # per aggregation, enforced below)
-            distinct = call.distinct and call.name in ("sum", "count")
+            distinct = (call.distinct and call.name in ("sum", "count")) \
+                or call.name == "approx_distinct"
             if distinct:
                 distinct_args.append(slot)
                 if len(set(distinct_args)) > 1:
                     raise AnalysisError(
                         "multiple DISTINCT aggregate arguments unsupported")
-            if call.name == "count":
+            if call.name in ("count", "approx_distinct"):
                 agg_specs.append(L.AggSpecNode("count", ir.ColumnRef(
                     slot, t), "count", BIGINT, distinct))
                 call_slots[call] = ("plain", len(agg_specs) - 1, -1)
+            elif call.name in ("bool_and", "bool_or", "every"):
+                if t.kind is not TypeKind.BOOLEAN:
+                    raise AnalysisError(f"{call.name} requires a boolean")
+                # AND == min over {0,1}; OR == max (BooleanAndAggregation)
+                b_slot = add_arg(ir.Cast(arg, BIGINT))
+                fn = "max" if call.name == "bool_or" else "min"
+                agg_specs.append(L.AggSpecNode(
+                    fn, ir.ColumnRef(b_slot, BIGINT), call.name, BIGINT))
+                call_slots[call] = ("bool", len(agg_specs) - 1, -1)
             elif call.name in ("min", "max"):
                 agg_specs.append(L.AggSpecNode(call.name, ir.ColumnRef(
                     slot, t), call.name, t))
@@ -1659,6 +1683,10 @@ class Planner:
                     if kind == "plain":
                         spec = agg_specs[s1]
                         return ir.ColumnRef(n_keys + s1, spec.out_dtype)
+                    if kind == "bool":
+                        return ir.Compare(
+                            "=", ir.ColumnRef(n_keys + s1, BIGINT),
+                            ir.Literal(1, BIGINT))
                     if kind == "var":
                         # finalize variance family from (Σx², Σx, n):
                         # m2 = Σx² - (Σx)²/n; var_pop = m2/n,

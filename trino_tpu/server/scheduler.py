@@ -109,8 +109,10 @@ class StageScheduler:
                  spool=None):
         self.state = coordinator_state
         self.session = session
-        self.split_rows = split_rows
-        self.max_task_retries = max_task_retries
+        props = getattr(session, "properties", {})
+        self.split_rows = props.get("split_rows", split_rows)
+        self.max_task_retries = props.get("task_retries",
+                                          max_task_retries)
         self.task_timeout_s = task_timeout_s
         self._seq = 0
         self._lock = threading.Lock()
