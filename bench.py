@@ -369,6 +369,27 @@ def budget_left(frac):
     return (time.monotonic() - T0) < BUDGET_S * frac
 
 
+def cached_baseline(key: str, fn):
+    """CPU baselines are deterministic per dataset, so their (result,
+    wall) pair is measured once per machine and cached beside the table
+    cache — the same once-per-machine treatment as datagen. The cached
+    cpu_ms is the wall measured on this host on first computation."""
+    import pickle
+    from trino_tpu.connectors.diskcache import cache_root
+    os.makedirs(cache_root(), exist_ok=True)
+    path = os.path.join(cache_root(), f"baseline_{key}.pkl")
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            rec = pickle.load(f)
+        return rec["result"], rec["cpu_ms"], True
+    t0 = time.monotonic()
+    result = fn()
+    cpu_ms = (time.monotonic() - t0) * 1000
+    with open(path, "wb") as f:
+        pickle.dump({"result": result, "cpu_ms": cpu_ms}, f)
+    return result, cpu_ms, False
+
+
 def main():
     threading.Thread(target=_watchdog, daemon=True).start()
     import jax
@@ -383,9 +404,8 @@ def main():
     tables = {"lineitem": session.catalog.get_table("tpch", "sf1",
                                                     "lineitem")}
     gen1_s = time.monotonic() - t0
-    t0 = time.monotonic()
-    cpu_q6 = numpy_q6(tables)
-    cpu_q6_ms = (time.monotonic() - t0) * 1000
+    cpu_q6, cpu_q6_ms, _ = cached_baseline("q6_sf1",
+                                           lambda: numpy_q6(tables))
     res, cold, steady = run_config(session, Q6)
     got = float(res.rows[0][0])
     assert abs(got - cpu_q6 / 1e4) < 1e-2, (got, cpu_q6 / 1e4)
@@ -402,9 +422,8 @@ def main():
         tables10 = {t: session10.catalog.get_table("tpch", "sf10", t)
                     for t in ["customer", "orders", "lineitem"]}
         gen10_s = time.monotonic() - t0
-        t0 = time.monotonic()
-        cpu_q3 = numpy_q3(tables10)
-        cpu_q3_ms = (time.monotonic() - t0) * 1000
+        cpu_q3, cpu_q3_ms, _ = cached_baseline(
+            "q3_sf10", lambda: numpy_q3(tables10))
         res, cold, steady = run_config(session10, Q3)
         got = [(int(r[0]), round(float(r[1]), 2)) for r in res.rows]
         want = [(k, round(v, 2)) for k, v in cpu_q3]
@@ -433,9 +452,8 @@ def main():
                        default_schema="q5")
         s100.properties["spill_chunk_rows"] = 50_000_000
         s100.executor.spill_chunk_rows = 50_000_000
-        t0 = time.monotonic()
-        cpu_q5 = numpy_q5(tables100)
-        cpu_q5_ms = (time.monotonic() - t0) * 1000
+        cpu_q5, cpu_q5_ms, _ = cached_baseline(
+            f"q5_sf{scale:g}", lambda: numpy_q5(tables100))
         res, cold, steady = run_config(s100, Q5, runs=1, prewarm=1)
         got = [(r[0], round(float(r[1]), 2)) for r in res.rows]
         want = [(n, round(v, 2)) for n, v in cpu_q5]

@@ -186,3 +186,22 @@ def test_window_with_nulls(session, oracle):
                                      ORDER BY o_orderkey) AS prev
         FROM orders ORDER BY o_custkey, o_orderkey LIMIT 5""").rows
     assert got[0][2] is None
+
+
+def test_bounded_rows_frames(session, oracle):
+    check(session, oracle, """
+        SELECT o_custkey, o_orderdate, o_totalprice,
+               sum(o_totalprice) OVER (
+                 PARTITION BY o_custkey ORDER BY o_orderdate, o_orderkey
+                 ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) mv3,
+               count(*) OVER (
+                 PARTITION BY o_custkey ORDER BY o_orderdate, o_orderkey
+                 ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) w3,
+               avg(o_totalprice) OVER (
+                 PARTITION BY o_custkey ORDER BY o_orderdate, o_orderkey
+                 ROWS BETWEEN 3 PRECEDING AND CURRENT ROW) a4
+        FROM orders
+        WHERE o_custkey < 200
+        ORDER BY o_custkey, o_orderdate, o_orderkey
+        LIMIT 300
+    """)

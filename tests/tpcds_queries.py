@@ -3048,8 +3048,8 @@ LIMIT 100
 # returns linkage goes through sr_customer_sk instead)
 QUERIES[84] = """
 SELECT c_customer_id customer_id,
-       coalesce(c_last_name, '') || ', ' || coalesce(c_first_name, '')
-         customername
+       coalesce(c_last_name, '') customer_last_name,
+       coalesce(c_first_name, '') customer_first_name
 FROM customer, customer_address, customer_demographics,
      household_demographics, income_band, store_returns
 WHERE ca_city = 'Edgewood'

@@ -125,10 +125,13 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
         return None
 
     # pin join build sides once (HashBuilderOperator builds once, probes
-    # stream); scalar subqueries are folded+cached by the executor anyway
+    # stream); scalar subqueries are folded+cached by the executor anyway.
+    # Builds of DETERMINISTIC sources additionally persist across runs in
+    # a structural-hash cache (the scan cache's policy extended to build
+    # subtrees): a repeated chunked query skips minutes of build joins.
     for b in plan.build_roots:
         if id(b) not in executor._subst:
-            executor._subst[id(b)] = executor.run(b)
+            executor._subst[id(b)] = executor.run_cached_build(b)
 
     data = executor.catalog.get_table(plan.driver.catalog,
                                       plan.driver.schema_name,

@@ -151,6 +151,8 @@ class DerivedDict(Expr):
     lut: tuple          # old code -> new code (int), len == source pool
     pool: tuple         # deduplicated transformed pool (new code -> str)
     dtype: DataType     # VARCHAR
+    null_code: Optional[int] = None   # coalesce: NULL rows take this
+    #                                   code and become valid
 
 
 @dataclass(frozen=True, eq=False)
@@ -332,10 +334,11 @@ def remap_columns(expr: Expr, mapping) -> Expr:
         return DecimalAvg(remap_columns(expr.sum, mapping),
                           remap_columns(expr.count, mapping), expr.dtype)
     if isinstance(expr, ExtractField):
-        return ExtractField(expr.part, remap_columns(expr.arg, mapping))
+        return ExtractField(expr.part, remap_columns(expr.arg, mapping),
+                            expr.dtype)
     if isinstance(expr, DerivedDict):
         return DerivedDict(remap_columns(expr.arg, mapping), expr.lut,
-                           expr.pool, expr.dtype)
+                           expr.pool, expr.dtype, expr.null_code)
     if isinstance(expr, ScalarFunc):
         return ScalarFunc(expr.name,
                           tuple(remap_columns(a, mapping)

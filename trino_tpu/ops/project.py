@@ -339,7 +339,11 @@ def eval_expr(expr: ir.Expr, batch: Batch):
         d, v = eval_expr(expr.arg, batch)
         lut = jnp.asarray(expr.lut, dtype=jnp.int32)
         codes = jnp.clip(d.astype(jnp.int32), 0, len(expr.lut) - 1)
-        return lut[codes], v
+        out = lut[codes]
+        if expr.null_code is not None:    # varchar coalesce-to-literal
+            out = jnp.where(v, out, jnp.int32(expr.null_code))
+            v = jnp.ones_like(v)
+        return out, v
 
     if isinstance(expr, ir.DictPredicate):
         d, v = eval_expr(expr.arg, batch)

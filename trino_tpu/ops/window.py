@@ -51,7 +51,8 @@ class WinSpec:
 
     def __post_init__(self):
         assert self.func in RANKING + VALUE_FUNCS + AGG_FUNCS, self.func
-        assert self.frame in FRAMES, self.frame
+        assert self.frame in FRAMES or \
+            self.frame.startswith("rows_bounded:"), self.frame
 
 
 def _scan_max(vals: jax.Array) -> jax.Array:
@@ -182,14 +183,23 @@ def window_compute(batch: Batch, partition_keys: tuple, order_keys: tuple,
             col = Column(data_s[end][invperm],
                          (valid_s[end])[invperm] & batch.live)
         else:                                     # framed aggregates
-            end = frame_end(spec.frame)
-            before = jnp.where(part_start > 0,
-                               jnp.clip(part_start - 1, 0, n - 1), 0)
+            if spec.frame.startswith("rows_bounded:"):
+                _, p_s, f_s = spec.frame.split(":")
+                fstart = jnp.maximum(part_start, idx - int(p_s))
+                end = jnp.minimum(part_end, idx + int(f_s))
+                empty = end < fstart
+                end = jnp.clip(end, 0, n - 1)
+            else:
+                fstart = part_start
+                end = frame_end(spec.frame)
+                empty = jnp.zeros(n, dtype=jnp.bool_)
+            before = jnp.where(fstart > 0,
+                               jnp.clip(fstart - 1, 0, n - 1), 0)
 
             def running_total(vals):
                 cs = jnp.cumsum(vals)
-                lo = jnp.where(part_start > 0, cs[before], 0)
-                return cs[end] - lo
+                lo = jnp.where(fstart > 0, cs[before], 0)
+                return jnp.where(empty, 0, cs[end] - lo)
 
             if f == "count_star":
                 data = running_total(live_s.astype(jnp.int64))

@@ -456,6 +456,18 @@ class ExpressionLowerer:
             from ..types import BOOLEAN as _B
             return ir.DictPredicate(args[0],
                                     tuple(v in t for t in pool), _B)
+        if name == "coalesce" and len(args) == 2 and \
+                not isinstance(args[0], _StringConst) and \
+                args[0].dtype.kind is TypeKind.VARCHAR and \
+                isinstance(args[1], _StringConst):
+            # varchar coalesce-to-literal: identity pool transform whose
+            # NULL rows take the literal's (possibly appended) code
+            col, lit = args[0], args[1].value
+            pool = self.pool_of(col)
+            new_pool = tuple(pool) if lit in pool else tuple(pool) + (lit,)
+            lut = tuple(range(len(pool)))
+            return ir.DerivedDict(col, lut, new_pool, col.dtype,
+                                  null_code=new_pool.index(lit))
         if name == "concat":
             return self.lower_concat(args)
         if name == "replace":

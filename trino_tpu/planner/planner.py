@@ -1292,10 +1292,34 @@ class Planner:
         if f is None:
             return "range_running"    # SQL default frame
         if f.start != "unbounded_preceding":
+            # bounded N-row frames: ROWS BETWEEN p PRECEDING AND
+            # (CURRENT ROW | f FOLLOWING) — FramedWindowFunction's role
+            if f.unit == "rows" and f.start.endswith("_preceding") and \
+                    f.start[0].isdigit():
+                p = int(f.start.split("_")[0])
+                if f.end == "current_row":
+                    fl = 0
+                elif f.end.endswith("_following") and f.end[0].isdigit():
+                    fl = int(f.end.split("_")[0])
+                else:
+                    raise AnalysisError(
+                        f"unsupported ROWS frame end {f.end!r}")
+                return f"rows_bounded:{p}:{fl}"
             raise AnalysisError(
-                "only UNBOUNDED PRECEDING frame starts are supported")
+                "only UNBOUNDED PRECEDING or n PRECEDING (ROWS) frame "
+                "starts are supported")
         if f.end == "current_row":
             return "rows_running" if f.unit == "rows" else "range_running"
+        if f.end.endswith("_following") and f.end[0].isdigit():
+            if f.unit != "rows":
+                raise AnalysisError(
+                    "RANGE frames with numeric bounds are unsupported")
+            # UNBOUNDED PRECEDING .. f FOLLOWING: bounded with a huge
+            # preceding span (partition sizes are < 2^31)
+            return f"rows_bounded:{(1 << 31) - 1}:{int(f.end.split('_')[0])}"
+        if f.end.endswith("_preceding") and f.end[0].isdigit():
+            raise AnalysisError(
+                "frames ending before CURRENT ROW are unsupported")
         return "partition"            # UNBOUNDED FOLLOWING
 
     def plan_windows(self, node: L.PlanNode, calls: List[A.WindowFunc],
@@ -1344,6 +1368,11 @@ class Planner:
                 okeys.append(L.SortKey(idx, o.ascending, nf))
             rec = {"part": part, "order": tuple(okeys)}
             name, frame = call.name, self.frame_mode(call)
+            if frame.startswith("rows_bounded") and \
+                    name not in ("sum", "count", "avg"):
+                raise AnalysisError(
+                    f"bounded ROWS frames support sum/count/avg "
+                    f"(not {name})")
             fields[call] = None
             if name in ("row_number", "rank", "dense_rank"):
                 rec["specs"] = [L.WinSpecNode(name, None, frame, 1, None,

@@ -850,6 +850,17 @@ class Parser:
                         return "unbounded_preceding"
                     self.expect_kw("FOLLOWING")
                     return "unbounded_following"
+                if self.peek().kind == "number":
+                    raw = self.advance().text
+                    try:
+                        k = int(raw)
+                    except ValueError:
+                        self.fail(f"frame bound must be an integer, "
+                                  f"got {raw!r}")
+                    if self.accept_kw("PRECEDING"):
+                        return f"{k}_preceding"
+                    self.expect_kw("FOLLOWING")
+                    return f"{k}_following"
                 self.expect_kw("CURRENT")
                 self.expect_kw("ROW")
                 return "current_row"

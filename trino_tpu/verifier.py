@@ -53,13 +53,42 @@ class Verifier:
         # otherwise load directly
         self.control = _load_sqlite(datasets)
 
+    # per-query wall cap: a wedged accelerator tunnel HANGS inside a
+    # native call (signals cannot interrupt it), so the watchdog is a
+    # thread that records the timeout and hard-exits the process — with
+    # --resume, the next invocation picks up after the recorded queries
+    # (Verifier.java's per-query timeout, adapted to the tunnel reality)
+    query_timeout_s: Optional[float] = None
+    on_timeout = None          # callable(name) -> None, set by the CLI
+
     def verify(self, name: str, sql: str,
                control_sql: Optional[str] = None) -> VerifyResult:
         t0 = time.monotonic()
+        watchdog = None
         try:
+            if self.query_timeout_s:
+                import os
+                import threading
+
+                def _expired():
+                    if self.on_timeout is not None:
+                        try:
+                            self.on_timeout(name)
+                        except Exception:    # noqa: BLE001
+                            pass
+                    print(f"TIMEOUT {name}: exceeded "
+                          f"{self.query_timeout_s}s (wedged tunnel?); "
+                          f"exiting — rerun with --resume", flush=True)
+                    os._exit(3)
+                watchdog = threading.Timer(self.query_timeout_s, _expired)
+                watchdog.daemon = True
+                watchdog.start()
             test_rows = self.session.execute(sql).rows
         except Exception as e:            # noqa: BLE001
             return VerifyResult(name, "TEST_ERROR", f"{e}")
+        finally:
+            if watchdog is not None:
+                watchdog.cancel()
         test_ms = (time.monotonic() - t0) * 1000
         t0 = time.monotonic()
         try:
@@ -230,6 +259,13 @@ def main(argv=None) -> int:
     ap.add_argument("--platform", choices=["cpu", "tpu"],
                     help="force a JAX platform (env vars are overridden "
                          "by accelerator tunnels; the config API wins)")
+    ap.add_argument("--timeout-s", type=float, default=0,
+                    help="per-query wall cap (0 = none): a wedged tunnel "
+                         "hangs, this turns it into TEST_TIMEOUT")
+    ap.add_argument("--resume", metavar="FILE",
+                    help="append results to FILE (jsonl) and skip "
+                         "queries already recorded there — a killed "
+                         "sweep resumes instead of restarting")
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -265,16 +301,58 @@ def main(argv=None) -> int:
             from tpcds_queries import QUERIES as queries  # type: ignore
         except ImportError:
             pass
+    if args.timeout_s:
+        verifier.query_timeout_s = args.timeout_s
+        if args.resume:
+            def _record_timeout(name):
+                import json
+                with open(args.resume, "a") as f:
+                    f.write(json.dumps(
+                        {"name": name, "status": "TEST_TIMEOUT",
+                         "test_ms": args.timeout_s * 1000,
+                         "detail": "watchdog hard-exit"}) + "\n")
+            verifier.on_timeout = _record_timeout
+
+    done = {}
+    if args.resume:
+        import json
+        import os.path
+        timeouts = {}
+        if os.path.exists(args.resume):
+            with open(args.resume) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    done[rec["name"]] = rec["status"]
+                    if rec["status"] == "TEST_TIMEOUT":
+                        timeouts[rec["name"]] = \
+                            timeouts.get(rec["name"], 0) + 1
+        # retry non-MATCH (a fresh attempt resumes cached compiles and
+        # gets further), but give up on a query that timed out 3 times
+        queries = {k: v for k, v in queries.items()
+                   if str(k) not in done or
+                   (done[str(k)] != "MATCH" and
+                    timeouts.get(str(k), 0) < 3)}
+        if done:
+            print(f"resuming: {len(done)} recorded, "
+                  f"{len(queries)} to run", flush=True)
+
     def show(r):
         mark = "OK " if r.status == "MATCH" else "FAIL"
         print(f"{mark} {r.name:>6}  {r.status:14} test={r.test_ms:8.1f}ms "
               f"control={r.control_ms:8.1f}ms rows={r.test_rows}"
               + (f"  {r.detail}" if r.detail else ""), flush=True)
+        if args.resume:
+            import json
+            with open(args.resume, "a") as f:
+                f.write(json.dumps({"name": r.name, "status": r.status,
+                                    "test_ms": r.test_ms,
+                                    "detail": r.detail[:200]}) + "\n")
 
     results = verifier.run_suite(queries, on_result=show)
     fails = sum(r.status != "MATCH" for r in results)
-    print(f"{len(results) - fails}/{len(results)} queries verified"
-          " identical")
+    prior = sum(1 for s in done.values() if s == "MATCH")
+    print(f"{len(results) - fails + prior}/{len(results) + prior} "
+          f"queries verified identical")
     return 1 if fails else 0
 
 
