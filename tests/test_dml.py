@@ -152,3 +152,22 @@ def test_merge_duplicate_source_rows_error(session):
             MERGE INTO accounts a USING feed f ON a.id = f.id
             WHEN MATCHED THEN UPDATE SET bal = f.amount
         """)
+
+
+def test_update_unseen_varchar_keeps_pool_sorted(session):
+    """Regression: UPDATE/INSERT with a varchar value absent from the
+    stored pool must keep the pool sorted (code order == string order)
+    and renumber existing codes — appending silently corrupts ORDER BY
+    and range compares on later queries."""
+    session.execute("UPDATE m.s.accounts SET name = 'zed' WHERE id = 1")
+    session.execute("UPDATE m.s.accounts SET name = 'amy' WHERE id = 3")
+    rows = session.execute(
+        "SELECT id, name FROM m.s.accounts ORDER BY name").rows
+    assert rows == [(3, "amy"), (2, "bob"), (4, "dan"), (1, "zed")]
+    n = session.execute("SELECT count(*) FROM m.s.accounts "
+                        "WHERE name < 'dan'").rows
+    assert n == [(2,)]
+    session.execute("INSERT INTO m.s.accounts VALUES (9, 'cat', 1)")
+    rows = session.execute(
+        "SELECT id FROM m.s.accounts ORDER BY name DESC").rows
+    assert rows == [(1,), (4,), (9,), (2,), (3,)]

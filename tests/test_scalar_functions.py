@@ -170,3 +170,32 @@ def test_approx_distinct_and_bool_aggs(session):
                bool_or(o_totalprice > 200000)
         FROM orders GROUP BY o_orderstatus ORDER BY o_orderstatus""").rows
     assert len(grouped) >= 2
+
+
+def test_coalesce_varchar_unseen_literal_keeps_pool_sorted():
+    """Regression (round-3 advisor, high): coalesce(varchar_col, 'lit')
+    with a literal absent from the pool must INSERT it at its sorted
+    position — appending breaks code-order == string-order, silently
+    corrupting range compares / ORDER BY on the result."""
+    from trino_tpu.catalog import Catalog
+    from trino_tpu.connectors.memory import MemoryConnector
+    cat = Catalog()
+    cat.register("m", MemoryConnector())
+    s = Session(catalog=cat, default_cat="m", default_schema="s")
+    s.execute("CREATE TABLE m.s.t (id bigint, v varchar)")
+    s.execute("INSERT INTO m.s.t VALUES (1, 'apple'), (2, NULL),"
+              " (3, 'zebra'), (4, NULL), (5, 'mango')")
+    # literal sorts strictly between existing pool entries
+    rows = s.execute(
+        "SELECT id, coalesce(v, 'banana') FROM m.s.t "
+        "ORDER BY coalesce(v, 'banana'), id").rows
+    assert rows == [(1, "apple"), (2, "banana"), (4, "banana"),
+                    (5, "mango"), (3, "zebra")]
+    # range compare across the inserted code
+    n = s.execute("SELECT count(*) FROM m.s.t "
+                  "WHERE coalesce(v, 'banana') < 'mango'").rows
+    assert n == [(3,)]
+    # literal sorts before everything (null_code = 0, all codes shift)
+    rows = s.execute("SELECT id FROM m.s.t "
+                     "ORDER BY coalesce(v, 'aaa') DESC, id").rows
+    assert rows == [(3,), (5,), (1,), (2,), (4,)]

@@ -16,19 +16,37 @@ from .tpch.datagen import TableData
 def _remap_codes(target_field: Field, src_field: Optional[Field],
                  codes: np.ndarray):
     """Translate VARCHAR codes from `src_field`'s pool into
-    `target_field`'s, extending the target pool with unseen strings.
-    Returns (remapped codes, updated target Field)."""
-    pool = list(target_field.dictionary or ())
-    index = {s: j for j, s in enumerate(pool)}
-    src_pool = (src_field.dictionary or ()) if src_field else ()
-    remap = np.zeros(max(len(src_pool), 1), dtype=np.int32)
-    for j, s in enumerate(src_pool):
-        if s not in index:
-            index[s] = len(pool)
-            pool.append(s)
-        remap[j] = index[s]
-    return remap[np.asarray(codes, dtype=np.int32)], Field(
-        target_field.name, target_field.dtype, dictionary=tuple(pool))
+    `target_field`'s, extending the target pool with unseen strings while
+    KEEPING THE POOL SORTED — the engine-wide invariant that code order ==
+    string order (varchar range compares, ORDER BY, min/max all rely on
+    it), so unseen strings INSERT at their sorted position rather than
+    append. That can renumber existing codes, so the remap for the
+    STORED column's codes is returned too.
+
+    Returns (remapped incoming codes, remap array for existing stored
+    codes or None if their numbering is unchanged, updated Field)."""
+    old_pool = tuple(target_field.dictionary or ())
+    src_pool = tuple(src_field.dictionary or ()) if src_field else ()
+    merged = tuple(sorted(set(old_pool) | set(src_pool)))
+    index = {s: j for j, s in enumerate(merged)}
+    src_remap = np.array([index[s] for s in src_pool] or [0],
+                         dtype=np.int32)
+    old_remap = None
+    if merged != old_pool and old_pool:
+        old_remap = np.array([index[s] for s in old_pool],
+                             dtype=np.int32)
+    new_codes = src_remap[np.clip(np.asarray(codes, dtype=np.int32),
+                                  0, len(src_remap) - 1)]
+    return new_codes, old_remap, Field(
+        target_field.name, target_field.dtype, dictionary=merged)
+
+
+def _apply_old_remap(old_codes: np.ndarray,
+                     old_remap: Optional[np.ndarray]) -> np.ndarray:
+    if old_remap is None or len(old_codes) == 0:
+        return old_codes
+    return old_remap[np.clip(np.asarray(old_codes, dtype=np.int32),
+                             0, len(old_remap) - 1)]
 
 
 class MemoryConnector:
@@ -83,7 +101,8 @@ class MemoryConnector:
             add = np.asarray(arrays[i])
             fld = tf
             if tf.dtype.kind is TypeKind.VARCHAR:
-                add, fld = _remap_codes(tf, nf, add)
+                add, old_remap, fld = _remap_codes(tf, nf, add)
+                old = _apply_old_remap(old, old_remap)
             elif add.dtype != old.dtype:
                 add = add.astype(old.dtype)
             new_cols.append(np.concatenate([old, add]))
@@ -144,7 +163,9 @@ class MemoryConnector:
             tf = fields[i]
             vals = np.asarray(vals)
             if tf.dtype.kind is TypeKind.VARCHAR:
-                vals, fields[i] = _remap_codes(tf, src_field, vals)
+                vals, old_remap, fields[i] = _remap_codes(tf, src_field,
+                                                          vals)
+                cols[i] = _apply_old_remap(cols[i], old_remap)
             else:
                 vals = vals.astype(cols[i].dtype)
             cols[i][ids] = vals

@@ -238,6 +238,8 @@ def streaming_build_join(executor, node: L.JoinNode,
     from ..ops.join import build_lut_chunk
     lut = jnp.full(domain + 1, -1, dtype=jnp.int32)
     cap = pad_capacity(min(chunk_rows, data.num_rows))
+    expected = jnp.zeros((), dtype=jnp.int64)   # in-domain valid build rows
+    oob = jnp.zeros((), dtype=jnp.int64)        # valid keys outside domain
     for start in range(0, data.num_rows, chunk_rows):
         arrays = [np.asarray(data.columns[i])[start:start + chunk_rows]
                   for i in scan.column_indices]
@@ -250,8 +252,25 @@ def streaming_build_join(executor, node: L.JoinNode,
         if pred is not None:
             from ..ops.project import apply_filter
             chunk = apply_filter(chunk, pred)
-        lut = build_lut_chunk(lut, chunk, key_in_scan, domain, start)
+        lut, n_in, n_oob = build_lut_chunk(lut, chunk, key_in_scan,
+                                           domain, start)
+        expected = expected + n_in
+        oob = oob + n_oob
         executor.stats.agg_spill_chunks += 1
+
+    # Runtime validation of the planner's uniqueness proof: every resident
+    # path checks dup/oob and degrades gracefully; mirror that here. A
+    # duplicate build key would silently keep only the max row id, and an
+    # out-of-domain key would be clipped into a real slot — both produce
+    # wrong answers, so fall back to the resident-build path instead.
+    # (occupied-slot counting avoids a second domain-sized count array:
+    # dup rows exist iff scattered rows exceed occupied slots.)
+    occupied = jnp.sum((lut[:domain] >= 0).astype(jnp.int64))
+    expected_h, oob_h, occupied_h = (int(x) for x in
+                                     np.asarray(jnp.stack(
+                                         (expected, oob, occupied))))
+    if oob_h > 0 or occupied_h != expected_h:
+        return None
 
     # probe: global row ids out of the LUT
     pk = probe.columns[node.left_keys[0]]

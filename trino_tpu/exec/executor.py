@@ -100,6 +100,13 @@ class Executor:
 
     # ------------------------------------------------------------------
 
+    def invalidate_scan_cache(self) -> None:
+        """Drop cached scans AND their byte accounting together — clearing
+        only the OrderedDict leaves ghost sizes that permanently shrink the
+        effective LRU budget."""
+        self._scan_cache.clear()
+        self._scan_cache_bytes.clear()
+
     def execute(self, root: L.OutputNode) -> Batch:
         assert isinstance(root, L.OutputNode)
         # release reservations surviving from the previous query (the root

@@ -300,7 +300,7 @@ class Session:
         n = self.catalog.connector(cat).insert(sch, tbl, arrays, valids,
                                                fields)
         # stored table changed: refresh any cached scans
-        self.executor._scan_cache.clear()
+        self.executor.invalidate_scan_cache()
         return QueryResult(["rows"], [(n,)], time.monotonic() - t0)
 
     # ---- UPDATE / DELETE / MERGE (row-id + delete-mask scheme) ----------
@@ -390,7 +390,7 @@ class Session:
                 n = conn.update_rows(sch, tbl, ids, updates)
         finally:
             conn.drop_table(sch, shadow, if_exists=True)
-        self.executor._scan_cache.clear()
+        self.executor.invalidate_scan_cache()
         return QueryResult(["rows"], [(n,)], time.monotonic() - t0)
 
     def execute_merge(self, stmt: "A.MergeInto", t0) -> QueryResult:
@@ -498,7 +498,7 @@ class Session:
                                  full_fields)
         finally:
             conn.drop_table(sch, shadow, if_exists=True)
-        self.executor._scan_cache.clear()
+        self.executor.invalidate_scan_cache()
         return QueryResult(["rows"], [(n,)], time.monotonic() - t0)
 
     def query_to_columns(self, query):

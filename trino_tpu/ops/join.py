@@ -152,13 +152,21 @@ def build_lut_chunk(lut: jax.Array, chunk: Batch, key_idx: int,
     """Scatter one build chunk's GLOBAL row ids into a persistent dense
     LUT (streaming-build join, exec/chunked.py): the LUT is domain-sized
     regardless of build row count, so arbitrarily large build sides
-    stream through one chunk of HBM."""
+    stream through one chunk of HBM.
+
+    Also returns (in-domain valid rows, out-of-domain valid rows) so the
+    caller can validate the planner's uniqueness proof at runtime
+    (duplicates show up as scattered-rows > occupied-slots; oob keys
+    would be silently clipped) without a second kernel per chunk."""
     key = chunk.columns[key_idx]
     ok = chunk.live & key.valid
+    in_dom = ok & (key.data >= 0) & (key.data < domain)
     idx = jnp.where(ok, jnp.clip(key.data, 0, domain - 1), domain)
     rows = (jnp.arange(chunk.capacity, dtype=jnp.int64) +
             start).astype(jnp.int32)
-    return lut.at[idx].max(rows, mode="drop")
+    return (lut.at[idx].max(rows, mode="drop"),
+            jnp.sum(in_dom, dtype=jnp.int64),
+            jnp.sum(ok & ~in_dom, dtype=jnp.int64))
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4))

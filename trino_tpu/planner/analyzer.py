@@ -460,14 +460,24 @@ class ExpressionLowerer:
                 not isinstance(args[0], _StringConst) and \
                 args[0].dtype.kind is TypeKind.VARCHAR and \
                 isinstance(args[1], _StringConst):
-            # varchar coalesce-to-literal: identity pool transform whose
-            # NULL rows take the literal's (possibly appended) code
+            # varchar coalesce-to-literal: pool transform whose NULL rows
+            # take the literal's code. Pools must stay lexicographically
+            # sorted (code order == string order is relied on by varchar
+            # range compares, ORDER BY, min/max), so an unseen literal is
+            # INSERTED at its sorted position and existing codes at or
+            # after the insertion point shift up by one.
+            import bisect
             col, lit = args[0], args[1].value
-            pool = self.pool_of(col)
-            new_pool = tuple(pool) if lit in pool else tuple(pool) + (lit,)
-            lut = tuple(range(len(pool)))
+            pool = tuple(self.pool_of(col))
+            if lit in pool:
+                return ir.DerivedDict(col, tuple(range(len(pool))), pool,
+                                      col.dtype,
+                                      null_code=pool.index(lit))
+            ins = bisect.bisect_left(pool, lit)
+            new_pool = pool[:ins] + (lit,) + pool[ins:]
+            lut = tuple(i if i < ins else i + 1 for i in range(len(pool)))
             return ir.DerivedDict(col, lut, new_pool, col.dtype,
-                                  null_code=new_pool.index(lit))
+                                  null_code=ins)
         if name == "concat":
             return self.lower_concat(args)
         if name == "replace":

@@ -104,15 +104,20 @@ class StageScheduler:
     local execution by returning None (the caller keeps the single-node
     path — Trino's coordinator-only queries take the same shortcut)."""
 
-    def __init__(self, coordinator_state, session, split_rows: int = 250_000,
-                 max_task_retries: int = 2, task_timeout_s: float = 300.0,
+    def __init__(self, coordinator_state, session, split_rows: int = None,
+                 max_task_retries: int = None, task_timeout_s: float = 300.0,
                  spool=None):
         self.state = coordinator_state
         self.session = session
+        # Constructor args, when given, override session properties —
+        # SESSION_PROPERTY_DEFAULTS pre-populates every key, so a plain
+        # props.get(name, arg) would silently ignore the caller's values.
         props = getattr(session, "properties", {})
-        self.split_rows = props.get("split_rows", split_rows)
-        self.max_task_retries = props.get("task_retries",
-                                          max_task_retries)
+        self.split_rows = split_rows if split_rows is not None \
+            else props.get("split_rows", 250_000)
+        self.max_task_retries = max_task_retries \
+            if max_task_retries is not None \
+            else props.get("task_retries", 2)
         self.task_timeout_s = task_timeout_s
         self._seq = 0
         self._lock = threading.Lock()

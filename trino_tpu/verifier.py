@@ -327,14 +327,22 @@ def main(argv=None) -> int:
                         timeouts[rec["name"]] = \
                             timeouts.get(rec["name"], 0) + 1
         # retry non-MATCH (a fresh attempt resumes cached compiles and
-        # gets further), but give up on a query that timed out 3 times
+        # gets further), but give up on a query that timed out 3 times —
+        # those count as FAILURES in the summary/exit code, never as
+        # verified.
+        gave_up = sorted(k for k in list(queries)
+                         if str(k) in done and done[str(k)] != "MATCH"
+                         and timeouts.get(str(k), 0) >= 3)
         queries = {k: v for k, v in queries.items()
                    if str(k) not in done or
                    (done[str(k)] != "MATCH" and
                     timeouts.get(str(k), 0) < 3)}
         if done:
             print(f"resuming: {len(done)} recorded, "
-                  f"{len(queries)} to run", flush=True)
+                  f"{len(queries)} to run, "
+                  f"{len(gave_up)} given up (count as FAIL)", flush=True)
+    else:
+        gave_up = []
 
     def show(r):
         mark = "OK " if r.status == "MATCH" else "FAIL"
@@ -349,10 +357,12 @@ def main(argv=None) -> int:
                                     "detail": r.detail[:200]}) + "\n")
 
     results = verifier.run_suite(queries, on_result=show)
-    fails = sum(r.status != "MATCH" for r in results)
+    fails = sum(r.status != "MATCH" for r in results) + len(gave_up)
     prior = sum(1 for s in done.values() if s == "MATCH")
-    print(f"{len(results) - fails + prior}/{len(results) + prior} "
-          f"queries verified identical")
+    total = len(results) + prior + len(gave_up)
+    print(f"{total - fails}/{total} queries verified identical"
+          + (f" ({len(gave_up)} permanently timed out: "
+             f"{', '.join(str(g) for g in gave_up)})" if gave_up else ""))
     return 1 if fails else 0
 
 
