@@ -33,13 +33,38 @@ BUILD side fall back to single-shot execution.
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..batch import (Batch, Column, batch_from_numpy, batch_to_numpy,
                      bucket_capacity, pad_capacity)
 from ..planner import logical as L
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _slice_widen(cap: int, wide_names: tuple, datas, valids,
+                 start, end, num_rows):
+    """Slice one chunk straight from device-resident narrowed columns
+    (exec/device_cache.py): dynamic_slice + widen to the engine's lane
+    dtype + live mask. The slice offset clamps so the last (short) chunk
+    re-reads the tail of the previous one, with the live mask excluding
+    the overlap — every chunk shares ONE trace and never touches the
+    host link."""
+    idx = jnp.arange(cap, dtype=jnp.int64)
+    s0 = jnp.clip(start, 0, jnp.maximum(num_rows - cap, 0))
+    cols = []
+    for a, v, wn in zip(datas, valids, wide_names):
+        sl = jax.lax.dynamic_slice(a, (s0,), (cap,))
+        data = sl if str(sl.dtype) == wn else sl.astype(jnp.dtype(wn))
+        valid = jnp.ones(cap, jnp.bool_) if v is None else \
+            jax.lax.dynamic_slice(v, (s0,), (cap,))
+        cols.append(Column(data, valid))
+    live = ((s0 + idx) >= start) & ((s0 + idx) < end)
+    return Batch(tuple(cols), live)
 
 # partial-state merge functions (HashAggregationOperator's
 # intermediate-state combine): min/max idempotent, sums/counts add
@@ -144,32 +169,71 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
     concat_valids: List[list] = []
     # one shared padded capacity => one jit trace for every chunk
     cap = pad_capacity(min(chunk_rows, plan.driver_rows))
-    for start in range(0, plan.driver_rows, chunk_rows):
-        arrays = [np.asarray(data.columns[i])[start:start + chunk_rows]
-                  for i in plan.driver.column_indices]
-        valids = None
-        if data.valids is not None:
-            valids = [None if data.valids[i] is None else
-                      np.asarray(data.valids[i])[start:start + chunk_rows]
-                      for i in plan.driver.column_indices]
-        chunk = batch_from_numpy(arrays, valids=valids, capacity=cap)
-        executor._subst[id(plan.driver)] = chunk
-        try:
-            out = executor.run(per_chunk_target)
-        finally:
-            executor._subst.pop(id(plan.driver), None)
-            # the per-chunk path recomputes these nodes next iteration;
-            # release their reservations now so the pool reflects only
-            # pinned builds + accumulated partials
-            executor.release_path_reservations(per_chunk_target,
-                                               keep=executor._subst)
-        executor.stats.agg_spill_chunks += 1
-        if plan.merge_agg is not None:
-            partials.append(out)
-        else:
-            arrs, vals = batch_to_numpy(out)
-            concat_arrays.append(arrs)
-            concat_valids.append(vals)
+
+    # device-resident narrowed fact columns: when the driver scan fits
+    # the HBM budget in its narrowest dtypes, chunks slice straight from
+    # device memory (steady state never touches the ~30 MB/s host link)
+    fact = None
+    if executor.enable_fact_cache and cap <= plan.driver_rows:
+        key = (plan.driver.catalog, plan.driver.schema_name,
+               plan.driver.table, tuple(plan.driver.column_indices))
+        if executor.fact_cache.estimate_bytes(
+                data, plan.driver.column_indices) <= \
+                executor.fact_cache.max_bytes:
+            if executor.fact_cache.get(key) is None:
+                # about to claim several GB of HBM: raw cached scans are
+                # dead weight now (the pinned builds already consumed
+                # them) — drop them first, NOT the fact cache itself
+                executor._scan_cache.clear()
+                executor._scan_cache_bytes.clear()
+            fact = executor.fact_cache.load(key, data,
+                                            plan.driver.column_indices)
+    if fact is not None:
+        fact_datas = tuple(c.data for c in fact)
+        fact_valids = tuple(c.valid for c in fact)
+        fact_wide = tuple(str(c.wide_dtype) for c in fact)
+
+    executor.enter_chunk_mode()
+    try:
+        for start in range(0, plan.driver_rows, chunk_rows):
+            if fact is not None:
+                chunk = _slice_widen(
+                    cap, fact_wide, fact_datas, fact_valids, start,
+                    min(start + chunk_rows, plan.driver_rows),
+                    plan.driver_rows)
+            else:
+                arrays = [np.asarray(data.columns[i])
+                          [start:start + chunk_rows]
+                          for i in plan.driver.column_indices]
+                valids = None
+                if data.valids is not None:
+                    valids = [None if data.valids[i] is None else
+                              np.asarray(data.valids[i])
+                              [start:start + chunk_rows]
+                              for i in plan.driver.column_indices]
+                chunk = batch_from_numpy(arrays, valids=valids,
+                                         capacity=cap)
+            executor._subst[id(plan.driver)] = chunk
+            try:
+                out = executor.run(per_chunk_target)
+            finally:
+                executor._subst.pop(id(plan.driver), None)
+                # the per-chunk path recomputes these nodes next
+                # iteration; release their reservations now so the pool
+                # reflects only pinned builds + accumulated partials
+                executor.release_path_reservations(per_chunk_target,
+                                                   keep=executor._subst)
+            executor.stats.agg_spill_chunks += 1
+            if fact is not None:
+                executor.stats.fact_cache_chunks += 1
+            if plan.merge_agg is not None:
+                partials.append(out)
+            else:
+                arrs, vals = batch_to_numpy(out)
+                concat_arrays.append(arrs)
+                concat_valids.append(vals)
+    finally:
+        executor.exit_chunk_mode()
 
     if plan.merge_agg is None:
         ncols = len(concat_arrays[0])

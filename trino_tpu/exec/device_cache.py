@@ -1,0 +1,151 @@
+"""Device-resident fact-column cache with range-compressed dtypes.
+
+Reference role: Trino's memory-pinned page cache / the Hive split cache
+keep hot table pages in RAM near the workers; the columnar formats
+(ORC/Parquet) store integers bit-packed so the hot set fits. On TPU the
+scarce tier is HBM and the host link is the bottleneck (measured here:
+~30 MB/s random, ~60 MB/s compressible through the tunnel — even a real
+PCIe v5e host link is dwarfed by 800 GB/s HBM), so the same two ideas
+move on-device: keep the fact table's scanned columns resident in HBM,
+and store them in the NARROWEST integer dtype their value range allows
+(connector stats or a one-time host min/max pass), widening to the
+engine's int64 lanes chunk-by-chunk inside the jitted pipeline.
+
+A 600M-row TPC-H SF100 lineitem q5 projection drops from 19.2 GB
+(int64) to 7.8 GB (int32 keys/prices, int8 discount) — it fits a single
+v5e chip's HBM, so steady-state queries never touch the host link at
+all; the chunked driver (exec/chunked.py) then slices chunks directly
+from the resident arrays.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class NarrowColumn:
+    """One device-resident column: narrow-dtype data + optional validity."""
+
+    __slots__ = ("data", "valid", "wide_dtype")
+
+    def __init__(self, data, valid, wide_dtype):
+        self.data = data          # jax.Array, narrowest safe dtype
+        self.valid = valid        # jax.Array bool or None (all valid)
+        self.wide_dtype = wide_dtype  # dtype the engine's lanes expect
+
+    @property
+    def nbytes(self) -> int:
+        n = self.data.size * self.data.dtype.itemsize
+        if self.valid is not None:
+            n += self.valid.size
+        return n
+
+
+_INT_STEPS = (np.int8, np.int16, np.int32, np.int64)
+
+
+def _narrow_dtype(arr: np.ndarray, valid: Optional[np.ndarray]):
+    """Smallest signed integer dtype holding the column's valid values."""
+    if not np.issubdtype(arr.dtype, np.integer):
+        return arr.dtype                       # floats/bools ship as-is
+    if valid is not None:
+        vals = arr[valid]
+        if len(vals) == 0:
+            return np.int8
+        lo, hi = int(vals.min()), int(vals.max())
+    elif len(arr) == 0:
+        return np.int8
+    else:
+        lo, hi = int(arr.min()), int(arr.max())
+    for dt in _INT_STEPS:
+        info = np.iinfo(dt)
+        if info.min <= lo and hi <= info.max:
+            return dt
+    return np.int64
+
+
+class FactTableCache:
+    """LRU of device-resident narrowed fact tables, capped by HBM bytes.
+
+    Keys are (catalog, schema, table, column_indices, table_version) so a
+    mutated memory-connector table never aliases a stale resident copy.
+    """
+
+    def __init__(self, max_bytes: int = 9 << 30):
+        self.max_bytes = max_bytes
+        self._entries: "OrderedDict[tuple, Tuple[List[NarrowColumn], int]]" \
+            = OrderedDict()
+        self._bytes: Dict[tuple, int] = {}
+
+    def total_bytes(self) -> int:
+        return sum(self._bytes.values())
+
+    def get(self, key) -> Optional[List[NarrowColumn]]:
+        hit = self._entries.get(key)
+        if hit is None:
+            return None
+        self._entries.move_to_end(key)
+        return hit[0]
+
+    def invalidate(self) -> int:
+        """Drop everything (DML invalidation); returns bytes released."""
+        freed = self.total_bytes()
+        self._entries.clear()
+        self._bytes.clear()
+        return freed
+
+    def estimate_bytes(self, data, column_indices) -> int:
+        """Cheap upper estimate WITHOUT the min/max pass: assumes int32
+        narrowing for int64 (the common case for keys/prices) and adds
+        validity bytes. Used to early-reject tables that cannot fit."""
+        n = data.num_rows
+        total = 0
+        for i in column_indices:
+            arr = np.asarray(data.columns[i])
+            itemsize = min(arr.dtype.itemsize, 4) \
+                if np.issubdtype(arr.dtype, np.integer) else \
+                arr.dtype.itemsize
+            total += n * itemsize
+            if data.valids is not None and data.valids[i] is not None:
+                total += n
+        return total
+
+    def load(self, key, data, column_indices) -> \
+            Optional[List[NarrowColumn]]:
+        """Narrow + ship `column_indices` of `data` to device, evicting
+        LRU entries to fit. None if the table can't fit the budget."""
+        import jax
+
+        hit = self.get(key)
+        if hit is not None:
+            return hit
+        cols: List[NarrowColumn] = []
+        total = 0
+        for i in column_indices:
+            arr = np.asarray(data.columns[i])
+            valid_np = None
+            if data.valids is not None and data.valids[i] is not None:
+                valid_np = np.asarray(data.valids[i])
+            dt = _narrow_dtype(arr, valid_np)
+            total += arr.shape[0] * np.dtype(dt).itemsize + \
+                (arr.shape[0] if valid_np is not None else 0)
+            if total > self.max_bytes:
+                return None
+            narrow = arr if arr.dtype == dt else arr.astype(dt)
+            if valid_np is not None and narrow is not arr:
+                # invalid slots may hold out-of-range garbage: zero them
+                # so the narrowed cast is well-defined
+                narrow = np.where(valid_np, narrow, np.zeros((), dt))
+            cols.append(NarrowColumn(
+                jax.device_put(narrow),
+                None if valid_np is None else jax.device_put(valid_np),
+                arr.dtype))
+        while self._entries and self.total_bytes() + total > self.max_bytes:
+            old, _ = self._entries.popitem(last=False)
+            self._bytes.pop(old, None)
+        self._entries[key] = (cols, data.num_rows)
+        self._bytes[key] = total
+        return cols

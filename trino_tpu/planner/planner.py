@@ -395,6 +395,10 @@ class Planner:
         role)."""
         pending = [self.apply_local_filters(r, conjuncts)
                    for r in relations]
+        if 2 < len(pending) <= self.DP_REORDER_MAX:
+            planned = self._dp_reorder(pending, conjuncts)
+            if planned is not None:
+                return planned
         pending.sort(key=lambda r: -self.estimate_rows(r.node))
         acc = pending.pop(0)
         while pending:
@@ -418,6 +422,130 @@ class Planner:
             acc = self.join_pair(acc, chosen, conjuncts, kind="inner")
             acc = self.apply_local_filters(acc, conjuncts)
         return acc
+
+    # cost-based join reordering explores all connected bushy splits up
+    # to this many relations (2^n subsets; TPC-DS join graphs past ~10
+    # relations fall back to the greedy order)
+    DP_REORDER_MAX = 10
+
+    def _dp_reorder(self, pending, conjuncts) -> \
+            Optional[PlannedRelation]:
+        """Cost-based bushy join reordering (ReorderJoins.java:97 /
+        IterativeOptimizer's memo, reduced to a subset DP: each memo
+        group is a relation subset; the winning split per group is the
+        plan). Cardinalities come from stats.py NDVs with the standard
+        independence assumption; cost = probe rows + 2x build rows +
+        output rows per join, summed over the tree. Unlike the greedy
+        left-deep order, a selective dimension can join a dimension
+        FIRST (bushy build subtrees) — TPC-H q5's orders x customer build
+        side is the canonical win. None = graph disconnected (caller's
+        greedy handles cross joins) or no stats-resolvable edges."""
+        n = len(pending)
+        rows = [max(1.0, self.estimate_rows(r.node)) for r in pending]
+        stats = [self.chain_column_stats(r.node) for r in pending]
+
+        # edges[i][j] = list of per-conjunct max-NDV denominators
+        edges: Dict[Tuple[int, int], List[float]] = {}
+        for c in conjuncts:
+            eq = as_equi(c)
+            if eq is None:
+                continue
+            a, b = eq
+            for i in range(n):
+                for j in range(i + 1, n):
+                    for x, y in ((a, b), (b, a)):
+                        ci = pending[i].scope.try_resolve(x)
+                        cj = pending[j].scope.try_resolve(y)
+                        if ci is None or cj is None:
+                            continue
+                        ndvs = [max(1.0, s.ndv) for s in (
+                            stats[i].get(ci.index) if stats[i] else None,
+                            stats[j].get(cj.index) if stats[j] else None)
+                            if s is not None]
+                        denom = max(ndvs) if ndvs else \
+                            min(rows[i], rows[j])
+                        edges.setdefault((i, j), []).append(
+                            max(1.0, denom))
+                        break
+        if not edges:
+            return None
+
+        def connected(mask: int) -> bool:
+            first = (mask & -mask).bit_length() - 1
+            seen = 1 << first
+            frontier = [first]
+            while frontier:
+                u = frontier.pop()
+                for v in range(n):
+                    if not (mask >> v) & 1 or (seen >> v) & 1:
+                        continue
+                    e = (min(u, v), max(u, v))
+                    if e in edges:
+                        seen |= 1 << v
+                        frontier.append(v)
+            return seen == mask
+        full = (1 << n) - 1
+        if not connected(full):
+            return None
+
+        # per-subset cardinality: product of base rows over the standard
+        # 1/max-NDV reduction for every internal equi edge — identical
+        # for every split of the subset, so the DP is well-defined
+        card: List[float] = [0.0] * (1 << n)
+        for mask in range(1, 1 << n):
+            est = 1.0
+            for i in range(n):
+                if (mask >> i) & 1:
+                    est *= rows[i]
+            for (i, j), denoms in edges.items():
+                if (mask >> i) & 1 and (mask >> j) & 1:
+                    for d in denoms:
+                        est /= d
+            card[mask] = max(1.0, est)
+
+        INF = float("inf")
+        cost = [INF] * (1 << n)
+        split: List[Optional[Tuple[int, int]]] = [None] * (1 << n)
+        for i in range(n):
+            cost[1 << i] = 0.0
+        for mask in range(1, 1 << n):
+            if mask & (mask - 1) == 0 or not connected(mask):
+                continue
+            # enumerate proper sub-splits (A, B); A keeps the lowest bit
+            # so each unordered split is visited once
+            low = mask & -mask
+            sub = (mask - 1) & mask
+            while sub:
+                a, b = sub, mask ^ sub
+                if (a & low) and cost[a] < INF and cost[b] < INF and \
+                        any(((a >> i) & 1) != ((a >> j) & 1)
+                            for (i, j) in edges
+                            if (mask >> i) & 1 and (mask >> j) & 1):
+                    probe_r, build_r = max(card[a], card[b]), \
+                        min(card[a], card[b])
+                    c = cost[a] + cost[b] + probe_r + \
+                        2.0 * build_r + card[mask]
+                    if c < cost[mask]:
+                        cost[mask] = c
+                        split[mask] = (a, b)
+                sub = (sub - 1) & mask
+            if split[mask] is None:
+                return None       # connected mask with no connected
+                                  # split: bail to the greedy order
+
+        def rec(mask: int) -> PlannedRelation:
+            if mask & (mask - 1) == 0:
+                return pending[mask.bit_length() - 1]
+            a, b = split[mask]
+            # larger estimated side goes left (probe): join_pair flips
+            # to the unique side for the build anyway, but left-ness
+            # decides which side stays the streaming spine
+            if card[a] < card[b]:
+                a, b = b, a
+            out = self.join_pair(rec(a), rec(b), conjuncts, kind="inner")
+            return self.apply_local_filters(out, conjuncts)
+
+        return rec(full)
 
     def join_output_estimate(self, acc: PlannedRelation,
                              r: PlannedRelation, conjuncts) -> float:
@@ -782,11 +910,39 @@ class Planner:
                 left_keys.append(lb.index)
                 right_keys.append(ra.index)
                 used.append(c)
-        for c in used:
-            conjuncts.remove(c)
         if not left_keys:
             raise AnalysisError(
                 "cross join without equi-condition not yet supported")
+
+        # Key minimization (inner joins): when several equi edges link the
+        # two sides, using them ALL as join keys forces the multi-column
+        # packed-key kernels (sorted path — no dense LUT). If ONE key pair
+        # alone proves build uniqueness with a dense domain, join on just
+        # that key and leave the other equalities in `conjuncts` — the
+        # caller's apply_local_filters turns them into a (free) post-join
+        # filter. TPC-H q5's c_custkey=o_custkey AND c_nationkey=
+        # s_nationkey is the canonical shape: the nationkey equality
+        # becomes a filter, keeping every join single-key dense.
+        if kind == "inner" and len(left_keys) > 1:
+            for j in range(len(left_keys)):
+                for a, b, ak, bk in ((left, right, left_keys, right_keys),
+                                     (right, left, right_keys, left_keys)):
+                    if not self.is_unique(b, [bk[j]]):
+                        continue
+                    dom = self._dense_key_domain(
+                        b.node, [bk[j]],
+                        [self._scope_field(b.scope, bk[j])])
+                    if dom is None:
+                        continue
+                    used = [used[j]]
+                    left_keys = [left_keys[j]]
+                    right_keys = [right_keys[j]]
+                    break
+                else:
+                    continue
+                break
+        for c in used:
+            conjuncts.remove(c)
 
         # orientation: build side should be unique on its keys if provable;
         # LEFT joins pin the preserved side as probe (no freedom)
