@@ -26,3 +26,23 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# XLA CPU compiler hygiene: one process compiling many hundreds of distinct
+# programs (the full TPC-DS sweep) deterministically SEGFAULTS inside
+# backend_compile_and_load around the ~80th jit-heavy test — reproduced on
+# two unrelated commits, independent of stack size, with the persistent
+# cache off, so it is backend-state accumulation, not this engine. Dropping
+# the live executables every N tests keeps the compiler healthy; the
+# recompiles cost seconds on CPU.
+# ---------------------------------------------------------------------------
+
+_CLEAR_EVERY = 30
+_test_count = [0]
+
+
+def pytest_runtest_teardown(item, nextitem):
+    _test_count[0] += 1
+    if _test_count[0] % _CLEAR_EVERY == 0:
+        jax.clear_caches()

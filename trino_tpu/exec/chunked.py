@@ -72,6 +72,116 @@ MERGE_FUNC = {"sum": "sum", "count": "sum", "count_star": "sum",
               "min": "min", "max": "max"}
 
 
+def compile_fused_chunk(executor, target: L.PlanNode,
+                        driver: L.ScanNode):
+    """Compose the whole per-chunk path (joins with prebuilt LUTs,
+    filters, projections, the partial aggregate) into ONE traced
+    function so every chunk is a single device dispatch with zero host
+    syncs and no per-operator intermediate materialization — XLA fuses
+    across what the per-node executor would run as 6-8 separate
+    programs. Supported shape: Filter/Project chains, single-key
+    unique-build dense joins (driver on the probe side), and a
+    direct/global partial aggregate on top. Returns (fn, join_nodes)
+    where fn(chunk, builds, luts) -> partial Batch and join_nodes lists
+    the JoinNodes in `builds`/`luts` order; None when the shape doesn't
+    apply (caller uses the per-node loop)."""
+    from ..ops.aggregate import (AggSpec, direct_group_aggregate,
+                                 global_aggregate)
+    from ..ops.join import dense_join_with_lut
+    from ..ops.project import apply_filter, filter_project
+
+    joins: List[L.JoinNode] = []
+
+    def emit(node):
+        if node is driver:
+            return lambda chunk, builds, luts: chunk
+        if isinstance(node, L.FilterNode):
+            child = emit(node.child)
+            if child is None:
+                return None
+            pred = executor.fold_scalars(node.predicate)
+            return lambda chunk, b, l: apply_filter(
+                child(chunk, b, l), pred)
+        if isinstance(node, L.ProjectNode):
+            child = emit(node.child)
+            if child is None:
+                return None
+            exprs = executor.fold_scalars_tuple(node.exprs)
+            return lambda chunk, b, l: filter_project(
+                child(chunk, b, l), None, exprs)
+        if isinstance(node, L.JoinNode):
+            if node.kind not in ("inner", "left", "semi", "anti") or \
+                    node.build_key_domain is None or \
+                    not node.build_unique or \
+                    node.residual is not None or node.null_aware or \
+                    len(node.left_keys) != 1:
+                return None
+            child = emit(node.left)
+            if child is None:
+                return None
+            idx = len(joins)
+            joins.append(node)
+            lk, rk, kind = node.left_keys, node.right_keys, node.kind
+            return lambda chunk, b, l: dense_join_with_lut(
+                child(chunk, b, l), b[idx], l[idx], lk, rk, kind)
+        if isinstance(node, L.AggregateNode):
+            child = emit(node.child)
+            if child is None:
+                return None
+            if any(a.distinct for a in node.aggs):
+                return None
+            aggs = tuple(AggSpec(a.func, a.arg.index
+                                 if a.arg is not None else None)
+                         for a in node.aggs)
+            if node.strategy == "global":
+                return lambda chunk, b, l: global_aggregate(
+                    child(chunk, b, l), aggs)
+            if node.strategy == "direct":
+                keys, domains = node.group_keys, node.key_domains
+                return lambda chunk, b, l: direct_group_aggregate(
+                    child(chunk, b, l), keys, domains, aggs)
+            return None
+        return None
+
+    fn = emit(target)
+    if fn is None:
+        return None
+    return fn, joins
+
+
+def _fused_luts(executor, joins) -> Optional[tuple]:
+    """Build + validate the dense LUT for every fused join, reusing the
+    cross-run cache for deterministic builds. ALL dup/oob checks fuse
+    into one device fetch; any violation aborts the fused path (the
+    per-node loop has the graceful fallbacks)."""
+    from ..ops.join import dense_build_lut
+    builds, luts, checks, fresh_keys = [], [], [], []
+    for node in joins:
+        build = executor.run(node.right)
+        builds.append(build)
+        key = executor.build_structure_key(node.right)
+        lut = executor._lut_cache.get((key, node.build_key_domain)) \
+            if key is not None else None
+        if lut is None:
+            lut, dup, oob = dense_build_lut(build, node.right_keys,
+                                            node.build_key_domain)
+            checks.append(dup.astype(jnp.int64))
+            checks.append(oob)
+            fresh_keys.append((key, node.build_key_domain, lut))
+        luts.append(lut)
+    if checks:
+        vals = np.asarray(jnp.stack(checks))
+        if int(vals.sum()) != 0:
+            return None
+        for key, domain, lut in fresh_keys:
+            if key is not None:
+                if len(executor._lut_cache) >= 4:
+                    executor._lut_cache.pop(
+                        next(iter(executor._lut_cache)))
+                executor._lut_cache[(key, domain)] = lut
+    return tuple(builds), tuple(luts)
+
+
 class ChunkAnalysis:
     """Where to cut the plan for chunked execution."""
 
@@ -193,6 +303,31 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
         fact_valids = tuple(c.valid for c in fact)
         fact_wide = tuple(str(c.wide_dtype) for c in fact)
 
+    # fused pipeline: the whole per-chunk path as ONE program per chunk
+    # (zero host syncs in the loop; LUTs prebuilt + validated once)
+    fused = None
+    if plan.merge_agg is not None and not executor.profile:
+        mine = compile_fused_chunk(executor, per_chunk_target,
+                                   plan.driver)
+        if mine is not None:
+            # one jitted wrapper per plan STRUCTURE, reused across runs
+            # so re-executions hit the in-memory trace cache (a replan
+            # produces new node objects but identical static values)
+            skey = executor.build_structure_key(per_chunk_target)
+            jitted = executor._fused_cache.get(skey) \
+                if skey is not None else None
+            if jitted is None:
+                jitted = jax.jit(mine[0])
+                if skey is not None:
+                    if len(executor._fused_cache) >= 8:
+                        executor._fused_cache.pop(
+                            next(iter(executor._fused_cache)))
+                    executor._fused_cache[skey] = jitted
+            bl = _fused_luts(executor, mine[1])
+            if bl is not None:
+                fused = (jitted, bl[0], bl[1])
+                executor.stats.fused_chunk_pipelines += 1
+
     executor.enter_chunk_mode()
     try:
         for start in range(0, plan.driver_rows, chunk_rows):
@@ -213,16 +348,19 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
                               for i in plan.driver.column_indices]
                 chunk = batch_from_numpy(arrays, valids=valids,
                                          capacity=cap)
-            executor._subst[id(plan.driver)] = chunk
-            try:
-                out = executor.run(per_chunk_target)
-            finally:
-                executor._subst.pop(id(plan.driver), None)
-                # the per-chunk path recomputes these nodes next
-                # iteration; release their reservations now so the pool
-                # reflects only pinned builds + accumulated partials
-                executor.release_path_reservations(per_chunk_target,
-                                                   keep=executor._subst)
+            if fused is not None:
+                out = fused[0](chunk, fused[1], fused[2])
+            else:
+                executor._subst[id(plan.driver)] = chunk
+                try:
+                    out = executor.run(per_chunk_target)
+                finally:
+                    executor._subst.pop(id(plan.driver), None)
+                    # the per-chunk path recomputes these nodes next
+                    # iteration; release their reservations now so the
+                    # pool reflects only pinned builds + partials
+                    executor.release_path_reservations(
+                        per_chunk_target, keep=executor._subst)
             executor.stats.agg_spill_chunks += 1
             if fact is not None:
                 executor.stats.fact_cache_chunks += 1

@@ -53,6 +53,7 @@ class ExecStats:
     mxu_agg_calls: int = 0
     fact_cache_chunks: int = 0       # chunks sliced from device-resident
     chunk_lut_joins: int = 0         # sync-free reused-LUT probes
+    fused_chunk_pipelines: int = 0   # whole-chunk-path single programs
 
 
 class QueryDeadlineError(RuntimeError):
@@ -106,6 +107,11 @@ class Executor:
         # skipped for the loop's duration
         self.chunk_mode = False
         self._chunk_lut_cache: Dict[tuple, object] = {}
+        # cross-run caches for the FUSED chunk pipeline: jitted per-chunk
+        # programs keyed by plan-structure hash, and validated dense LUTs
+        # keyed by (build structure, domain)
+        self._fused_cache: Dict[str, object] = {}
+        self._lut_cache: Dict[tuple, object] = {}
         # device-resident narrowed fact columns (exec/device_cache.py):
         # steady-state chunked scans slice HBM instead of re-streaming
         # the host link (~30 MB/s through this rig's tunnel)
@@ -188,19 +194,27 @@ class Executor:
             self.pool.free(self._node_bytes.pop(id(c), 0))
         return out
 
+    def build_structure_key(self, node: L.PlanNode) -> Optional[str]:
+        """Cross-run cache key for a DETERMINISTIC build subtree: the
+        wire-form hash (serde is canonical), or None when any scan
+        reads a mutable catalog (memory tables change between runs)."""
+        scans = [s for s in _subtree_scans(node)]
+        if any(s.catalog not in ("tpch", "tpcds", "bench")
+               for s in scans) or not scans:
+            return None
+        import hashlib
+        from ..server import serde
+        return hashlib.sha256(serde.dumps(node).encode()).hexdigest()
+
     def run_cached_build(self, node: L.PlanNode) -> Batch:
         """Execute a chunked-mode build subtree with a cross-run cache:
         the key is the subtree's wire-form hash (serde is canonical), so
         a re-planned but structurally identical build reuses the pinned
         device batch. Only deterministic generator catalogs participate
         (a memory-connector table can change between runs)."""
-        scans = [s for s in _subtree_scans(node)]
-        if any(s.catalog not in ("tpch", "tpcds", "bench")
-               for s in scans) or not scans:
+        key = self.build_structure_key(node)
+        if key is None:
             return self.run(node)
-        import hashlib
-        from ..server import serde
-        key = hashlib.sha256(serde.dumps(node).encode()).hexdigest()
         hit = self._build_cache.get(key)
         if hit is not None:
             return hit

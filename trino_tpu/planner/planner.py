@@ -444,8 +444,12 @@ class Planner:
         rows = [max(1.0, self.estimate_rows(r.node)) for r in pending]
         stats = [self.chain_column_stats(r.node) for r in pending]
 
-        # edges[i][j] = list of per-conjunct max-NDV denominators
-        edges: Dict[Tuple[int, int], List[float]] = {}
+        # edges[(i, j)] = [(denominator, uniq_i, uniq_j)] per conjunct:
+        # denominator is the max-NDV cardinality reduction; uniq_* says
+        # that side is provably unique on its end of the edge (the FK ->
+        # unique-PK direction), which is what makes a dense single-key
+        # build possible
+        edges: Dict[Tuple[int, int], List[Tuple[float, bool, bool]]] = {}
         for c in conjuncts:
             eq = as_equi(c)
             if eq is None:
@@ -465,10 +469,49 @@ class Planner:
                         denom = max(ndvs) if ndvs else \
                             min(rows[i], rows[j])
                         edges.setdefault((i, j), []).append(
-                            max(1.0, denom))
+                            (max(1.0, denom),
+                             self.is_unique(pending[i], [ci.index]),
+                             self.is_unique(pending[j], [cj.index])))
                         break
         if not edges:
             return None
+
+        def n1_closed(mask: int, anchor: int) -> bool:
+            """True if every relation in `mask` is reachable from
+            `anchor` via N:1 edges (each hop lands on a side unique on
+            its edge column) — then the subset joined in anchor-rooted
+            order has at most one row per anchor row, so it stays unique
+            on anchor's keys."""
+            seen = 1 << anchor
+            grew = True
+            while grew:
+                grew = False
+                for (i, j), metas in edges.items():
+                    if not ((mask >> i) & 1 and (mask >> j) & 1):
+                        continue
+                    for _, ui, uj in metas:
+                        if (seen >> i) & 1 and not (seen >> j) & 1 and uj:
+                            seen |= 1 << j
+                            grew = True
+                        if (seen >> j) & 1 and not (seen >> i) & 1 and ui:
+                            seen |= 1 << i
+                            grew = True
+            return seen & mask == mask
+
+        def split_is_dense(a: int, b: int) -> bool:
+            """A cross edge whose build end is unique AND whose build
+            subset is N:1-closed from that end admits a single-key dense
+            unique-build join (key minimization drops other edges)."""
+            for probe_m, build_m in ((a, b), (b, a)):
+                for (i, j), metas in edges.items():
+                    for _, ui, uj in metas:
+                        if (probe_m >> i) & 1 and (build_m >> j) & 1 \
+                                and uj and n1_closed(build_m, j):
+                            return True
+                        if (probe_m >> j) & 1 and (build_m >> i) & 1 \
+                                and ui and n1_closed(build_m, i):
+                            return True
+            return False
 
         def connected(mask: int) -> bool:
             first = (mask & -mask).bit_length() - 1
@@ -497,11 +540,21 @@ class Planner:
             for i in range(n):
                 if (mask >> i) & 1:
                     est *= rows[i]
-            for (i, j), denoms in edges.items():
+            for (i, j), metas in edges.items():
                 if (mask >> i) & 1 and (mask >> j) & 1:
-                    for d in denoms:
+                    for d, _, _ in metas:
                         est /= d
             card[mask] = max(1.0, est)
+
+        # probe work scales with the probe side's BATCH CAPACITY, which
+        # stays at the largest base relation's size along the fact spine
+        # (the chunked loop never compacts), not with the post-join
+        # cardinality — cost probes by the dominant base row count
+        maxbase = [0.0] * (1 << n)
+        for mask in range(1, 1 << n):
+            i = (mask & -mask).bit_length() - 1
+            rest = mask ^ (1 << i)
+            maxbase[mask] = max(rows[i], maxbase[rest])
 
         INF = float("inf")
         cost = [INF] * (1 << n)
@@ -521,10 +574,25 @@ class Planner:
                         any(((a >> i) & 1) != ((a >> j) & 1)
                             for (i, j) in edges
                             if (mask >> i) & 1 and (mask >> j) & 1):
-                    probe_r, build_r = max(card[a], card[b]), \
-                        min(card[a], card[b])
-                    c = cost[a] + cost[b] + probe_r + \
-                        2.0 * build_r + card[mask]
+                    if card[a] >= card[b]:
+                        probe_m, build_m = a, b
+                    else:
+                        probe_m, build_m = b, a
+                    probe_r = max(card[probe_m], maxbase[probe_m])
+                    build_r = card[build_m]
+                    # non-dense joins (multi-key or no unique build) run
+                    # the sorted kernels — measured ~4-10x the dense
+                    # LUT's gather cost on this backend, so weigh them
+                    # out of contention unless nothing dense exists.
+                    # Probe rows weigh 3x: every probe-side join costs
+                    # 2-3 HBM gathers per probe row (the measured
+                    # bottleneck), so folding dimensions into build
+                    # subtrees (fewer fact-side joins) wins even when it
+                    # grows the build a little.
+                    factor = 1.0 if split_is_dense(a, b) else 6.0
+                    c = cost[a] + cost[b] + \
+                        factor * (3.0 * probe_r + 2.0 * build_r) + \
+                        card[mask]
                     if c < cost[mask]:
                         cost[mask] = c
                         split[mask] = (a, b)

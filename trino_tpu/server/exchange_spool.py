@@ -19,8 +19,8 @@ real one is this class)."""
 from __future__ import annotations
 
 import hashlib
-import json
 import os
+import struct
 import tempfile
 import threading
 from typing import List, Optional
@@ -45,29 +45,46 @@ class ExchangeSpool:
                      f":{s.start}+{s.count}".encode())
         return h.hexdigest()[:32]
 
-    def _path(self, key: str) -> str:
-        return os.path.join(self.root, f"{key}.json")
+    # container layout: b"TSPL" | npages u32 | per page: len u64 | frame
+    _MAGIC = b"TSPL"
 
-    def get(self, key: str) -> Optional[List[dict]]:
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, f"{key}.spool")
+
+    def get(self, key: str) -> Optional[List[bytes]]:
         try:
-            with open(self._path(key)) as f:
-                return json.load(f)
-        except (OSError, ValueError):
+            with open(self._path(key), "rb") as f:
+                blob = f.read()
+            if blob[:4] != self._MAGIC:
+                return None
+            (npages,) = struct.unpack_from("<I", blob, 4)
+            off = 8
+            pages = []
+            for _ in range(npages):
+                (ln,) = struct.unpack_from("<Q", blob, off)
+                off += 8
+                pages.append(blob[off:off + ln])
+                off += ln
+            return pages
+        except (OSError, ValueError, struct.error):
             return None
 
-    def put(self, key: str, pages: List[dict]) -> None:
+    def put(self, key: str, pages: List[bytes]) -> None:
         # write-then-rename: a crashed writer never leaves a torn file a
         # later attempt could read (the exactly-one-attempt guarantee)
         path = self._path(key)
         with self._lock:
             tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(pages, f)
+            with open(tmp, "wb") as f:
+                f.write(self._MAGIC + struct.pack("<I", len(pages)))
+                for p in pages:
+                    f.write(struct.pack("<Q", len(p)))
+                    f.write(p)
             os.replace(tmp, path)
 
     def clear(self) -> None:
         for f in os.listdir(self.root):
-            if f.endswith(".json"):
+            if f.endswith((".json", ".spool")):
                 try:
                     os.unlink(os.path.join(self.root, f))
                 except OSError:

@@ -1,0 +1,120 @@
+"""Binary page serde for the data plane.
+
+Reference: Trino ships exchange pages as length-prefixed binary frames
+with optional LZ4/ZSTD compression
+(core/trino-main/.../execution/buffer/CompressingEncryptingPageSerializer.java:60,
+PagesSerdeUtil). Round-3 shipped base64-in-JSON — fine for correctness,
+hopeless for SF100 shuffles — this module is the binary replacement.
+
+Frame layout (little-endian):
+
+    magic  b"TPG1"
+    flags  u8      bit0: body zstd-compressed, bit1: zlib-compressed
+    rawlen u64     uncompressed body length
+    body   bytes   (compressed per flags)
+
+Body:
+
+    ncols  u16
+    rows   u64
+    per column:
+        dlen   u8   dtype string length
+        dtype  ascii
+        nbytes u64  data byte length
+        data   bytes
+        vbytes u64  validity byte length (bool_, rows entries)
+        valid  bytes
+
+Decoding attacker-controlled bytes can at worst produce malformed numpy
+arrays — no object deserialization (same data-only property as
+server/serde.py).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import List, Tuple
+
+import numpy as np
+
+MAGIC = b"TPG1"
+_F_ZSTD = 1
+_F_ZLIB = 2
+
+try:
+    import zstandard as _zstd
+    _zc = _zstd.ZstdCompressor(level=3)
+    _zd = _zstd.ZstdDecompressor()
+except Exception:                        # pragma: no cover — zstd absent
+    _zstd = None
+    _zc = _zd = None
+
+# frames smaller than this ship uncompressed (header cost dominates)
+MIN_COMPRESS = 512
+
+
+def encode_page(arrays: List[np.ndarray],
+                valids: List[np.ndarray]) -> bytes:
+    rows = len(arrays[0]) if arrays else 0
+    parts = [struct.pack("<HQ", len(arrays), rows)]
+    for a, v in zip(arrays, valids):
+        a = np.ascontiguousarray(a)
+        v = np.ascontiguousarray(np.asarray(v, dtype=np.bool_))
+        dt = str(a.dtype).encode("ascii")
+        parts.append(struct.pack("<B", len(dt)))
+        parts.append(dt)
+        ab = a.tobytes()
+        parts.append(struct.pack("<Q", len(ab)))
+        parts.append(ab)
+        vb = v.tobytes()
+        parts.append(struct.pack("<Q", len(vb)))
+        parts.append(vb)
+    body = b"".join(parts)
+    flags = 0
+    if len(body) >= MIN_COMPRESS:
+        if _zc is not None:
+            comp = _zc.compress(body)
+            if len(comp) < len(body):
+                body, flags = comp, _F_ZSTD
+        else:
+            comp = zlib.compress(body, 1)
+            if len(comp) < len(body):
+                body, flags = comp, _F_ZLIB
+    return MAGIC + struct.pack("<BQ", flags, len(body)) + body
+
+
+def decode_page(buf: bytes) -> Tuple[List[np.ndarray], List[np.ndarray]]:
+    if buf[:4] != MAGIC:
+        raise ValueError("bad page frame magic")
+    flags, rawlen = struct.unpack_from("<BQ", buf, 4)
+    body = buf[13:13 + rawlen]
+    if flags & _F_ZSTD:
+        if _zd is None:
+            raise ValueError("zstd page but zstandard unavailable")
+        body = _zd.decompress(body)
+    elif flags & _F_ZLIB:
+        body = zlib.decompress(body)
+    off = 0
+    ncols, rows = struct.unpack_from("<HQ", body, off)
+    off += 10
+    arrays, valids = [], []
+    for _ in range(ncols):
+        (dlen,) = struct.unpack_from("<B", body, off)
+        off += 1
+        dt = np.dtype(body[off:off + dlen].decode("ascii"))
+        off += dlen
+        (nbytes,) = struct.unpack_from("<Q", body, off)
+        off += 8
+        arrays.append(np.frombuffer(body, dtype=dt,
+                                    count=nbytes // dt.itemsize,
+                                    offset=off) if nbytes else
+                      np.empty(0, dt))
+        off += nbytes
+        (vbytes,) = struct.unpack_from("<Q", body, off)
+        off += 8
+        valids.append(np.frombuffer(body, dtype=np.bool_, count=vbytes,
+                                    offset=off) if vbytes else
+                      np.empty(0, np.bool_))
+        off += vbytes
+    return arrays, valids

@@ -58,12 +58,19 @@ class RemoteTask:
         return f"{self.node.uri}/v1/task/{self.task_id}{suffix}"
 
     def _request(self, url: str, data: Optional[bytes] = None,
-                 method: str = "GET") -> dict:
-        req = Request(url, data=data, method=method,
-                      headers={"Content-Type": "application/json"})
+                 method: str = "GET", accept: str = ""):
+        """JSON request; with `accept` = the binary pages media type the
+        response may instead be a raw page frame (returned as bytes)."""
+        headers = {"Content-Type": "application/json"}
+        if accept:
+            headers["Accept"] = accept
+        req = Request(url, data=data, method=method, headers=headers)
         with urlopen(req, timeout=self.http_timeout_s) as resp:
-            body = resp.read().decode()
-            return json.loads(body) if body else {}
+            body = resp.read()
+            if resp.headers.get("Content-Type", "").startswith(
+                    "application/x-trino-pages"):
+                return bytes(body)
+            return json.loads(body.decode()) if body else {}
 
     def start(self) -> None:
         body = json.dumps({
@@ -72,14 +79,25 @@ class RemoteTask:
         }).encode()
         self._request(self._url(), data=body, method="POST")
 
-    def drain(self, deadline: float) -> List[dict]:
+    def drain(self, deadline: float) -> List[bytes]:
         """Pull result pages token by token until the buffer completes
-        (HttpPageBufferClient.sendGetResults:355's loop)."""
+        (HttpPageBufferClient.sendGetResults:355's loop). Pages cross
+        the wire as binary zstd/zlib frames (pageserde.py), the JSON
+        envelope only carries terminal/empty states."""
         token = 0
         while time.time() < deadline:
-            out = self._request(self._url(f"/results/{token}"))
+            out = self._request(self._url(f"/results/{token}"),
+                                accept="application/x-trino-pages")
+            if isinstance(out, bytes):
+                self.pages.append(out)
+                token += 1
+                continue
             if out.get("page") is not None:
-                self.pages.append(out["page"])
+                page = out["page"]
+                if isinstance(page, dict) and "b64" in page:
+                    import base64
+                    page = base64.b64decode(page["b64"])
+                self.pages.append(page)
                 token += 1
                 continue
             if out.get("state") == "FAILED":
@@ -244,9 +262,9 @@ class StageScheduler:
             if analysis.merge_agg is not None:
                 partials = []
                 for p in pages:
-                    if p["rows"] == 0:
-                        continue
                     arrs, vals = decode_columns(p)
+                    if len(arrs) == 0 or len(arrs[0]) == 0:
+                        continue
                     partials.append(batch_from_numpy(arrs, valids=vals))
                 merged = merge_partials(ex, analysis.merge_agg, partials) \
                     if partials else self._empty_like(analysis.merge_agg)

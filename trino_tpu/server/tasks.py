@@ -30,27 +30,28 @@ import numpy as np
 
 # --------------------------------------------------------------------------
 # wire serde: numpy column sets and plan fragments
-# (PagesSerde's role, execution/buffer/CompressingEncryptingPageSerializer.java:60
-# — JSON+base64 instead of binary framing; compression is a TODO knob)
+# (PagesSerde's role, execution/buffer/CompressingEncryptingPageSerializer.java:60)
+# Pages are length-prefixed binary frames with zstd/zlib compression
+# (server/pageserde.py); the worker serves them raw on the binary results
+# route and base64-wrapped on the legacy JSON route.
 # --------------------------------------------------------------------------
 
 def encode_columns(arrays: List[np.ndarray],
-                   valids: List[np.ndarray]) -> dict:
-    cols = []
-    for a, v in zip(arrays, valids):
-        cols.append({
-            "dtype": str(a.dtype),
-            "data": base64.b64encode(np.ascontiguousarray(a)).decode(),
-            "valid": base64.b64encode(
-                np.ascontiguousarray(np.asarray(v, dtype=np.bool_))).decode(),
-        })
-    n = len(arrays[0]) if arrays else 0
-    return {"rows": n, "columns": cols}
+                   valids: List[np.ndarray]) -> bytes:
+    from .pageserde import encode_page
+    return encode_page(arrays, valids)
 
 
-def decode_columns(payload: dict):
+def decode_columns(page) -> tuple:
+    """Accepts a binary frame (bytes), its base64 JSON wrapping
+    ({"b64": ...}), or the round-3 dict layout (rolling upgrade)."""
+    from .pageserde import decode_page
+    if isinstance(page, (bytes, bytearray)):
+        return decode_page(bytes(page))
+    if isinstance(page, dict) and "b64" in page:
+        return decode_page(base64.b64decode(page["b64"]))
     arrays, valids = [], []
-    for c in payload["columns"]:
+    for c in page["columns"]:
         a = np.frombuffer(base64.b64decode(c["data"]),
                           dtype=np.dtype(c["dtype"]))
         v = np.frombuffer(base64.b64decode(c["valid"]), dtype=np.bool_)
@@ -125,7 +126,7 @@ class WorkerTask:
     splits: List[Split]
     state: str = "PENDING"
     error: str = ""
-    pages: List[dict] = field(default_factory=list)   # encoded column sets
+    pages: List[bytes] = field(default_factory=list)  # binary page frames
     acked: int = 0                 # tokens below this are released
     splits_done: int = 0
     lock: threading.Lock = field(default_factory=threading.Lock)

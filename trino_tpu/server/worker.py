@@ -37,6 +37,19 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_page(self, frame: bytes, headers: dict) -> None:
+        """Binary data-plane response: the page frame raw in the body,
+        pull-protocol metadata in headers (PagesSerde over HTTP — the
+        reference's TaskResource results route with
+        application/x-trino-pages)."""
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-trino-pages")
+        self.send_header("Content-Length", str(len(frame)))
+        for k, v in headers.items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(frame)
+
     def do_GET(self):
         path = urlparse(self.path).path
         parts = [p for p in path.split("/") if p]
@@ -70,6 +83,7 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 self._send(500, {"error": "injected results failure"})
                 return
             token = int(parts[4])
+            binary = "x-trino-pages" in self.headers.get("Accept", "")
             with task.lock:
                 # Advancing to `token` acknowledges every page below it
                 # (TaskResource.java:372's implicit-ack contract) — drop
@@ -81,8 +95,16 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 idx = token - task.acked
                 total = task.acked + len(task.pages)
                 if 0 <= idx < len(task.pages):
-                    self._send(200, {"token": token, "complete": False,
-                                     "page": task.pages[idx]})
+                    if binary:
+                        self._send_page(task.pages[idx],
+                                        {"X-Trino-Token": token,
+                                         "X-Trino-Complete": "false"})
+                    else:
+                        import base64
+                        self._send(200, {
+                            "token": token, "complete": False,
+                            "page": {"b64": base64.b64encode(
+                                task.pages[idx]).decode()}})
                     return
                 done = task.state in ("FINISHED", "FAILED", "CANCELED")
                 self._send(200, {"token": token,

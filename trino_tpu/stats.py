@@ -34,16 +34,25 @@ _SAMPLE = 1 << 18
 
 
 def _ndv_estimate(col: np.ndarray, n: int) -> float:
-    """Sampled distinct-count with linear scale-up for saturated samples
-    (the bias direction that keeps keys looking key-like)."""
+    """Sampled distinct-count via the Duj1 estimator (Haas & Stokes —
+    what Postgres' ANALYZE uses): D = d / (1 - ((N-r)/N)(f1/r)), where
+    f1 counts sample singletons. Exact for true keys (f1=r => D=N),
+    asymptotically d for heavily repeated columns, and ~N/repeat for
+    clustered fact keys — the strided sampler it replaces read sorted
+    key columns as all-distinct and overestimated NDV by 4-20x, which
+    flattened every join-cardinality estimate the reorderer relies on.
+    The sample is RANDOM: strided sampling is biased on sorted data."""
     if n <= _SAMPLE:
         return float(len(np.unique(col)))
-    step = n // _SAMPLE
-    sample = col[::step][:_SAMPLE]
-    d = len(np.unique(sample))
-    if d >= 0.8 * len(sample):        # nearly all distinct: key-like
-        return float(n) * d / len(sample)
-    return float(min(n, d * max(1, n // len(sample)) ** 0.5 * 4 + d))
+    rng = np.random.default_rng(0x5EED)
+    sample = col[rng.integers(0, n, _SAMPLE)]
+    r = len(sample)
+    counts = np.unique(sample, return_counts=True)[1]
+    d = len(counts)
+    f1 = int((counts == 1).sum())
+    denom = 1.0 - ((n - r) / n) * (f1 / r)
+    est = d / max(denom, d / n)          # clamp keeps D <= N
+    return float(min(est, n))
 
 
 def compute_table_stats(data) -> TableStats:
@@ -70,6 +79,9 @@ def compute_table_stats(data) -> TableStats:
             cols[f.name] = ColumnStats(ndv, None, None, null_frac)
             continue
         ndv = _ndv_estimate(arr_v, len(arr_v))
-        cols[f.name] = ColumnStats(
-            ndv, float(arr_v.min()), float(arr_v.max()), null_frac)
+        lo, hi = float(arr_v.min()), float(arr_v.max())
+        if np.issubdtype(arr_v.dtype, np.integer):
+            # integers cannot have more distincts than their value range
+            ndv = min(ndv, hi - lo + 1.0)
+        cols[f.name] = ColumnStats(ndv, lo, hi, null_frac)
     return TableStats(n, cols)
