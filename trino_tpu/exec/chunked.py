@@ -72,8 +72,70 @@ MERGE_FUNC = {"sum": "sum", "count": "sum", "count_star": "sum",
               "min": "min", "max": "max"}
 
 
+def _fused_join_ok(node: L.JoinNode) -> bool:
+    return (node.kind in ("inner", "left", "semi", "anti") and
+            node.build_key_domain is not None and node.build_unique and
+            node.residual is None and not node.null_aware and
+            len(node.left_keys) == 1)
+
+
+def _spine_joins(target: L.PlanNode, driver: L.ScanNode) \
+        -> Optional[List[L.JoinNode]]:
+    """JoinNodes on the driver's probe spine, bottom-up (the order
+    compile_fused_chunk's emit() appends them). None when any spine
+    join can't run in the fused pipeline."""
+    joins: List[L.JoinNode] = []
+
+    def walk(node) -> bool:
+        if node is driver:
+            return True
+        if isinstance(node, (L.FilterNode, L.ProjectNode,
+                             L.AggregateNode)):
+            return walk(node.child)
+        if isinstance(node, L.JoinNode):
+            if _fused_join_ok(node) and walk(node.left):
+                joins.append(node)
+                return True
+            return False
+        return False
+
+    return joins if walk(target) else None
+
+
+# value-packing caps: at most this many payload columns, packed word
+# must fit int64 with the sign bit untouched
+_PACK_MAX_COLS = 4
+_PACK_MAX_BITS = 62
+
+
+def _plan_packing(build: Batch, node: L.JoinNode, mins, maxs):
+    """Static packing meta for a build whose payload values fit one
+    word: ((col_idx, lo, width, val_off, valid_off), ...), word dtype
+    name. None when not packable (caller keeps the row-id LUT)."""
+    bkey = node.right_keys[0] if len(node.right_keys) == 1 else None
+    payload = [i for i in range(len(build.columns)) if i != bkey]
+    if len(payload) > _PACK_MAX_COLS:
+        return None
+    meta = []
+    off = 1                                   # bit0 = presence
+    for j, i in enumerate(payload):
+        if not jnp.issubdtype(build.columns[i].data.dtype, jnp.integer):
+            return None
+        lo, hi = int(mins[j]), int(maxs[j])
+        if hi < lo:
+            lo, hi = 0, 0
+        width = max(1, int(hi - lo + 1).bit_length())
+        meta.append((i, lo, width, off, off + width))
+        off += width + 1
+    if off > _PACK_MAX_BITS:
+        return None
+    word_dtype = "int8" if off <= 7 else "int16" if off <= 15 else \
+        "int32" if off <= 31 else "int64"
+    return tuple(meta), word_dtype
+
+
 def compile_fused_chunk(executor, target: L.PlanNode,
-                        driver: L.ScanNode):
+                        driver: L.ScanNode, lut_specs=None):
     """Compose the whole per-chunk path (joins with prebuilt LUTs,
     filters, projections, the partial aggregate) into ONE traced
     function so every chunk is a single device dispatch with zero host
@@ -81,13 +143,19 @@ def compile_fused_chunk(executor, target: L.PlanNode,
     across what the per-node executor would run as 6-8 separate
     programs. Supported shape: Filter/Project chains, single-key
     unique-build dense joins (driver on the probe side), and a
-    direct/global partial aggregate on top. Returns (fn, join_nodes)
-    where fn(chunk, builds, luts) -> partial Batch and join_nodes lists
-    the JoinNodes in `builds`/`luts` order; None when the shape doesn't
-    apply (caller uses the per-node loop)."""
+    direct/global partial aggregate on top.
+
+    `lut_specs` maps id(join node) -> spec from _fused_luts: ("rows",)
+    joins gather per payload column off a row-id LUT; ("packed", meta,
+    word_dtype, bkey, out_dtypes) joins decode everything from ONE
+    value-packed gather.
+
+    Returns (fn, join_nodes) where fn(chunk, builds, luts) -> partial
+    Batch and join_nodes lists the JoinNodes in `builds`/`luts` order;
+    None when the shape doesn't apply (caller uses the per-node loop)."""
     from ..ops.aggregate import (AggSpec, direct_group_aggregate,
                                  global_aggregate)
-    from ..ops.join import dense_join_with_lut
+    from ..ops.join import dense_join_packed, dense_join_with_lut
     from ..ops.project import apply_filter, filter_project
 
     joins: List[L.JoinNode] = []
@@ -110,11 +178,7 @@ def compile_fused_chunk(executor, target: L.PlanNode,
             return lambda chunk, b, l: filter_project(
                 child(chunk, b, l), None, exprs)
         if isinstance(node, L.JoinNode):
-            if node.kind not in ("inner", "left", "semi", "anti") or \
-                    node.build_key_domain is None or \
-                    not node.build_unique or \
-                    node.residual is not None or node.null_aware or \
-                    len(node.left_keys) != 1:
+            if not _fused_join_ok(node):
                 return None
             child = emit(node.left)
             if child is None:
@@ -122,6 +186,12 @@ def compile_fused_chunk(executor, target: L.PlanNode,
             idx = len(joins)
             joins.append(node)
             lk, rk, kind = node.left_keys, node.right_keys, node.kind
+            spec = lut_specs.get(id(node)) if lut_specs else None
+            if spec is not None and spec[0] == "packed":
+                _, meta, _wd, bkey, out_dtypes = spec
+                return lambda chunk, b, l: dense_join_packed(
+                    child(chunk, b, l), l[idx], lk, meta, bkey,
+                    out_dtypes, kind)
             return lambda chunk, b, l: dense_join_with_lut(
                 child(chunk, b, l), b[idx], l[idx], lk, rk, kind)
         if isinstance(node, L.AggregateNode):
@@ -150,36 +220,87 @@ def compile_fused_chunk(executor, target: L.PlanNode,
 
 
 def _fused_luts(executor, joins) -> Optional[tuple]:
-    """Build + validate the dense LUT for every fused join, reusing the
-    cross-run cache for deterministic builds. ALL dup/oob checks fuse
-    into one device fetch; any violation aborts the fused path (the
-    per-node loop has the graceful fallbacks)."""
-    from ..ops.join import dense_build_lut
-    builds, luts, checks, fresh_keys = [], [], [], []
-    for node in joins:
-        build = executor.run(node.right)
-        builds.append(build)
-        key = executor.build_structure_key(node.right)
-        lut = executor._lut_cache.get((key, node.build_key_domain)) \
-            if key is not None else None
-        if lut is None:
-            lut, dup, oob = dense_build_lut(build, node.right_keys,
-                                            node.build_key_domain)
-            checks.append(dup.astype(jnp.int64))
-            checks.append(oob)
-            fresh_keys.append((key, node.build_key_domain, lut))
-        luts.append(lut)
-    if checks:
-        vals = np.asarray(jnp.stack(checks))
-        if int(vals.sum()) != 0:
+    """Build + validate the dense LUT for every fused join, choosing
+    value-packed LUTs whenever the payload fits one word (probe = ONE
+    gather) and falling back to row-id LUTs otherwise. LUT+spec pairs
+    reuse the cross-run cache for deterministic builds. Payload min/max
+    stats fuse into one device fetch, and ALL dup/oob validations fuse
+    into a second; any violation aborts the fused path (the per-node
+    loop has the graceful fallbacks)."""
+    from ..ops.join import dense_build_lut, dense_build_packed_lut
+    n = len(joins)
+    builds = [executor.run(j.right) for j in joins]
+    luts: List[object] = [None] * n
+    specs: List[object] = [None] * n
+    fresh: List[int] = []
+    keys: List[object] = [None] * n
+    for k, node in enumerate(joins):
+        keys[k] = executor.build_structure_key(node.right)
+        hit = executor._lut_cache.get((keys[k], node.build_key_domain)) \
+            if keys[k] is not None else None
+        if hit is not None:
+            luts[k], specs[k] = hit
+        else:
+            fresh.append(k)
+    if fresh:
+        # one fused fetch: min/max of every integer payload column of
+        # every fresh build (packing decisions are host-side statics)
+        parts = []
+        big = 1 << 62
+        for k in fresh:
+            b, j = builds[k], joins[k]
+            bkey = j.right_keys[0]
+            for i in range(len(b.columns)):
+                if i == bkey:
+                    continue
+                col = b.columns[i]
+                if jnp.issubdtype(col.data.dtype, jnp.integer):
+                    m = b.live & col.valid
+                    d = col.data.astype(jnp.int64)
+                    parts.append(jnp.min(jnp.where(m, d, big)))
+                    parts.append(jnp.max(jnp.where(m, d, -big)))
+                else:
+                    parts.append(jnp.full((), big, jnp.int64))
+                    parts.append(jnp.full((), -big, jnp.int64))
+        vals = np.asarray(jnp.stack(parts)) if parts else \
+            np.zeros(0, np.int64)
+        pos = 0
+        checks = []
+        for k in fresh:
+            b, j = builds[k], joins[k]
+            npay = len(b.columns) - 1
+            mins = vals[pos:pos + 2 * npay:2]
+            maxs = vals[pos + 1:pos + 2 * npay:2]
+            pos += 2 * npay
+            if j.kind in ("semi", "anti"):
+                pk = ((), "int8")         # presence bit only
+            else:
+                pk = _plan_packing(b, j, mins, maxs)
+            if pk is not None:
+                meta, wd = pk
+                lut, exp, oob, occ = dense_build_packed_lut(
+                    b, j.right_keys, j.build_key_domain, meta, wd)
+                specs[k] = ("packed", meta, wd, j.right_keys[0],
+                            tuple(str(c.data.dtype) for c in b.columns))
+                checks.append(exp - occ)      # >0 = duplicate keys
+                checks.append(oob)
+            else:
+                lut, dup, oob = dense_build_lut(b, j.right_keys,
+                                                j.build_key_domain)
+                specs[k] = ("rows",)
+                checks.append(dup.astype(jnp.int64))
+                checks.append(oob)
+            luts[k] = lut
+        if int(np.asarray(jnp.stack(checks)).sum()) != 0:
             return None
-        for key, domain, lut in fresh_keys:
-            if key is not None:
+        for k in fresh:
+            if keys[k] is not None:
                 if len(executor._lut_cache) >= 4:
                     executor._lut_cache.pop(
                         next(iter(executor._lut_cache)))
-                executor._lut_cache[(key, domain)] = lut
-    return tuple(builds), tuple(luts)
+                executor._lut_cache[(keys[k], joins[k].build_key_domain)] \
+                    = (luts[k], specs[k])
+    return tuple(builds), tuple(luts), tuple(specs)
 
 
 class ChunkAnalysis:
@@ -307,25 +428,31 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
     # (zero host syncs in the loop; LUTs prebuilt + validated once)
     fused = None
     if plan.merge_agg is not None and not executor.profile:
-        mine = compile_fused_chunk(executor, per_chunk_target,
-                                   plan.driver)
-        if mine is not None:
-            # one jitted wrapper per plan STRUCTURE, reused across runs
-            # so re-executions hit the in-memory trace cache (a replan
-            # produces new node objects but identical static values)
+        spine = _spine_joins(per_chunk_target, plan.driver)
+        bl = _fused_luts(executor, spine) if spine is not None else None
+        if bl is not None:
+            builds, luts, specs = bl
+            # one jitted wrapper per (plan structure, packing layout),
+            # reused across runs so re-executions hit the in-memory
+            # trace cache (a replan produces new node objects but
+            # identical static values)
             skey = executor.build_structure_key(per_chunk_target)
-            jitted = executor._fused_cache.get(skey) \
-                if skey is not None else None
+            ckey = (skey, specs) if skey is not None else None
+            jitted = executor._fused_cache.get(ckey) \
+                if ckey is not None else None
             if jitted is None:
-                jitted = jax.jit(mine[0])
-                if skey is not None:
-                    if len(executor._fused_cache) >= 8:
-                        executor._fused_cache.pop(
-                            next(iter(executor._fused_cache)))
-                    executor._fused_cache[skey] = jitted
-            bl = _fused_luts(executor, mine[1])
-            if bl is not None:
-                fused = (jitted, bl[0], bl[1])
+                mine = compile_fused_chunk(
+                    executor, per_chunk_target, plan.driver,
+                    {id(j): s for j, s in zip(spine, specs)})
+                if mine is not None:
+                    jitted = jax.jit(mine[0])
+                    if ckey is not None:
+                        if len(executor._fused_cache) >= 8:
+                            executor._fused_cache.pop(
+                                next(iter(executor._fused_cache)))
+                        executor._fused_cache[ckey] = jitted
+            if jitted is not None:
+                fused = (jitted, builds, luts)
                 executor.stats.fused_chunk_pipelines += 1
 
     executor.enter_chunk_mode()

@@ -38,6 +38,22 @@ def _identity(n: int) -> Dict[int, int]:
     return {i: i for i in range(n)}
 
 
+def _narrow_to(node: L.PlanNode, mapping: Dict[int, int],
+               needed) -> Tuple[L.PlanNode, Dict[int, int]]:
+    """Project `node` down to exactly the columns `needed` (old indices)
+    when it kept extras; mapping entries outside `needed` drop."""
+    keep = sorted({mapping[i] for i in needed})
+    if len(keep) >= len(node.output):
+        return node, mapping
+    remap = {old: new for new, old in enumerate(keep)}
+    proj = L.ProjectNode(
+        node,
+        tuple(ir.ColumnRef(i, node.output[i][1]) for i in keep),
+        tuple(node.output[i] for i in keep))
+    return proj, {orig: remap[m] for orig, m in mapping.items()
+                  if m in remap}
+
+
 def _prune(node: L.PlanNode, needed: frozenset):
     """Returns (new_node, mapping old_index -> new_index). The new node's
     output covers at least `needed` (supersets allowed)."""
@@ -99,6 +115,13 @@ def _prune(node: L.PlanNode, needed: frozenset):
             {i - n_probe for i in res_refs if i >= n_probe}
         left, ml = _prune(node.left, frozenset(probe_needed))
         right, mr = _prune(node.right, frozenset(build_needed))
+        # children may keep MORE than needed (supersets: their own
+        # filter/key columns). Dead columns in a join's input are not
+        # just metadata — the build batch carries them at runtime,
+        # growing every payload gather and defeating value-packed LUTs
+        # — so narrow each side with a projection when it over-kept.
+        left, ml = _narrow_to(left, ml, probe_needed)
+        right, mr = _narrow_to(right, mr, build_needed)
         n_new_probe = len(left.output)
         # pair mapping covers probe++build regardless of join kind (the
         # residual uses it); the returned mapping is restricted to the
