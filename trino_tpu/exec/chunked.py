@@ -427,7 +427,12 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
     # fused pipeline: the whole per-chunk path as ONE program per chunk
     # (zero host syncs in the loop; LUTs prebuilt + validated once)
     fused = None
-    if plan.merge_agg is not None and not executor.profile:
+    if plan.merge_agg is not None and not executor.profile and \
+            plan.merge_agg.strategy in ("global", "direct") and \
+            not any(a.distinct for a in plan.merge_agg.aggs):
+        # the strategy gate mirrors compile_fused_chunk's emit() support
+        # so LUTs are never built (device work + a blocking validation
+        # fetch) for a plan the fused compiler would then reject
         spine = _spine_joins(per_chunk_target, plan.driver)
         bl = _fused_luts(executor, spine) if spine is not None else None
         if bl is not None:

@@ -118,6 +118,18 @@ class Executor:
         from .device_cache import FactTableCache
         self.fact_cache = FactTableCache()
         self.enable_fact_cache = True
+        # cross-run DECISION cache: every data-dependent host decision
+        # (join dup/oob validation, live counts for compaction capacity,
+        # key-packing layouts) is a pure function of a deterministic
+        # subtree, so its fetched integers are cached by structure key.
+        # Steady-state re-execution then runs the whole plan as one
+        # async dispatch chain with a single final result fetch — each
+        # avoided sync is a ~100-260 ms tunnel round trip here.
+        self._decision_cache: Dict[tuple, tuple] = {}
+        # per-execution memo of build_structure_key by plan-node id —
+        # the plan holds every node alive for the duration of execute(),
+        # so ids are stable; cleared with _subst at query start
+        self._skey_memo: Dict[int, Optional[str]] = {}
 
     # ------------------------------------------------------------------
 
@@ -129,6 +141,9 @@ class Executor:
         self._scan_cache.clear()
         self._scan_cache_bytes.clear()
         self.fact_cache.invalidate()
+        # decision values never cache for mutable catalogs, but clearing
+        # costs nothing and removes any doubt after DML
+        self._decision_cache.clear()
 
     def execute(self, root: L.OutputNode) -> Batch:
         assert isinstance(root, L.OutputNode)
@@ -138,6 +153,7 @@ class Executor:
             self.pool.free(b)
         self._node_bytes.clear()
         self._subst.clear()
+        self._skey_memo.clear()
         if self.spill_chunk_rows:
             from .chunked import execute_chunked
             out = execute_chunked(self, root)
@@ -205,6 +221,52 @@ class Executor:
         import hashlib
         from ..server import serde
         return hashlib.sha256(serde.dumps(node).encode()).hexdigest()
+
+    def _decision_salt(self) -> tuple:
+        """Session knobs that change runtime decision values for the
+        SAME plan structure (dynamic filtering alters intermediate live
+        counts, merge-join toggles which kernel's dup check runs)."""
+        return (self.enable_dynamic_filtering, self.enable_merge_join,
+                self.enable_mxu_agg, bool(self.stream_build_bytes),
+                self.spill_chunk_rows)
+
+    def fetch_ints(self, node, tag: str, *vals) -> tuple:
+        """Fetch small device integers (validation flags, row counts,
+        min/max stats) as host ints — through the cross-run decision
+        cache when `node`'s subtree is deterministic. On a hit the
+        blocking device round trip is skipped entirely; the device-side
+        computation of `vals` was async-dispatched and is dead code XLA
+        never waits on. Chunk mode and ANY active substitution bypass
+        the cache: a substituted node (per-split worker data, pinned
+        builds, merge batches) carries data its structure key doesn't
+        describe, so split 2 of a worker task must not reuse split 1's
+        counts."""
+        key = None
+        if node is not None and not self.chunk_mode and not self._subst:
+            skey = self.memo_structure_key(node)
+            if skey is not None:
+                key = (tag, skey, self._decision_salt())
+                hit = self._decision_cache.get(key)
+                if hit is not None:
+                    return hit
+        out = tuple(int(v) for v in np.asarray(jnp.stack(
+            [jnp.asarray(v).astype(jnp.int64) for v in vals])))
+        if key is not None:
+            if len(self._decision_cache) >= 4096:
+                self._decision_cache.clear()
+            self._decision_cache[key] = out
+        return out
+
+    def memo_structure_key(self, node: L.PlanNode) -> Optional[str]:
+        """build_structure_key with a per-execution id(node) memo: a join
+        makes several decision fetches against the same subtree and the
+        serde+sha walk is O(subtree) host work each time."""
+        nid = id(node)
+        if nid in self._skey_memo:
+            return self._skey_memo[nid]
+        skey = self.build_structure_key(node)
+        self._skey_memo[nid] = skey
+        return skey
 
     def run_cached_build(self, node: L.PlanNode) -> Batch:
         """Execute a chunked-mode build subtree with a cross-run cache:
@@ -276,7 +338,9 @@ class Executor:
             # stays 2-operand (see SORT_SMALL_ROWS)
             if keys and child.capacity > SORT_SMALL_ROWS:
                 from ..ops.sort import sort_batch_packed, sort_pack_plan
-                plan = sort_pack_plan(child, keys)
+                plan = sort_pack_plan(
+                    child, keys,
+                    fetch=lambda *v: self.fetch_ints(node, "sortpack", *v))
                 if plan is not None:
                     kmins, bits = plan
                     return sort_batch_packed(child, jnp.asarray(kmins),
@@ -506,7 +570,9 @@ class Executor:
                 child.capacity > SORT_SMALL_ROWS:
             from ..ops.aggregate import (key_pack_plan,
                                          packed_sort_group_aggregate)
-            pack = key_pack_plan(child, node.group_keys)
+            pack = key_pack_plan(
+                child, node.group_keys,
+                fetch=lambda *v: self.fetch_ints(node, "aggpack", *v))
         while True:
             if pack is not None:
                 kmins, bits = pack
@@ -516,7 +582,8 @@ class Executor:
             else:
                 out = sort_group_aggregate(child, node.group_keys, aggs,
                                            capacity)
-            n_groups = int(out.live.sum())
+            n_groups = self.fetch_ints(node, f"agggroups{capacity}",
+                                       jnp.sum(out.live))[0]
             if n_groups < capacity or capacity >= child.capacity:
                 break
             capacity *= 4
@@ -613,18 +680,21 @@ class Executor:
     COMPACT_SHRINK = 2
 
     def maybe_compact(self, batch: Batch,
-                      live: Optional[int] = None) -> Batch:
+                      live: Optional[int] = None,
+                      node: Optional[L.PlanNode] = None) -> Batch:
         """Compact when live rows shrank enough. `live` should be passed
         when the caller already synced a row count (join totals): the
         device round trip for jnp.sum is ~60ms over a tunneled chip, so
-        every avoidable sync matters to end-to-end latency."""
+        every avoidable sync matters to end-to-end latency. `node` keys
+        the cross-run decision cache when the count must be fetched."""
         if live is None:
             if batch.capacity < (1 << 16):
                 return batch          # too small for compaction to pay
             if self.chunk_mode:
                 return batch          # the chunked loop stays sync-free:
                                       # a row-count fetch is ~260 ms here
-            live = int(jnp.sum(batch.live))
+            live = self.fetch_ints(node, "complive",
+                                   jnp.sum(batch.live))[0]
         new_cap = bucket_capacity(live)
         if new_cap * self.COMPACT_SHRINK <= batch.capacity:
             self.stats.dynamic_filter_compactions += 1
@@ -649,7 +719,7 @@ class Executor:
         # keys into ONE appended int64 column (shared min/max so equality
         # is preserved), run the join single-key, strip the extras after
         packed = self.pack_join_keys(probe, build, node.left_keys,
-                                     node.right_keys)
+                                     node.right_keys, node=node)
         if packed is not None:
             probe2, build2, pk, bk = packed
             import dataclasses as _dc
@@ -686,7 +756,8 @@ class Executor:
             return None
         return rows * max(1, len(scan.column_indices)) * 8
 
-    def pack_join_keys(self, probe: Batch, build: Batch, pkeys, bkeys):
+    def pack_join_keys(self, probe: Batch, build: Batch, pkeys, bkeys,
+                       node=None):
         """None when the fixed 32-bit packing is safe (<=2 in-range
         columns); else (probe', build', probe_keys', build_keys') with
         one range-compressed key column appended to each side."""
@@ -695,7 +766,6 @@ class Executor:
         if len(pkeys) == 2:
             # the fixed packing is fine when trailing key values fit 31
             # bits — ONE fused fetch for the check
-            import numpy as np
             stats = []
             for side, keys in ((build, bkeys), (probe, pkeys)):
                 for ki in keys[1:]:
@@ -704,11 +774,10 @@ class Executor:
                     d = col.data.astype(jnp.int64)
                     stats.append(jnp.min(jnp.where(m, d, 0)))
                     stats.append(jnp.max(jnp.where(m, d, 0)))
-            vals = np.asarray(jnp.stack(stats))
+            vals = self.fetch_ints(node, "jpack31", *stats)
             if all(0 <= int(vals[i]) and int(vals[i + 1]) < (1 << 31)
                    for i in range(0, len(vals), 2)):
                 return None
-        import numpy as np
         stats = []
         big = jnp.iinfo(jnp.int64)
         for side, keys in ((probe, pkeys), (build, bkeys)):
@@ -718,7 +787,7 @@ class Executor:
                 d = col.data.astype(jnp.int64)
                 stats.append(jnp.min(jnp.where(m, d, big.max)))
                 stats.append(jnp.max(jnp.where(m, d, big.min)))
-        vals = np.asarray(jnp.stack(stats))
+        vals = self.fetch_ints(node, "jpack", *stats)
         k = len(pkeys)
         kmins, bits, total = [], [], 0
         for i in range(k):
@@ -747,7 +816,7 @@ class Executor:
             return self.run_mark_join(node, probe, build)
         if node.kind in ("semi", "anti"):
             return self.run_membership_join(node, probe, build)
-        probe = self.maybe_compact(probe)
+        probe = self.maybe_compact(probe, node=node)
         domain = node.build_key_domain
         if node.build_unique:
             out = self.try_unique_join(node, probe, build, domain)
@@ -760,8 +829,8 @@ class Executor:
             out, total, oob = join_expand(probe, build, node.left_keys,
                                           node.right_keys, node.kind,
                                           cap, domain)
-            total, oob = (int(v) for v in np.asarray(
-                jnp.stack([total, jnp.asarray(oob, total.dtype)])))
+            total, oob = self.fetch_ints(node, f"expand{cap}:{domain}",
+                                         total, oob)
             if oob > 0:             # stale stats: keys escaped the domain
                 domain = None
                 self.stats.join_domain_fallbacks += 1
@@ -807,8 +876,8 @@ class Executor:
                 len(probe.columns) <= 63 and len(build.columns) <= 63:
             out, dup = join_unique_build_merge(
                 probe, build, node.left_keys, node.right_keys, node.kind)
-            dup, live = (int(v) for v in np.asarray(jnp.stack(
-                [dup, jnp.sum(out.live, dtype=dup.dtype)])))
+            dup, live = self.fetch_ints(node, "jmerge", dup,
+                                        jnp.sum(out.live))
             return self.maybe_compact(out, live=live) if dup == 0 else None
         if domain is not None:
             if node.kind == "inner" and probe.capacity > SORT_SMALL_ROWS:
@@ -820,8 +889,8 @@ class Executor:
                 src, matched, dup, oob, live = dense_probe(
                     probe, build, node.left_keys, node.right_keys,
                     domain)
-                dup, oob, live = (int(v) for v in np.asarray(jnp.stack(
-                    [dup, oob, live])))
+                dup, oob, live = self.fetch_ints(
+                    node, f"jdense2:{domain}", dup, oob, live)
                 if oob == 0:
                     if dup != 0:
                         return None
@@ -840,16 +909,17 @@ class Executor:
                 out, dup, oob = join_unique_build_dense(
                     probe, build, node.left_keys, node.right_keys,
                     node.kind, domain)
-                dup, oob, live = (int(v) for v in np.asarray(jnp.stack(
-                    [dup, oob, jnp.sum(out.live, dtype=dup.dtype)])))
+                dup, oob, live = self.fetch_ints(
+                    node, f"jdense:{domain}", dup, oob,
+                    jnp.sum(out.live))
                 if oob == 0:
                     return self.maybe_compact(out, live=live) \
                         if dup == 0 else None
                 self.stats.join_domain_fallbacks += 1
         out, dup = join_unique_build(probe, build, node.left_keys,
                                      node.right_keys, node.kind)
-        dup, live = (int(v) for v in np.asarray(jnp.stack(
-            [dup, jnp.sum(out.live, dtype=dup.dtype)])))
+        dup, live = self.fetch_ints(node, "jsorted", dup,
+                                    jnp.sum(out.live))
         return self.maybe_compact(out, live=live) if dup == 0 else None
 
     def _chunk_lut_join(self, node: L.JoinNode, probe: Batch,
@@ -921,7 +991,8 @@ class Executor:
             # small probes skip the sync; so does the chunked loop (the
             # range mask above still applies — only compaction needs the
             # row-count round trip)
-            live = int(jnp.sum(probe.live))
+            live = self.fetch_ints(node, "dflive",
+                                   jnp.sum(probe.live))[0]
             new_cap = pad_capacity(live)
             if new_cap * 4 <= probe.capacity:
                 self.stats.dynamic_filter_compactions += 1
@@ -941,7 +1012,8 @@ class Executor:
                 dout, _dup, oob = join_unique_build_dense(
                     probe, build, node.left_keys, node.right_keys,
                     "semi", domain)
-                if int(oob) == 0:
+                if self.fetch_ints(node, f"markoob:{domain}",
+                                   oob)[0] == 0:
                     out = dout
                 else:
                     self.stats.join_domain_fallbacks += 1
@@ -956,8 +1028,8 @@ class Executor:
                 mark, total, oob = join_mark(
                     probe, build, node.left_keys, node.right_keys,
                     residual, cap, domain)
-                total, oob = (int(v) for v in np.asarray(
-                    jnp.stack([total, jnp.asarray(oob, total.dtype)])))
+                total, oob = self.fetch_ints(
+                    node, f"markexp{cap}:{domain}", total, oob)
                 if oob > 0:
                     domain = None
                     self.stats.join_domain_fallbacks += 1
@@ -977,7 +1049,8 @@ class Executor:
         if node.null_aware:
             # NOT IN: any NULL in the subquery output -> no row can pass
             bk = build.columns[node.right_keys[0]]
-            if bool(jnp.any(build.live & ~bk.valid)):
+            if self.fetch_ints(node, "nullaware",
+                               jnp.any(build.live & ~bk.valid))[0]:
                 return probe.with_live(jnp.zeros_like(probe.live))
         domain = node.build_key_domain
         if node.residual is None:
@@ -985,7 +1058,8 @@ class Executor:
                 out, _dup, oob = join_unique_build_dense(
                     probe, build, node.left_keys, node.right_keys,
                     node.kind, domain)
-                if int(oob) == 0:
+                if self.fetch_ints(node, f"memoob:{domain}",
+                                   oob)[0] == 0:
                     return out
                 self.stats.join_domain_fallbacks += 1
             out, _dup = join_unique_build(probe, build, node.left_keys,
@@ -997,8 +1071,8 @@ class Executor:
             mark, total, oob = join_mark(probe, build, node.left_keys,
                                          node.right_keys, residual, cap,
                                          domain)
-            total, oob = (int(v) for v in np.asarray(
-                jnp.stack([total, jnp.asarray(oob, total.dtype)])))
+            total, oob = self.fetch_ints(
+                node, f"memexp{cap}:{domain}", total, oob)
             if oob > 0:
                 domain = None
                 self.stats.join_domain_fallbacks += 1
@@ -1016,10 +1090,17 @@ class Executor:
         not padded capacity (a 60M-capacity TopN result is 10 rows).
         Small batches skip the live-count probe: its device sync costs a
         tunnel round trip and the fetch moves little data anyway."""
-        if batch.columns and batch.capacity >= (1 << 16):
-            live = int(jnp.sum(batch.live))
+        # mid-size results only probe when the decision cache can absorb
+        # the sync on re-execution (deterministic subtree); one-shot
+        # mutable-catalog queries keep the old 64K threshold — for them
+        # the probe costs a round trip and the fetch moves little data
+        probe_floor = (1 << 13) if not self._subst and \
+            self.memo_structure_key(root) is not None else (1 << 16)
+        if batch.columns and batch.capacity >= probe_floor:
+            live = self.fetch_ints(root, "resultlive",
+                                   jnp.sum(batch.live))[0]
             new_cap = bucket_capacity(live)
-            if new_cap * 4 <= batch.capacity:
+            if new_cap * 2 <= batch.capacity:
                 batch = compact_batch(batch, new_cap)
         arrays, valids = batch_to_numpy(batch)
         return list(root.names), arrays, valids

@@ -300,7 +300,7 @@ def _grouped_reduce(batch: Batch, key_indices: tuple, aggs: tuple,
 # packed sort strategy — range-compressed keys, 2-operand sort
 # --------------------------------------------------------------------------
 
-def key_pack_plan(batch: Batch, key_indices: tuple):
+def key_pack_plan(batch: Batch, key_indices: tuple, fetch=None):
     """Measure per-key [min, max] on device (ONE fused fetch) and derive a
     static packing layout: key i occupies ceil(log2(span+3)) bits; slot 0
     and the top slot stay free for NULL placement and direction
@@ -324,7 +324,10 @@ def key_pack_plan(batch: Batch, key_indices: tuple):
         big = jnp.iinfo(jnp.int64)
         stats.append(jnp.min(jnp.where(m, data, big.max)))
         stats.append(jnp.max(jnp.where(m, data, big.min)))
-    vals = np.asarray(jnp.stack(stats))
+    # `fetch` (the executor's cross-run decision cache) turns this into
+    # a zero-round-trip host decision on re-execution
+    vals = fetch(*stats) if fetch is not None else \
+        np.asarray(jnp.stack(stats))
     kmins, bits = [], []
     total = 0
     for i in range(len(key_indices)):

@@ -60,7 +60,7 @@ def sort_batch(batch: Batch, keys: tuple, limit) -> Batch:
     return Batch(columns=cols, live=live)
 
 
-def sort_pack_plan(batch: Batch, keys: tuple):
+def sort_pack_plan(batch: Batch, keys: tuple, fetch=None):
     """Range-compress integer ORDER BY keys into one int64 (direction and
     null placement baked into the rank encoding) so the big sort is
     always (packed, index) — measurement and bit layout shared with the
@@ -68,7 +68,8 @@ def sort_pack_plan(batch: Batch, keys: tuple):
     keeps the DESC rank range clear of the nulls-first slot 0 and the
     ASC range clear of the nulls-last slot 2^b - 1)."""
     from .aggregate import key_pack_plan
-    return key_pack_plan(batch, tuple(idx for idx, _, _ in keys))
+    return key_pack_plan(batch, tuple(idx for idx, _, _ in keys),
+                         fetch=fetch)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4))

@@ -140,8 +140,12 @@ class Dispatcher:
                         from .scheduler import TaskFailedError
                         try:
                             result = self.scheduler.execute(tq.sql)
-                        except TaskFailedError:
+                            tq.fallback_reason = \
+                                self.scheduler.fallback_reason \
+                                if result is None else None
+                        except TaskFailedError as te:
                             result = None   # degrade to local execution
+                            tq.fallback_reason = f"task failure: {te}"
                         tq.distributed = result is not None
                     if result is None:
                         result = self.session.execute(tq.sql)
@@ -348,7 +352,9 @@ class _Handler(BaseHTTPRequestHandler):
                 "queryId": tq.query_id, "state": tq.state, "query": tq.sql,
                 "user": tq.session_user, "error": sm.error,
                 "elapsedSeconds": tq.elapsed_s,
-                "rows": tq.rows_returned, "retries": tq.retries})
+                "rows": tq.rows_returned, "retries": tq.retries,
+                "distributed": tq.distributed,
+                "fallbackReason": tq.fallback_reason})
             return
         if len(parts) >= 4 and parts[:3] == ["v1", "statement", "executing"]:
             qid, token = parts[3], int(parts[4]) if len(parts) > 4 else 0

@@ -97,6 +97,10 @@ class TrackedQuery:
     elapsed_s: float = 0.0
     retries: int = 0
     distributed: bool = False             # ran via the stage scheduler
+    # why the stage scheduler declined (None when distributed/local-only
+    # coordinator): surfaced in /v1/query info so `SET SESSION
+    # distributed = true` degrading to local is never silent
+    fallback_reason: Optional[str] = None
 
     @property
     def state(self) -> str:
