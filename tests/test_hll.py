@@ -1,0 +1,106 @@
+"""HyperLogLog approx_distinct (relational rewrite).
+
+Reference behavior: ApproximateCountDistinctAggregation.java — default
+max standard error 2.3%, NULLs ignored, mergeable partial state. Here
+the sketch is a relational rewrite (planner.plan_hll_aggregation): an
+inner max-aggregate over (keys, bucket) rows whose partials merge with
+the ordinary machinery, so the same bound must hold in single-shot,
+chunked, and distributed execution.
+"""
+
+import pytest
+
+from trino_tpu.exec.session import Session
+
+TOL = 0.023
+
+
+def _close(got, want):
+    # 2.3% is the sketch's ASYMPTOTIC standard error; for small true
+    # counts the absolute error floor of a few registers dominates
+    return abs(got - want) <= max(TOL * want, 5)
+
+
+@pytest.fixture(scope="module")
+def session():
+    return Session(default_schema="tiny")
+
+
+def _exact(session, sql):
+    return session.execute(sql).rows
+
+
+def test_hll_global_accuracy(session):
+    got = session.execute(
+        "SELECT approx_distinct(o_custkey) FROM orders").rows[0][0]
+    want = session.execute(
+        "SELECT count(DISTINCT o_custkey) FROM orders").rows[0][0]
+    assert _close(got, want)
+
+
+def test_hll_grouped_accuracy(session):
+    got = session.execute("""
+        SELECT l_returnflag, approx_distinct(l_orderkey)
+        FROM lineitem GROUP BY l_returnflag ORDER BY l_returnflag""").rows
+    want = session.execute("""
+        SELECT l_returnflag, count(DISTINCT l_orderkey)
+        FROM lineitem GROUP BY l_returnflag ORDER BY l_returnflag""").rows
+    for (f1, a), (f2, e) in zip(got, want):
+        assert f1 == f2
+        assert _close(a, e)
+
+
+def test_hll_mixed_with_plain_aggs(session):
+    rows = session.execute("""
+        SELECT l_returnflag, approx_distinct(l_suppkey), count(*),
+               sum(l_quantity), min(l_orderkey), max(l_orderkey)
+        FROM lineitem GROUP BY l_returnflag ORDER BY l_returnflag""").rows
+    want = session.execute("""
+        SELECT l_returnflag, count(DISTINCT l_suppkey), count(*),
+               sum(l_quantity), min(l_orderkey), max(l_orderkey)
+        FROM lineitem GROUP BY l_returnflag ORDER BY l_returnflag""").rows
+    for g, w in zip(rows, want):
+        assert g[0] == w[0]
+        assert _close(g[1], w[1])
+        assert tuple(g[2:]) == tuple(w[2:])       # plain aggs stay exact
+
+
+def test_hll_nulls_and_empty(session):
+    # all rows filtered out: approx_distinct over empty input is 0
+    got = session.execute(
+        "SELECT approx_distinct(o_custkey) FROM orders "
+        "WHERE o_custkey < 0").rows[0][0]
+    assert got == 0
+    # NULLs are ignored (nation has no nulls; synthesize via nullif)
+    got = session.execute(
+        "SELECT approx_distinct(nullif(n_nationkey, n_nationkey)) "
+        "FROM nation").rows[0][0]
+    assert got == 0
+    got = session.execute(
+        "SELECT approx_distinct(nullif(n_nationkey, 3)) "
+        "FROM nation").rows[0][0]
+    assert got == 24
+
+
+def test_hll_chunked_bounded_state(session):
+    """The chunked driver merges the inner aggregate's partial rows —
+    bounded 2^p rows per group — instead of refusing distinct the way
+    the exact path must."""
+    s = Session(default_schema="tiny")
+    want = s.execute(
+        "SELECT count(DISTINCT l_orderkey) FROM lineitem").rows[0][0]
+    s.properties["spill_chunk_rows"] = 8192
+    s.executor.spill_chunk_rows = 8192
+    got = s.execute(
+        "SELECT approx_distinct(l_orderkey) FROM lineitem").rows[0][0]
+    assert s.executor.stats.agg_spill_chunks > 1, "did not chunk"
+    assert _close(got, want)
+
+
+def test_hll_matches_exact_fallbacks(session):
+    """Mixed with an exact DISTINCT aggregate the rewrite steps aside
+    (shared sort-dedup column), so approx == exact there."""
+    rows = session.execute("""
+        SELECT approx_distinct(o_custkey), count(DISTINCT o_custkey)
+        FROM orders""").rows[0]
+    assert rows[0] == rows[1]

@@ -126,10 +126,11 @@ class Executor:
         # async dispatch chain with a single final result fetch — each
         # avoided sync is a ~100-260 ms tunnel round trip here.
         self._decision_cache: Dict[tuple, tuple] = {}
-        # per-execution memo of build_structure_key by plan-node id —
-        # the plan holds every node alive for the duration of execute(),
-        # so ids are stable; cleared with _subst at query start
-        self._skey_memo: Dict[int, Optional[str]] = {}
+        # per-execution memo of build_structure_key: id(node) -> (node,
+        # key). The node reference keeps temporaries alive so CPython
+        # cannot reuse their id within one execution; cleared at query
+        # start
+        self._skey_memo: Dict[int, tuple] = {}
 
     # ------------------------------------------------------------------
 
@@ -260,12 +261,17 @@ class Executor:
     def memo_structure_key(self, node: L.PlanNode) -> Optional[str]:
         """build_structure_key with a per-execution id(node) memo: a join
         makes several decision fetches against the same subtree and the
-        serde+sha walk is O(subtree) host work each time."""
+        serde+sha walk is O(subtree) host work each time. The memo holds
+        the NODE too, not just its id — short-lived dataclasses.replace
+        temporaries (packed-key joins) would otherwise free their id for
+        reuse by a later temp, which would inherit the wrong key and
+        poison the cross-run decision cache."""
         nid = id(node)
-        if nid in self._skey_memo:
-            return self._skey_memo[nid]
+        hit = self._skey_memo.get(nid)
+        if hit is not None:
+            return hit[1]
         skey = self.build_structure_key(node)
-        self._skey_memo[nid] = skey
+        self._skey_memo[nid] = (node, skey)
         return skey
 
     def run_cached_build(self, node: L.PlanNode) -> Batch:
@@ -562,6 +568,19 @@ class Executor:
             return direct_group_aggregate(child, node.group_keys,
                                           node.key_domains, aggs)
         capacity = node.out_capacity
+        # planner NDV products overestimate real group counts by orders
+        # of magnitude on join outputs, and the sorted kernel's key
+        # readback gathers scale with OUT capacity — so once a run has
+        # measured the true group count, later runs size the output
+        # tightly from the decision cache (one recompile, then every
+        # re-execution gathers at the real G instead of the estimate)
+        if not self.chunk_mode and not self._subst:
+            skey = self.memo_structure_key(node)
+            known = self._decision_cache.get(
+                ("aggfinal", skey, self._decision_salt())) \
+                if skey is not None else None
+            if known is not None:
+                capacity = max(1024, bucket_capacity(known[0]))
         # big inputs: pack all keys into one int64 so the sort has 2
         # operands — the general kernel's 2-per-key operand count makes
         # XLA TPU compiles explode at scale (see SORT_COMPILE_BUDGET)
@@ -588,6 +607,11 @@ class Executor:
                 break
             capacity *= 4
             self.stats.agg_capacity_retries += 1
+        if not self.chunk_mode and not self._subst:
+            skey = self.memo_structure_key(node)
+            if skey is not None:
+                self._decision_cache[
+                    ("aggfinal", skey, self._decision_salt())] = (n_groups,)
         if n_groups == 0 and not node.group_keys:
             # zero-key sort aggregation (global DISTINCT) over an empty
             # input: SQL still requires one output row (0 counts / NULL

@@ -493,7 +493,63 @@ def eval_scalar_func(expr: ir.ScalarFunc, batch: Batch):
     if name == "ln":
         x = d.astype(jnp.float64)
         return jnp.log(jnp.where(x > 0, x, jnp.float64(1))), v & (x > 0)
+
+    # ---- HyperLogLog building blocks (approx_distinct) ---------------
+    # The reference keeps an HLL sketch object per group
+    # (operator/aggregation/ApproximateCountDistinctAggregation.java +
+    # airlift HyperLogLog). TPU redesign: the sketch IS a relational
+    # rewrite — registers become (group, bucket) rows of an inner
+    # max-aggregate, so partials merge through the ordinary mergeable-
+    # aggregation machinery (chunked + distributed for free) with
+    # bounded 2^p-per-group state. These scalars are the hash-side
+    # primitives of that rewrite.
+    if name in ("$hll_bucket", "$hll_rho"):
+        p = expr.params[0]
+        h = _hll_hash64(d)
+        if name == "$hll_bucket":
+            return jax.lax.shift_right_logical(h, 64 - p), v
+        w = jax.lax.shift_left(h, p)
+        rho = jnp.minimum(jax.lax.clz(w) + 1, 64 - p + 1)
+        return rho.astype(jnp.int64), v
+    if name == "$hll_pow":
+        # 2^-rho contribution to the harmonic mean; NULL passes through
+        return jnp.exp2(-d.astype(jnp.float64)), v
+    if name == "$hll_est":
+        # finisher over (V = occupied registers, S = sum 2^-rho):
+        # raw HLL estimate with linear-counting correction for the
+        # small range, 0 for all-NULL/empty groups
+        m = float(expr.params[0])
+        (vd, vv) = parts[0]
+        (sd, sv) = parts[1]
+        V = jnp.where(vv, vd, 0).astype(jnp.float64)
+        S = jnp.where(sv, sd, 0.0).astype(jnp.float64)
+        alpha = 0.7213 / (1.0 + 1.079 / m)
+        raw = alpha * m * m / (S + (m - V))
+        zeros = m - V
+        lin = m * jnp.log(jnp.where(zeros > 0, m / jnp.maximum(zeros, 0.5),
+                                    1.0))
+        est = jnp.where((raw <= 2.5 * m) & (zeros > 0), lin, raw)
+        est = jnp.where(V == 0, 0.0, est)
+        return jnp.round(est).astype(jnp.int64), jnp.ones_like(vv)
     raise NotImplementedError(f"scalar function {name}")
+
+
+def _hll_hash64(d):
+    """splitmix64 finalizer over the lane value (int64 two's-complement
+    wraparound arithmetic; logical shifts via lax). Doubles hash their
+    bit pattern; dictionary codes hash as ints (code identity == string
+    identity within a pool)."""
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        x = jax.lax.bitcast_convert_type(d.astype(jnp.float64), jnp.int64)
+    else:
+        x = d.astype(jnp.int64)
+    x = x + jnp.int64(-7046029254386353131)          # 0x9E3779B97F4A7C15
+    x = x ^ jax.lax.shift_right_logical(x, 30)
+    x = x * jnp.int64(-4658895280553007687)          # 0xBF58476D1CE4E5B9
+    x = x ^ jax.lax.shift_right_logical(x, 27)
+    x = x * jnp.int64(-7723592293110705685)          # 0x94D049BB133111EB
+    x = x ^ jax.lax.shift_right_logical(x, 31)
+    return x
 
 
 def filter_mask(expr: ir.Expr, batch: Batch) -> jax.Array:

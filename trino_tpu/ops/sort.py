@@ -92,11 +92,20 @@ def sort_batch_packed(batch: Batch, kmins, keys: tuple, key_bits: tuple,
     packed = jnp.where(batch.live, packed, jnp.iinfo(jnp.int64).max)
     idx_arr = jnp.arange(n, dtype=jnp.int32)
     _, perm = jax.lax.sort((packed, idx_arr), num_keys=1, is_stable=True)
+    out_n = n
+    if limit is not None and int(limit) < n:
+        # TopN: dead rows sort last, so the winners live in the prefix —
+        # slice the permutation BEFORE the payload gathers. The gathers
+        # are the kernel's whole cost at scale (the sort itself is 2
+        # operands); a LIMIT 10 over millions must not gather millions.
+        from ..batch import bucket_capacity
+        out_n = min(n, max(1024, bucket_capacity(int(limit))))
+        perm = perm[:out_n]
     cols = tuple(Column(data=c.data[perm], valid=c.valid[perm])
                  for c in batch.columns)
     live = batch.live[perm]
     if limit is not None:
-        live = live & (jnp.arange(n) < limit)
+        live = live & (jnp.arange(out_n) < limit)
     return Batch(columns=cols, live=live)
 
 

@@ -42,6 +42,10 @@ from .analyzer import (AGG_NAMES, VARIANCE_AGGS, AnalysisError,
 from ..ops.aggregate import MAX_DIRECT_GROUPS  # dense-domain cutoff (64)
 
 DEFAULT_SORT_GROUPS = 1 << 16    # sort-agg output capacity default
+# HyperLogLog precision for approx_distinct: 2^12 registers gives ~1.6%
+# standard error (inside the reference's 2.3% default,
+# ApproximateCountDistinctAggregation.java's maxStandardError)
+HLL_P = 12
 
 
 def _scale_of(dtype) -> int:
@@ -1123,7 +1127,14 @@ class Planner:
                           build_key_domain=build_key_domain)
 
     # dense-LUT memory caps: absolute 2^30 entries (4GB of int32), and
-    # 16x the build rows so wildly sparse domains stay on the sorted path
+    # 256x the build rows so only wildly sparse domains stay on the
+    # sorted path. The sparsity cap is deliberately loose: scatter cost
+    # is O(domain memset + rows) and probe cost is O(probe gathers) —
+    # both independent of sparsity — so the only real cost of a sparse
+    # LUT is HBM, and a measured 33M-probe dense join runs ~2s where the
+    # sorted fallback takes ~60s. (A cost-reordered bushy build side is
+    # often SMALL relative to its key domain — a 16x cap silently
+    # knocked those joins off the dense path.)
     _DENSE_DOMAIN_CAP = 1 << 30
 
     def _dense_key_domain(self, build_node, build_keys, build_fields):
@@ -1148,7 +1159,7 @@ class Planner:
             return None
         d = int(s.max_val) + 2
         rows = self.estimate_rows(build_node)
-        if d > self._DENSE_DOMAIN_CAP or d > max(1 << 22, 16 * rows):
+        if d > self._DENSE_DOMAIN_CAP or d > max(1 << 22, 256 * rows):
             return None
         return 1 << (d - 1).bit_length()      # pow2: stable jit cache
 
@@ -1777,6 +1788,17 @@ class Planner:
 
         n_keys = len(group_irs)
         distinct_args: List[int] = []
+        # approx_distinct -> HLL relational rewrite (below): each entry
+        # is (call, bucket_slot, rho_slot). Grouping sets keep the exact
+        # sort-distinct lowering (the rewrite would have to replicate
+        # per grouping set).
+        hll_calls: List[tuple] = []
+        # a DISTINCT sum/count shares the sort kernel's dedup column; the
+        # HLL rewrite can't carry it through the (keys, bucket) inner
+        # grouping, so approx_distinct degrades to exact sort-distinct
+        # whenever one is present
+        any_exact_distinct = any(
+            c.distinct and c.name in ("sum", "count") for c in agg_calls)
         for call in agg_calls:
             if call.distinct and call.name == "avg":
                 raise AnalysisError("avg(DISTINCT) not yet supported")
@@ -1788,6 +1810,14 @@ class Planner:
             if len(call.args) != 1:
                 raise AnalysisError(f"{call.name} takes one argument")
             arg = lowerer.lower(call.args[0])
+            if call.name == "approx_distinct" and not q.grouping_sets \
+                    and not any_exact_distinct:
+                b_slot = add_arg(ir.ScalarFunc(
+                    "$hll_bucket", (arg,), BIGINT, (HLL_P,)))
+                r_slot = add_arg(ir.ScalarFunc(
+                    "$hll_rho", (arg,), BIGINT, (HLL_P,)))
+                hll_calls.append((call, b_slot, r_slot))
+                continue
             slot = add_arg(arg)
             t = arg.dtype
             # min/max DISTINCT == plain min/max; sum/count DISTINCT need
@@ -1888,6 +1918,13 @@ class Planner:
                 q.grouping_sets, pre_node, group_irs, agg_specs, scope,
                 agg_out, bool(distinct_args),
                 grouping_specs=tuple(grouping_specs))
+        elif hll_calls:
+            agg_node, agg_specs = self.plan_hll_aggregation(
+                q, pre_node, group_irs, agg_specs, scope, hll_calls,
+                call_slots, distinct_args)
+            agg_out = tuple(
+                [(f"gk{i}", e.dtype) for i, e in enumerate(group_irs)] +
+                [(s.out_name, s.out_dtype) for s in agg_specs])
         else:
             strategy, domains, capacity = self.agg_strategy(
                 group_irs, scope, pre_node,
@@ -1936,6 +1973,15 @@ class Planner:
                     if kind == "plain":
                         spec = agg_specs[s1]
                         return ir.ColumnRef(n_keys + s1, spec.out_dtype)
+                    if kind == "hll":
+                        # finisher over (V = occupied registers,
+                        # S = sum 2^-rho) — see plan_hll_aggregation
+                        from ..types import DOUBLE as _D
+                        return ir.ScalarFunc(
+                            "$hll_est",
+                            (ir.ColumnRef(n_keys + s1, BIGINT),
+                             ir.ColumnRef(n_keys + s2, _D)),
+                            BIGINT, (1 << HLL_P,))
                     if kind == "bool":
                         return ir.Compare(
                             "=", ir.ColumnRef(n_keys + s1, BIGINT),
@@ -2085,6 +2131,93 @@ class Planner:
             current = L.SetOpNode("union_all", current, b, none_maps,
                                   none_maps, agg_out)
         return current
+
+    def plan_hll_aggregation(self, q, pre_node, group_irs, agg_specs,
+                             scope, hll_calls, call_slots, distinct_args):
+        """approx_distinct as a relational HLL rewrite (the TPU answer to
+        ApproximateCountDistinctAggregation.java's per-group sketch
+        objects):
+
+            inner : GROUP BY keys + $hll_bucket(x) -> max($hll_rho(x)),
+                    other aggregates as mergeable partials
+            mid   : project 2^-max_rho
+            outer : GROUP BY keys -> merge partials,
+                    V = count(max_rho), S = sum(2^-max_rho)
+            post  : $hll_est(V, S) finisher expression
+
+        The inner aggregate is max/sum/count only, so the chunked driver
+        and the distributed source stage merge its partial states with
+        the ordinary machinery — bounded 2^p rows of state per group,
+        where the exact sort-distinct path has unbounded state."""
+        from ..types import DOUBLE as _D
+        assert not distinct_args, \
+            "caller routes DISTINCT mixes to the exact path"
+        uniq = {}
+        for call, b, r in hll_calls:
+            uniq.setdefault((b, r), []).append(call)
+        if len(uniq) > 1:
+            raise AnalysisError(
+                "multiple approx_distinct arguments unsupported")
+        (b_slot, r_slot), calls = next(iter(uniq.items()))
+        n_keys = len(group_irs)
+        npart = len(agg_specs)
+
+        # inner aggregate: keys + bucket, partial states + max(rho)
+        inner_specs = list(agg_specs) + [L.AggSpecNode(
+            "max", ir.ColumnRef(r_slot, BIGINT), "$mrho", BIGINT)]
+        inner_out = tuple(
+            [(f"gk{i}", e.dtype) for i, e in enumerate(group_irs)] +
+            [("$hllb", BIGINT)] +
+            [(s.out_name, s.out_dtype) for s in inner_specs])
+        # capacity: per-group state saturates at 2^p registers, and the
+        # total can never exceed the input row count
+        base = self._sort_capacity(group_irs, scope, pre_node) \
+            if group_irs else 1
+        rows = max(1024, self.estimate_rows(pre_node))
+        cap = min(max(base, 1) * (1 << HLL_P), rows)
+        cap = 1 << (int(cap) - 1).bit_length()
+        inner = L.AggregateNode(
+            pre_node, tuple(range(n_keys)) + (b_slot,),
+            tuple(inner_specs), "sort", (), cap, inner_out)
+
+        # mid projection: pass keys + partials, add 2^-max_rho
+        mrho = ir.ColumnRef(n_keys + 1 + npart, BIGINT)
+        mid_exprs = tuple(
+            [ir.ColumnRef(i, group_irs[i].dtype) for i in range(n_keys)] +
+            [ir.ColumnRef(n_keys + 1 + j, s.out_dtype)
+             for j, s in enumerate(agg_specs)] +
+            [mrho, ir.ScalarFunc("$hll_pow", (mrho,), _D)])
+        mid_out = tuple(
+            [(f"gk{i}", e.dtype) for i, e in enumerate(group_irs)] +
+            [(s.out_name, s.out_dtype) for s in agg_specs] +
+            [("$mrho", BIGINT), ("$hpow", _D)])
+        mid = L.ProjectNode(inner, mid_exprs, mid_out)
+
+        # outer aggregate: merge partials, count/sum the register rows —
+        # the same merge vocabulary the chunked driver uses, shared so
+        # the two can't drift
+        from ..exec.chunked import MERGE_FUNC as merge_of
+        outer_specs = [
+            L.AggSpecNode(merge_of[s.func],
+                          ir.ColumnRef(n_keys + j, s.out_dtype),
+                          s.out_name, s.out_dtype)
+            for j, s in enumerate(agg_specs)]
+        outer_specs.append(L.AggSpecNode(
+            "count", ir.ColumnRef(n_keys + npart, BIGINT),
+            "$hllv", BIGINT))
+        outer_specs.append(L.AggSpecNode(
+            "sum", ir.ColumnRef(n_keys + npart + 1, _D), "$hlls", _D))
+        agg_out = tuple(
+            [(f"gk{i}", e.dtype) for i, e in enumerate(group_irs)] +
+            [(s.out_name, s.out_dtype) for s in outer_specs])
+        strategy, domains, capacity = self.agg_strategy(
+            group_irs, scope, pre_node)
+        outer = L.AggregateNode(
+            mid, tuple(range(n_keys)), tuple(outer_specs),
+            strategy, domains, capacity, agg_out)
+        for call in calls:
+            call_slots[call] = ("hll", npart, npart + 1)
+        return outer, list(outer_specs)
 
     def agg_strategy(self, group_irs, scope: Scope, pre_node,
                      any_distinct: bool = False):
