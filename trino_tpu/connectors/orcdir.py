@@ -1,0 +1,100 @@
+"""ORC file connector.
+
+Reference role: the ORC storage tier (lib/trino-orc
+reader/OrcRecordReader.java:83 feeding the hive-style connectors). A
+root directory holds schemas as subdirectories and tables as
+`<name>.orc` files; the type mapping mirrors the parquet connector —
+strings dictionary-encode at load, DECIMAL/DATE carry their logical
+annotations.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..batch import Field, Schema
+from ..formats.orc import read_orc
+from ..types import BIGINT, BOOLEAN, DOUBLE, TypeKind, VARCHAR
+from .tpch.datagen import TableData
+
+
+def load_orc(path: str, name: str) -> TableData:
+    from ..types import DATE, decimal
+    names, columns, valids, logicals = read_orc(path)
+    fields: List[Field] = []
+    arrays: List[np.ndarray] = []
+    out_valids: List[Optional[np.ndarray]] = []
+    for cname, col, valid, logical in zip(names, columns, valids,
+                                          logicals):
+        if col.dtype == object:              # STRING -> dict varchar
+            mask = valid if valid is not None else \
+                np.ones(len(col), dtype=np.bool_)
+            pool = sorted({s for s, v in zip(col, mask) if v})
+            index = {s: i for i, s in enumerate(pool)}
+            codes = np.fromiter((index.get(s, 0) for s in col),
+                                dtype=np.int32, count=len(col))
+            arrays.append(codes)
+            fields.append(Field(cname, VARCHAR, dictionary=tuple(pool)))
+        elif logical is not None and logical[0] == "decimal":
+            arrays.append(np.asarray(col, dtype=np.int64))
+            fields.append(Field(cname, decimal(logical[1], logical[2])))
+        elif logical is not None and logical[0] == "date":
+            arrays.append(np.asarray(col, dtype=np.int32))
+            fields.append(Field(cname, DATE))
+        elif col.dtype == np.bool_:
+            arrays.append(np.asarray(col))
+            fields.append(Field(cname, BOOLEAN))
+        elif np.issubdtype(col.dtype, np.integer):
+            arrays.append(np.asarray(col, dtype=np.int64))
+            fields.append(Field(cname, BIGINT))
+        elif np.issubdtype(col.dtype, np.floating):
+            arrays.append(np.asarray(col, dtype=np.float64))
+            fields.append(Field(cname, DOUBLE))
+        else:
+            raise ValueError(f"{name}.{cname}: unsupported ORC dtype "
+                             f"{col.dtype}")
+        out_valids.append(valid)
+    if all(v is None for v in out_valids):
+        out_valids = None
+    return TableData(name, Schema(tuple(fields)), arrays,
+                     valids=out_valids)
+
+
+class OrcConnector:
+    name = "orc"
+
+    def __init__(self, root: str):
+        self.root = root
+        self._cache: Dict[Tuple[str, str], TableData] = {}
+
+    def _schema_dir(self, schema: str) -> str:
+        return os.path.join(self.root, schema)
+
+    def schema_names(self):
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(d for d in os.listdir(self.root)
+                      if os.path.isdir(os.path.join(self.root, d)))
+
+    def table_names(self, schema: str):
+        d = self._schema_dir(schema)
+        if not os.path.isdir(d):
+            return []
+        return sorted(f[:-4] for f in os.listdir(d)
+                      if f.endswith(".orc"))
+
+    def get_table(self, schema: str, table: str) -> TableData:
+        key = (schema, table)
+        if key not in self._cache:
+            path = os.path.join(self._schema_dir(schema), f"{table}.orc")
+            if not os.path.isfile(path):
+                raise KeyError(f"orc table {schema}.{table} not found "
+                               f"({path})")
+            self._cache[key] = load_orc(path, table)
+        return self._cache[key]
+
+    def get_table_schema(self, schema: str, table: str) -> Schema:
+        return self.get_table(schema, table).schema

@@ -27,6 +27,16 @@ from ..types import BIGINT, BOOLEAN, DOUBLE, INTEGER, TypeKind, VARCHAR
 from .tpch.datagen import TableData
 
 
+def _pool_encode(values, mask, key=None):
+    """Shared dictionary-building: sorted unique valid values -> (int32
+    codes, pool tuple). Used by the varchar and array branches."""
+    pool = sorted({v for v, m in zip(values, mask) if m}, key=key)
+    index = {v: i for i, v in enumerate(pool)}
+    codes = np.fromiter((index.get(v, 0) for v in values),
+                        dtype=np.int32, count=len(values))
+    return codes, tuple(pool)
+
+
 def load_parquet(path: str, name: str) -> TableData:
     from ..types import DATE, decimal
     names, columns, valids, logicals = read_parquet(path)
@@ -35,15 +45,37 @@ def load_parquet(path: str, name: str) -> TableData:
     out_valids: List[Optional[np.ndarray]] = []
     for cname, col, valid, logical in zip(names, columns, valids,
                                           logicals):
+        if logical is not None and logical[0] == "list":
+            # LIST leaves arrive as object arrays of per-row tuples;
+            # arrays follow the engine's pool-id discipline
+            from ..types import array_of
+            mask = valid if valid is not None else \
+                np.ones(len(col), dtype=np.bool_)
+
+            def norm(t):
+                if t is None:
+                    return ()
+                return tuple(None if x is None else
+                             float(x) if isinstance(x, (float, np.floating))
+                             else int(x) for x in t)
+            normed = [norm(t) for t in col]
+            elem_t = DOUBLE if any(
+                isinstance(x, float) for t in normed for x in t) else BIGINT
+            codes, pool = _pool_encode(
+                normed, mask,
+                key=lambda t: (len(t), tuple((x is None, x or 0)
+                                             for x in t)))
+            arrays.append(codes)
+            fields.append(Field(cname, array_of(elem_t),
+                                dictionary=pool))
+            out_valids.append(valid)
+            continue
         if col.dtype == object:              # BYTE_ARRAY -> dict varchar
             mask = valid if valid is not None else \
                 np.ones(len(col), dtype=np.bool_)
-            pool = sorted({s for s, v in zip(col, mask) if v})
-            index = {s: i for i, s in enumerate(pool)}
-            codes = np.fromiter((index.get(s, 0) for s in col),
-                                dtype=np.int32, count=len(col))
+            codes, pool = _pool_encode(col, mask)
             arrays.append(codes)
-            fields.append(Field(cname, VARCHAR, dictionary=tuple(pool)))
+            fields.append(Field(cname, VARCHAR, dictionary=pool))
         elif logical is not None and logical[0] == "decimal":
             arrays.append(np.asarray(col, dtype=np.int64))
             fields.append(Field(cname, decimal(logical[1], logical[2])))
@@ -82,6 +114,12 @@ def export_table(data: TableData, path: str) -> None:
         col = np.asarray(data.columns[i])
         valid = None if data.valids is None else data.valids[i]
         logical = None
+        if f.dtype.kind is TypeKind.ARRAY:
+            # the flat writer cannot represent repeated leaves; silent
+            # code-column output would corrupt a round trip
+            raise ValueError(
+                f"{data.name}.{f.name}: ARRAY columns cannot be "
+                "exported to parquet yet")
         if f.dtype.kind is TypeKind.VARCHAR:
             pool = np.array(f.dictionary, dtype=object)
             col = pool[col]

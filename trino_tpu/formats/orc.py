@@ -1,0 +1,460 @@
+"""ORC reader — pure numpy, no external dependencies.
+
+Reference: lib/trino-orc (reader/OrcRecordReader.java:83, the stripe /
+stream / RLE decoding stack). Coverage, built from the ORC v1 spec:
+
+- protobuf wire decoding for PostScript / Footer / StripeFooter metadata
+  (a small generic field->values reader; ORC metadata is plain proto2)
+- compression kinds NONE / ZLIB (raw deflate) / SNAPPY / LZ4, applied
+  per ORC's 3-byte chunk framing (header = length << 1 | isOriginal)
+- column types BOOLEAN / BYTE / SHORT / INT / LONG / FLOAT / DOUBLE /
+  STRING / VARCHAR / CHAR / DATE / DECIMAL (<=18 digits) inside a
+  top-level STRUCT; LIST/MAP/UNION/TIMESTAMP are rejected loudly
+- integer RLE v1 and v2 (SHORT_REPEAT / DIRECT / PATCHED_BASE / DELTA),
+  boolean/byte RLE for presence bits, string DIRECT_V2 and
+  DICTIONARY_V2 encodings
+- multiple stripes; NULLs via PRESENT streams
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .parquet import lz4_raw_decompress, snappy_decompress
+
+# compression kinds (PostScript field 2)
+C_NONE, C_ZLIB, C_SNAPPY, C_LZO, C_LZ4, C_ZSTD = 0, 1, 2, 3, 4, 5
+
+# type kinds (Footer Type field 1)
+(K_BOOLEAN, K_BYTE, K_SHORT, K_INT, K_LONG, K_FLOAT, K_DOUBLE, K_STRING,
+ K_BINARY, K_TIMESTAMP, K_LIST, K_MAP, K_STRUCT, K_UNION, K_DECIMAL,
+ K_DATE, K_VARCHAR, K_CHAR) = range(18)
+
+# stream kinds (StripeFooter Stream field 2)
+S_PRESENT, S_DATA, S_LENGTH, S_DICTIONARY_DATA, S_DICTIONARY_COUNT, \
+    S_SECONDARY = 0, 1, 2, 3, 4, 5
+
+# column encodings
+E_DIRECT, E_DICTIONARY, E_DIRECT_V2, E_DICTIONARY_V2 = 0, 1, 2, 3
+
+
+# --------------------------------------------------------------------------
+# protobuf wire format
+# --------------------------------------------------------------------------
+
+def _pb_varint(b: bytes, pos: int) -> Tuple[int, int]:
+    out = shift = 0
+    while True:
+        x = b[pos]
+        pos += 1
+        out |= (x & 0x7F) << shift
+        if not x & 0x80:
+            return out, pos
+        shift += 7
+
+
+def pb_decode(b: bytes) -> Dict[int, list]:
+    """Generic proto2 message -> {field: [raw values]} (varints stay
+    ints, length-delimited stay bytes; callers interpret)."""
+    fields: Dict[int, list] = {}
+    pos = 0
+    n = len(b)
+    while pos < n:
+        key, pos = _pb_varint(b, pos)
+        fid, wire = key >> 3, key & 7
+        if wire == 0:
+            v, pos = _pb_varint(b, pos)
+        elif wire == 1:
+            v = struct.unpack_from("<q", b, pos)[0]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _pb_varint(b, pos)
+            v = b[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            v = struct.unpack_from("<i", b, pos)[0]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wire}")
+        fields.setdefault(fid, []).append(v)
+    return fields
+
+
+def _zz(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def pb_ints(msg: Dict[int, list], fid: int) -> List[int]:
+    """Repeated integer field, handling proto2 packed encoding (the
+    values arrive as one length-delimited blob of varints)."""
+    out: List[int] = []
+    for v in msg.get(fid, []):
+        if isinstance(v, bytes):
+            pos = 0
+            while pos < len(v):
+                x, pos = _pb_varint(v, pos)
+                out.append(x)
+        else:
+            out.append(v)
+    return out
+
+
+# --------------------------------------------------------------------------
+# compression chunk framing
+# --------------------------------------------------------------------------
+
+def _decompress_stream(kind: int, data: bytes) -> bytes:
+    if kind == C_NONE:
+        return data
+    out = bytearray()
+    pos = 0
+    n = len(data)
+    while pos < n:
+        header = int.from_bytes(data[pos:pos + 3], "little")
+        pos += 3
+        length = header >> 1
+        chunk = data[pos:pos + length]
+        pos += length
+        if header & 1:                   # isOriginal
+            out += chunk
+        elif kind == C_ZLIB:
+            out += zlib.decompress(chunk, wbits=-15)
+        elif kind == C_SNAPPY:
+            out += snappy_decompress(chunk)
+        elif kind == C_LZ4:
+            out += lz4_raw_decompress(chunk, -1)
+        else:
+            raise ValueError(f"unsupported ORC compression kind {kind}")
+    return bytes(out)
+
+
+# --------------------------------------------------------------------------
+# RLE decoders
+# --------------------------------------------------------------------------
+
+def _bool_rle(data: bytes, count: int) -> np.ndarray:
+    """Byte-RLE then bit expansion, MSB first."""
+    by = _byte_rle(data, (count + 7) // 8)
+    bits = np.unpackbits(np.frombuffer(by, dtype=np.uint8),
+                         bitorder="big")
+    return bits[:count].astype(np.bool_)
+
+
+def _byte_rle(data: bytes, count: int) -> bytes:
+    out = bytearray()
+    pos = 0
+    while len(out) < count and pos < len(data):
+        h = data[pos]
+        pos += 1
+        if h < 128:                      # run of h+3 repeats
+            out += bytes([data[pos]]) * (h + 3)
+            pos += 1
+        else:                            # 256-h literals
+            n = 256 - h
+            out += data[pos:pos + n]
+            pos += n
+    return bytes(out[:count])
+
+
+def _unpack_bits_be(data: bytes, width: int, count: int,
+                    pos: int) -> Tuple[np.ndarray, int]:
+    """Big-endian bit-packed integers (RLEv2 DIRECT/PATCHED payloads)."""
+    nbits = width * count
+    nbytes = (nbits + 7) // 8
+    raw = np.frombuffer(data, dtype=np.uint8, count=nbytes, offset=pos)
+    bits = np.unpackbits(raw, bitorder="big")[:nbits]
+    vals = np.zeros(count, dtype=np.int64)
+    bm = bits.reshape(count, width).astype(np.int64)
+    for i in range(width):
+        vals = (vals << 1) | bm[:, i]
+    return vals, pos + nbytes
+
+
+# RLEv2 5-bit width encoding -> actual bit width
+_W5 = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18,
+       19, 20, 21, 22, 23, 24, 26, 28, 30, 32, 40, 48, 56, 64]
+
+
+def _varint(data: bytes, pos: int) -> Tuple[int, int]:
+    return _pb_varint(data, pos)
+
+
+def int_rle_v2(data: bytes, count: int, signed: bool) -> np.ndarray:
+    out = np.empty(count, dtype=np.int64)
+    filled = 0
+    pos = 0
+    while filled < count:
+        h = data[pos]
+        enc = h >> 6
+        if enc == 0:                     # SHORT_REPEAT
+            width = ((h >> 3) & 0x7) + 1
+            run = (h & 0x7) + 3
+            v = int.from_bytes(data[pos + 1:pos + 1 + width], "big")
+            pos += 1 + width
+            if signed:
+                v = _zz(v)
+            out[filled:filled + run] = v
+            filled += run
+        elif enc == 1:                   # DIRECT
+            width = _W5[(h >> 1) & 0x1F]
+            run = (((h & 1) << 8) | data[pos + 1]) + 1
+            pos += 2
+            vals, pos = _unpack_bits_be(data, width, run, pos)
+            if signed:
+                vals = (vals >> 1) ^ -(vals & 1)
+            out[filled:filled + run] = vals
+            filled += run
+        elif enc == 2:                   # PATCHED_BASE
+            width = _W5[(h >> 1) & 0x1F]
+            run = (((h & 1) << 8) | data[pos + 1]) + 1
+            b3, b4 = data[pos + 2], data[pos + 3]
+            bw = (b3 >> 5) + 1           # base value width, bytes
+            pw = _W5[b3 & 0x1F]          # patch value width, bits
+            pgw = (b4 >> 5) + 1          # patch gap width, bits
+            pll = b4 & 0x1F              # patch list length
+            pos += 4
+            base = int.from_bytes(data[pos:pos + bw], "big")
+            sign = base >> (bw * 8 - 1)
+            if sign:
+                base = -(base & ((1 << (bw * 8 - 1)) - 1))
+            pos += bw
+            vals, pos = _unpack_bits_be(data, width, run, pos)
+            patch_width = pgw + pw
+            patches, pos = _unpack_bits_be(
+                data, ((patch_width + 7) // 8) * 8, pll, pos)
+            gap_acc = 0
+            for p in patches.tolist():
+                gap = p >> pw
+                patch = p & ((1 << pw) - 1)
+                gap_acc += gap
+                vals[gap_acc] |= patch << width
+            out[filled:filled + run] = base + vals
+            filled += run
+        else:                            # DELTA
+            width_code = (h >> 1) & 0x1F
+            width = _W5[width_code] if width_code else 0
+            run = (((h & 1) << 8) | data[pos + 1]) + 1
+            pos += 2
+            v0, pos = _varint(data, pos)
+            base = _zz(v0) if signed else v0
+            delta0, pos = _varint(data, pos)
+            delta0 = _zz(delta0)
+            seq = [base]
+            if run > 1:
+                seq.append(base + delta0)
+            if run > 2:
+                if width:
+                    deltas, pos = _unpack_bits_be(data, width, run - 2,
+                                                  pos)
+                    sgn = 1 if delta0 >= 0 else -1
+                    for d in deltas.tolist():
+                        seq.append(seq[-1] + sgn * d)
+                else:
+                    for _ in range(run - 2):
+                        seq.append(seq[-1] + delta0)
+            out[filled:filled + run] = seq
+            filled += run
+    return out
+
+
+def int_rle_v1(data: bytes, count: int, signed: bool) -> np.ndarray:
+    out = np.empty(count, dtype=np.int64)
+    filled = 0
+    pos = 0
+    while filled < count:
+        h = data[pos]
+        pos += 1
+        if h < 128:                      # run
+            run = h + 3
+            delta = struct.unpack_from("<b", data, pos)[0]
+            pos += 1
+            v, pos = _varint(data, pos)
+            if signed:
+                v = _zz(v)
+            out[filled:filled + run] = v + delta * np.arange(run)
+            filled += run
+        else:                            # literals
+            n = 256 - h
+            for i in range(n):
+                v, pos = _varint(data, pos)
+                out[filled + i] = _zz(v) if signed else v
+            filled += n
+    return out
+
+
+# --------------------------------------------------------------------------
+# reader
+# --------------------------------------------------------------------------
+
+class OrcFile:
+    def __init__(self, names, columns, valids, logicals):
+        self.names = names
+        self.columns = columns
+        self.valids = valids
+        self.logicals = logicals
+
+
+def read_orc(path: str):
+    """Read an ORC file -> (names, columns, valids, logicals)."""
+    f = read_orc_file(path)
+    return f.names, f.columns, f.valids, f.logicals
+
+
+def read_orc_file(path: str) -> OrcFile:
+    with open(path, "rb") as f:
+        blob = f.read()
+    ps_len = blob[-1]
+    ps = pb_decode(blob[-1 - ps_len:-1])
+    footer_len = ps[1][0]
+    comp = ps.get(2, [C_NONE])[0]
+    magic = ps.get(8000, [b""])[0]
+    if magic != b"ORC":
+        raise ValueError("not an ORC file")
+    footer_raw = blob[-1 - ps_len - footer_len:-1 - ps_len]
+    footer = pb_decode(_decompress_stream(comp, footer_raw))
+
+    types = [pb_decode(t) for t in footer.get(4, [])]
+    root = types[0]
+    if root.get(1, [K_STRUCT])[0] != K_STRUCT:
+        raise ValueError("ORC root type must be STRUCT")
+    child_ids = pb_ints(root, 2)
+    names = [n.decode() for n in root.get(3, [])]
+    for cid in child_ids:
+        k = types[cid].get(1, [None])[0]
+        if k in (K_LIST, K_MAP, K_UNION, K_TIMESTAMP, K_BINARY):
+            raise ValueError(f"unsupported ORC column kind {k}")
+
+    stripes = [pb_decode(s) for s in footer.get(3, [])]
+    col_parts: Dict[int, list] = {cid: [] for cid in child_ids}
+    val_parts: Dict[int, list] = {cid: [] for cid in child_ids}
+    for st in stripes:
+        offset = st.get(1, [0])[0]
+        index_len = st.get(2, [0])[0]
+        data_len = st.get(3, [0])[0]
+        sfooter_len = st.get(4, [0])[0]
+        n_rows = st.get(5, [0])[0]
+        sf_raw = blob[offset + index_len + data_len:
+                      offset + index_len + data_len + sfooter_len]
+        sfooter = pb_decode(_decompress_stream(comp, sf_raw))
+        streams = [pb_decode(s) for s in sfooter.get(1, [])]
+        encodings = [pb_decode(e) for e in sfooter.get(2, [])]
+        # stream placement: sequential after the index region
+        spos = offset
+        placed = []
+        for s in streams:
+            kind = s.get(1, [S_DATA])[0]
+            col = s.get(2, [0])[0]
+            ln = s.get(3, [0])[0]
+            placed.append((kind, col, spos, ln))
+            spos += ln
+        for cid in child_ids:
+            kind = types[cid].get(1, [None])[0]
+            enc = encodings[cid].get(1, [E_DIRECT])[0] \
+                if cid < len(encodings) else E_DIRECT
+            dict_size = encodings[cid].get(2, [0])[0] \
+                if cid < len(encodings) else 0
+            mine = {k: blob[p:p + ln]
+                    for (k, c, p, ln) in placed if c == cid}
+            vals, valid = _read_column(kind, enc, dict_size, mine, comp,
+                                       n_rows, types[cid])
+            col_parts[cid].append(vals)
+            val_parts[cid].append(valid)
+
+    columns, valids, logicals = [], [], []
+    for cid in child_ids:
+        parts = col_parts[cid]
+        vparts = val_parts[cid]
+        columns.append(np.concatenate(parts) if len(parts) > 1 else
+                       (parts[0] if parts else np.zeros(0, np.int64)))
+        if any(v is not None for v in vparts):
+            vs = [v if v is not None else np.ones(len(p), np.bool_)
+                  for v, p in zip(vparts, parts)]
+            valids.append(np.concatenate(vs) if len(vs) > 1 else vs[0])
+        else:
+            valids.append(None)
+        kind = types[cid].get(1, [None])[0]
+        if kind == K_DECIMAL:
+            logicals.append(("decimal",
+                             types[cid].get(5, [18])[0],
+                             types[cid].get(6, [0])[0]))
+        elif kind == K_DATE:
+            logicals.append(("date",))
+        else:
+            logicals.append(None)
+    return OrcFile(names, columns, valids, logicals)
+
+
+def _read_column(kind, enc, dict_size, streams, comp, n_rows, tmeta):
+    present = streams.get(S_PRESENT)
+    valid = None
+    if present is not None:
+        valid = _bool_rle(_decompress_stream(comp, present), n_rows)
+    n_present = int(valid.sum()) if valid is not None else n_rows
+    data = _decompress_stream(comp, streams.get(S_DATA, b""))
+
+    def rle_ints(raw, cnt, signed=True):
+        if enc in (E_DIRECT_V2, E_DICTIONARY_V2):
+            return int_rle_v2(raw, cnt, signed)
+        return int_rle_v1(raw, cnt, signed)
+
+    if kind in (K_SHORT, K_INT, K_LONG, K_DATE):
+        vals_p = rle_ints(data, n_present)
+    elif kind == K_BYTE:
+        vals_p = np.frombuffer(_byte_rle(data, n_present),
+                               dtype=np.int8).astype(np.int64)
+    elif kind == K_BOOLEAN:
+        vals_p = _bool_rle(data, n_present)
+    elif kind == K_FLOAT:
+        vals_p = np.frombuffer(data, dtype="<f4",
+                               count=n_present).astype(np.float64)
+    elif kind == K_DOUBLE:
+        vals_p = np.frombuffer(data, dtype="<f8", count=n_present)
+    elif kind in (K_STRING, K_VARCHAR, K_CHAR):
+        lens_raw = _decompress_stream(comp, streams.get(S_LENGTH, b""))
+        if enc in (E_DICTIONARY, E_DICTIONARY_V2):
+            dict_raw = _decompress_stream(
+                comp, streams.get(S_DICTIONARY_DATA, b""))
+            lens = rle_ints(lens_raw, dict_size, signed=False)
+            pool, pos = [], 0
+            for ln in lens.tolist():
+                pool.append(dict_raw[pos:pos + ln].decode(
+                    "utf-8", "replace"))
+                pos += ln
+            idx = rle_ints(data, n_present, signed=False)
+            vals_p = np.array([pool[i] for i in idx.tolist()],
+                              dtype=object)
+        else:
+            lens = rle_ints(lens_raw, n_present, signed=False)
+            out, pos = [], 0
+            for ln in lens.tolist():
+                out.append(data[pos:pos + ln].decode("utf-8", "replace"))
+                pos += ln
+            vals_p = np.array(out, dtype=object)
+    elif kind == K_DECIMAL:
+        # unbounded base-128 varints (sign in zigzag), scale SECONDARY
+        sec = _decompress_stream(comp, streams.get(S_SECONDARY, b""))
+        scales = rle_ints(sec, n_present)
+        scale = tmeta.get(6, [0])[0]
+        vals = []
+        pos = 0
+        for i in range(n_present):
+            v, pos = _varint(data, pos)
+            v = _zz(v)
+            s = int(scales[i])
+            vals.append(v * (10 ** (scale - s)) if s != scale else v)
+        vals_p = np.asarray(vals, dtype=np.int64)
+    else:
+        raise ValueError(f"unsupported ORC column kind {kind}")
+
+    if valid is None:
+        return vals_p, None
+    if vals_p.dtype == object:
+        full = np.full(n_rows, "", dtype=object)
+    else:
+        full = np.zeros(n_rows, dtype=vals_p.dtype)
+    full[valid] = vals_p
+    return full, valid

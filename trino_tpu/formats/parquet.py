@@ -1,27 +1,36 @@
 """Parquet reader/writer — pure numpy, no external dependencies.
 
 Reference: lib/trino-parquet (reader/ParquetReader.java:103, writer/) —
-the columnar file format tier. This implementation covers the flat subset
-the engine's column model needs:
+the columnar file format tier. Coverage:
 
 - physical types BOOLEAN / INT32 / INT64 / DOUBLE / BYTE_ARRAY
 - PLAIN value encoding; RLE/bit-packed hybrid definition levels
-- optional (nullable) flat columns, required columns
-- dictionary-encoded BYTE_ARRAY pages (PLAIN_DICTIONARY) on read
-- UNCOMPRESSED codec (no compression libraries in this environment;
-  the codec field is validated and other codecs rejected loudly)
+- optional (nullable) columns; repeated leaves (3-level LIST) read as
+  per-row tuples via definition+repetition level assembly
+- dictionary-encoded pages (PLAIN_DICTIONARY / RLE_DICTIONARY) on read
+- codecs: UNCOMPRESSED always; SNAPPY and LZ4_RAW via from-scratch
+  block decoders (the two formats are byte-oriented LZ77 variants);
+  GZIP/ZLIB via the stdlib. ZSTD/BROTLI are rejected loudly (no
+  library in this environment and the formats are not reimplementable
+  in reasonable space).
+- multiple row groups; per-chunk min/max statistics on write; row-group
+  skipping from statistics given predicate ranges (the reader-side
+  analog of trino-parquet's predicate pushdown,
+  reader/ParquetReader.java row-group filtering)
 
 The thrift compact protocol (footer metadata serde) is implemented here
 directly — parquet's metadata is a small fixed set of structs and carrying
 a thrift library for it would be the only use.
 
-Layout written: PAR1 | column chunks (one data page each, dictionary page
-first for dictionary-encoded columns) | FileMetaData | footer_len | PAR1.
+Layout written: PAR1 | row groups of column chunks (one data page each,
+dictionary page first for dictionary-encoded columns) | FileMetaData |
+footer_len | PAR1.
 """
 
 from __future__ import annotations
 
 import struct
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -38,8 +47,127 @@ T_BOOLEAN, T_INT32, T_INT64, T_INT96, T_FLOAT, T_DOUBLE, T_BYTE_ARRAY = \
     0, 1, 2, 3, 4, 5, 6
 REP_REQUIRED, REP_OPTIONAL, REP_REPEATED = 0, 1, 2
 ENC_PLAIN, ENC_PLAIN_DICTIONARY, ENC_RLE, ENC_RLE_DICTIONARY = 0, 2, 3, 8
-CODEC_UNCOMPRESSED = 0
-PAGE_DATA, PAGE_INDEX, PAGE_DICTIONARY = 0, 1, 2
+CODEC_UNCOMPRESSED, CODEC_SNAPPY, CODEC_GZIP = 0, 1, 2
+CODEC_LZO, CODEC_BROTLI, CODEC_LZ4, CODEC_ZSTD, CODEC_LZ4_RAW = \
+    3, 4, 5, 6, 7
+PAGE_DATA, PAGE_INDEX, PAGE_DICTIONARY, PAGE_DATA_V2 = 0, 1, 2, 3
+
+
+# --------------------------------------------------------------------------
+# codecs
+# --------------------------------------------------------------------------
+
+def snappy_decompress(data: bytes) -> bytes:
+    """Snappy block format (format_description.txt): uvarint output
+    length, then tagged elements — 2-bit tag selects literal or a copy
+    with 1/2/4-byte offsets."""
+    out_len, pos = _uvarint(data, 0)
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:                       # literal
+            ln = tag >> 2
+            if ln >= 60:                    # 60..63: length in next bytes
+                extra = ln - 59
+                ln = int.from_bytes(data[pos:pos + extra], "little")
+                pos += extra
+            ln += 1
+            out += data[pos:pos + ln]
+            pos += ln
+            continue
+        if kind == 1:                       # copy, 1-byte offset
+            ln = ((tag >> 2) & 0x7) + 4
+            off = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:                     # copy, 2-byte offset
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(data[pos:pos + 2], "little")
+            pos += 2
+        else:                               # copy, 4-byte offset
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+        if off == 0 or off > len(out):
+            raise ValueError(f"snappy: copy offset {off} outside the "
+                             f"{len(out)} bytes produced")
+        _lz_copy(out, off, ln)
+    if len(out) != out_len:
+        raise ValueError(f"snappy: expected {out_len} bytes, "
+                         f"got {len(out)}")
+    return bytes(out)
+
+
+def _lz_copy(out: bytearray, off: int, ln: int) -> None:
+    """LZ77 back-reference copy. Disjoint copies are one slice; self-
+    overlapping ones (RLE-style) extend in doubling chunks — both O(slices)
+    instead of a Python loop per byte."""
+    start = len(out) - off
+    if off >= ln:
+        out += out[start:start + ln]
+        return
+    remaining = ln
+    while remaining > 0:
+        chunk = out[start:start + min(remaining, len(out) - start)]
+        out += chunk
+        remaining -= len(chunk)
+
+
+def lz4_raw_decompress(data: bytes, out_len: int) -> bytes:
+    """LZ4 block format: token byte (literal len | match len nibbles),
+    optional length continuations, 2-byte little-endian match offset."""
+    out = bytearray()
+    pos = 0
+    n = len(data)
+    while pos < n:
+        token = data[pos]
+        pos += 1
+        lit = token >> 4
+        if lit == 15:
+            while True:
+                x = data[pos]
+                pos += 1
+                lit += x
+                if x != 255:
+                    break
+        out += data[pos:pos + lit]
+        pos += lit
+        if pos >= n:                        # last block ends with literals
+            break
+        off = int.from_bytes(data[pos:pos + 2], "little")
+        pos += 2
+        if off == 0 or off > len(out):
+            raise ValueError(f"lz4: match offset {off} outside the "
+                             f"{len(out)} bytes produced")
+        mlen = token & 0xF
+        if mlen == 15:
+            while True:
+                x = data[pos]
+                pos += 1
+                mlen += x
+                if x != 255:
+                    break
+        mlen += 4
+        _lz_copy(out, off, mlen)
+    if out_len >= 0 and len(out) != out_len:
+        raise ValueError(f"lz4: expected {out_len} bytes, got {len(out)}")
+    return bytes(out)
+
+
+def decompress(codec: int, data: bytes, out_len: int) -> bytes:
+    if codec == CODEC_UNCOMPRESSED:
+        return data
+    if codec == CODEC_SNAPPY:
+        return snappy_decompress(data)
+    if codec == CODEC_GZIP:
+        return zlib.decompress(data, wbits=zlib.MAX_WBITS | 32)
+    if codec == CODEC_LZ4_RAW:
+        return lz4_raw_decompress(data, out_len)
+    raise ValueError(
+        f"unsupported parquet codec {codec} "
+        "(UNCOMPRESSED/SNAPPY/GZIP/LZ4_RAW supported)")
 
 
 # --------------------------------------------------------------------------
@@ -261,75 +389,124 @@ def _plain_encode(phys: int, arr: np.ndarray) -> bytes:
 CONV_UTF8, CONV_DECIMAL, CONV_DATE = 0, 5, 6
 
 
+def _stats_encode(phys: int, present: np.ndarray) -> Optional[bytes]:
+    """Statistics struct (min_value/max_value, fields 6/5) for row-group
+    pruning; None when the column has no present values or no ordering
+    worth recording."""
+    if len(present) == 0:
+        return None
+    tw = ThriftWriter()
+    if phys in (T_INT32, T_INT64):
+        lo, hi = int(present.min()), int(present.max())
+        fmt = "<i" if phys == T_INT32 else "<q"
+        return tw.struct([(5, CT_BINARY, struct.pack(fmt, hi)),
+                          (6, CT_BINARY, struct.pack(fmt, lo))])
+    if phys == T_DOUBLE:
+        lo, hi = float(present.min()), float(present.max())
+        return tw.struct([(5, CT_BINARY, struct.pack("<d", hi)),
+                          (6, CT_BINARY, struct.pack("<d", lo))])
+    if phys == T_BYTE_ARRAY:
+        ss = [s if isinstance(s, str) else str(s) for s in present]
+        return tw.struct([(5, CT_BINARY, max(ss).encode()),
+                          (6, CT_BINARY, min(ss).encode())])
+    return None
+
+
 def write_parquet(path: str, names: List[str], arrays: List[np.ndarray],
                   valids: Optional[List[Optional[np.ndarray]]] = None,
-                  logicals: Optional[List[Optional[tuple]]] = None) \
-        -> None:
-    """Write flat columns to a single-row-group parquet file.
+                  logicals: Optional[List[Optional[tuple]]] = None,
+                  compression: str = "none",
+                  row_group_rows: Optional[int] = None) -> None:
+    """Write flat columns to a parquet file.
 
     Object/str arrays become BYTE_ARRAY (UTF8). A valids mask marks the
     column OPTIONAL with RLE/bit-packed definition levels. `logicals`
     annotates columns with converted types: ("decimal", precision, scale)
-    on INT64, ("date",) on INT32.
+    on INT64, ("date",) on INT32. `compression` is "none" or "gzip"
+    (the stdlib codec; reading additionally handles snappy/lz4_raw).
+    `row_group_rows` splits the data into multiple row groups, each
+    carrying min/max statistics for reader-side pruning.
     """
     n_rows = len(arrays[0]) if arrays else 0
     valids = valids if valids is not None else [None] * len(arrays)
     logicals = logicals if logicals is not None else [None] * len(arrays)
+    codec = {"none": CODEC_UNCOMPRESSED, "gzip": CODEC_GZIP}[compression]
     tw = ThriftWriter()
     body = bytearray(MAGIC)
 
-    col_metas: List[bytes] = []
-    for name, arr, valid in zip(names, arrays, valids):
-        arr = np.asarray(arr)
-        if arr.dtype.kind in ("U", "O", "S"):
-            phys = T_BYTE_ARRAY
-        else:
-            if arr.dtype not in _PHYS_FOR_DTYPE:
-                arr = arr.astype(np.int64)
-            phys = _PHYS_FOR_DTYPE[arr.dtype]
-        optional = valid is not None
-        offset = len(body)
+    group_rows = row_group_rows or max(1, n_rows)
+    row_group_blobs: List[bytes] = []
+    for g_start in range(0, max(1, n_rows), group_rows):
+        g_end = min(n_rows, g_start + group_rows)
+        g_n = g_end - g_start
+        col_metas: List[bytes] = []
+        for name, arr, valid in zip(names, arrays, valids):
+            arr = np.asarray(arr)[g_start:g_end]
+            if arr.dtype.kind in ("U", "O", "S"):
+                phys = T_BYTE_ARRAY
+            else:
+                if arr.dtype not in _PHYS_FOR_DTYPE:
+                    arr = arr.astype(np.int64)
+                phys = _PHYS_FOR_DTYPE[arr.dtype]
+            optional = valid is not None
+            offset = len(body)
 
-        if optional:
-            defs = rle_encode_bitpacked(
-                np.asarray(valid).astype(np.int64), 1)
-            def_block = struct.pack("<I", len(defs)) + defs
-            present = arr[np.asarray(valid)]
-        else:
-            def_block = b""
-            present = arr
-        payload = def_block + _plain_encode(phys, present)
+            if optional:
+                gvalid = np.asarray(valid)[g_start:g_end]
+                defs = rle_encode_bitpacked(gvalid.astype(np.int64), 1)
+                def_block = struct.pack("<I", len(defs)) + defs
+                present = arr[gvalid]
+            else:
+                def_block = b""
+                present = arr
+            payload = def_block + _plain_encode(phys, present)
+            if codec == CODEC_UNCOMPRESSED:
+                wire = payload
+            else:                          # gzip container for
+                import gzip as _gz         # cross-reader compatibility
+                wire = _gz.compress(payload, 6)
 
-        page_header = tw.struct([
-            (1, CT_I32, PAGE_DATA),
-            (2, CT_I32, len(payload)),
-            (3, CT_I32, len(payload)),
-            (5, CT_STRUCT, tw.struct([
-                (1, CT_I32, n_rows),
-                (2, CT_I32, ENC_PLAIN),
-                (3, CT_I32, ENC_RLE),
-                (4, CT_I32, ENC_RLE),
-            ])),
-        ])
-        body += page_header + payload
+            page_header = tw.struct([
+                (1, CT_I32, PAGE_DATA),
+                (2, CT_I32, len(payload)),
+                (3, CT_I32, len(wire)),
+                (5, CT_STRUCT, tw.struct([
+                    (1, CT_I32, g_n),
+                    (2, CT_I32, ENC_PLAIN),
+                    (3, CT_I32, ENC_RLE),
+                    (4, CT_I32, ENC_RLE),
+                ])),
+            ])
+            body += page_header + wire
 
-        col_meta = tw.struct([
-            (1, CT_I32, phys),
-            (2, CT_LIST, tw.list_of(CT_I32, [_enc_zigzag(ENC_PLAIN),
-                                             _enc_zigzag(ENC_RLE)])),
-            (3, CT_LIST, tw.list_of(CT_BINARY,
-                                    [_enc_uvarint(len(name.encode())) +
-                                     name.encode()])),
-            (4, CT_I32, CODEC_UNCOMPRESSED),
-            (5, CT_I64, n_rows),
-            (6, CT_I64, len(payload)),
-            (7, CT_I64, len(payload)),
-            (9, CT_I64, offset),
-        ])
-        col_metas.append(tw.struct([
-            (2, CT_I64, offset),
-            (3, CT_STRUCT, col_meta),
+            meta_fields = [
+                (1, CT_I32, phys),
+                (2, CT_LIST, tw.list_of(CT_I32, [_enc_zigzag(ENC_PLAIN),
+                                                 _enc_zigzag(ENC_RLE)])),
+                (3, CT_LIST, tw.list_of(
+                    CT_BINARY, [_enc_uvarint(len(name.encode())) +
+                                name.encode()])),
+                (4, CT_I32, codec),
+                (5, CT_I64, g_n),
+                (6, CT_I64, len(page_header) + len(payload)),
+                (7, CT_I64, len(page_header) + len(wire)),
+                (9, CT_I64, offset),
+            ]
+            stats = _stats_encode(phys, present)
+            if stats is not None:
+                meta_fields.append((12, CT_STRUCT, stats))
+            col_meta = tw.struct(meta_fields)
+            col_metas.append(tw.struct([
+                (2, CT_I64, offset),
+                (3, CT_STRUCT, col_meta),
+            ]))
+        row_group_blobs.append(tw.struct([
+            (1, CT_LIST, tw.list_of(CT_STRUCT, col_metas)),
+            (2, CT_I64, sum(len(c) for c in col_metas)),
+            (3, CT_I64, g_n),
         ]))
+        if n_rows == 0:
+            break
 
     # schema: root group + one element per column
     schema_elems = [tw.struct([
@@ -358,16 +535,11 @@ def write_parquet(path: str, names: List[str], arrays: List[np.ndarray],
             fields.append((6, CT_I32, CONV_DATE))
         schema_elems.append(tw.struct(fields))
 
-    row_group = tw.struct([
-        (1, CT_LIST, tw.list_of(CT_STRUCT, col_metas)),
-        (2, CT_I64, sum(len(c) for c in col_metas)),
-        (3, CT_I64, n_rows),
-    ])
     footer = tw.struct([
         (1, CT_I32, 1),
         (2, CT_LIST, tw.list_of(CT_STRUCT, schema_elems)),
         (3, CT_I64, n_rows),
-        (4, CT_LIST, tw.list_of(CT_STRUCT, [row_group])),
+        (4, CT_LIST, tw.list_of(CT_STRUCT, row_group_blobs)),
     ])
     body += footer
     body += struct.pack("<I", len(footer))
@@ -379,15 +551,6 @@ def write_parquet(path: str, names: List[str], arrays: List[np.ndarray],
 # --------------------------------------------------------------------------
 # reader
 # --------------------------------------------------------------------------
-
-class ParquetColumn:
-    def __init__(self, name: str, phys: int, optional: bool):
-        self.name = name
-        self.phys = phys
-        self.optional = optional
-        self.values: Optional[np.ndarray] = None
-        self.valid: Optional[np.ndarray] = None
-
 
 def _plain_decode(phys: int, data: bytes, count: int):
     if phys == T_INT64:
@@ -412,10 +575,108 @@ def _plain_decode(phys: int, data: bytes, count: int):
     raise ValueError(f"unsupported physical type {phys}")
 
 
-def read_parquet(path: str):
-    """Read a flat parquet file -> (names, columns, valids, logicals).
+class _Leaf:
+    """One physical column: its schema path, levels, and logical type."""
 
-    logicals[i] is None, ("decimal", precision, scale), or ("date",)."""
+    def __init__(self, name, phys, max_def, max_rep, logical, def_list):
+        self.name = name                 # outermost field name
+        self.phys = phys
+        self.max_def = max_def           # def level meaning present value
+        self.max_rep = max_rep           # 0 = flat, 1 = LIST element
+        self.logical = logical
+        self.def_list = def_list         # def level meaning empty list
+
+
+def _walk_schema(schema: list) -> List[_Leaf]:
+    """Flatten the SchemaElement preorder list into leaves with their
+    max definition/repetition levels (the standard parquet level
+    computation; nested depth >1 is rejected loudly)."""
+    leaves: List[_Leaf] = []
+    idx = 0
+
+    def walk(max_def, max_rep, top_name, list_def):
+        nonlocal idx
+        raw = schema[idx]
+        idx += 1
+        rep = raw.get(3, REP_REQUIRED)
+        name = raw[4].decode()
+        n_children = raw.get(5)
+        if rep == REP_OPTIONAL:
+            max_def += 1
+        elif rep == REP_REPEATED:
+            max_def += 1
+            max_rep += 1
+            list_def = max_def - 1       # def at this level-1 = empty
+        if top_name is None:
+            top_name = name
+        if n_children:                   # group node
+            for _ in range(n_children):
+                walk(max_def, max_rep, top_name, list_def)
+            return
+        phys = raw.get(1)
+        conv = raw.get(6)
+        logical = None
+        if conv == CONV_DECIMAL:
+            logical = ("decimal", raw.get(8, 18), raw.get(7, 0))
+        elif conv == CONV_DATE:
+            logical = ("date",)
+        if max_rep > 1:
+            raise ValueError(
+                f"column {top_name}: nesting depth {max_rep} > 1 "
+                "unsupported")
+        leaves.append(_Leaf(top_name, phys, max_def, max_rep, logical,
+                            list_def))
+
+    root = schema[idx]
+    idx += 1
+    for _ in range(root.get(5, 0)):
+        walk(0, 0, None, None)
+    return leaves
+
+
+def _stats_value(phys: int, raw: bytes):
+    if raw is None:
+        return None
+    if phys == T_INT32:
+        return struct.unpack("<i", raw)[0]
+    if phys == T_INT64:
+        return struct.unpack("<q", raw)[0]
+    if phys == T_DOUBLE:
+        return struct.unpack("<d", raw)[0]
+    if phys == T_BYTE_ARRAY:
+        return raw.decode("utf-8", "replace")
+    if phys == T_BOOLEAN:
+        return bool(raw[0])
+    return None
+
+
+class ParquetFile:
+    """Decoded file plus read-side bookkeeping (skipped row groups)."""
+
+    def __init__(self, names, columns, valids, logicals,
+                 skipped_row_groups, total_row_groups):
+        self.names = names
+        self.columns = columns
+        self.valids = valids
+        self.logicals = logicals
+        self.skipped_row_groups = skipped_row_groups
+        self.total_row_groups = total_row_groups
+
+
+def read_parquet(path: str, predicates: Optional[dict] = None):
+    """Read a parquet file -> (names, columns, valids, logicals).
+
+    logicals[i] is None, ("decimal", precision, scale), ("date",), or
+    ("list", element_logical). LIST columns decode to object arrays of
+    per-row tuples (None = NULL list). `predicates` maps column name ->
+    (lo, hi) inclusive bounds; row groups whose chunk statistics prove
+    no row can match are skipped wholesale."""
+    f = read_parquet_file(path, predicates)
+    return f.names, f.columns, f.valids, f.logicals
+
+
+def read_parquet_file(path: str, predicates: Optional[dict] = None) \
+        -> ParquetFile:
     with open(path, "rb") as f:
         blob = f.read()
     if blob[:4] != MAGIC or blob[-4:] != MAGIC:
@@ -423,78 +684,128 @@ def read_parquet(path: str):
     (footer_len,) = struct.unpack("<I", blob[-8:-4])
     footer = ThriftReader(blob, len(blob) - 8 - footer_len).read_struct()
 
-    schema = footer[2]
-    num_rows = footer[3]
-    elems = []
-    for raw in schema[1:]:                      # skip the root group
-        phys = raw.get(1)
-        rep = raw.get(3, REP_REQUIRED)
-        name = raw[4].decode()
-        conv = raw.get(6)
-        logical = None
-        if conv == CONV_DECIMAL:
-            logical = ("decimal", raw.get(8, 18), raw.get(7, 0))
-        elif conv == CONV_DATE:
-            logical = ("date",)
-        elems.append((name, phys, rep == REP_OPTIONAL, logical))
+    leaves = _walk_schema(footer[2])
+    row_groups = footer[4]
 
-    names: List[str] = []
+    per_group: List[Optional[list]] = []
+    skipped = 0
+    for rg in row_groups:
+        chunks = rg[1]
+        if predicates and _group_excluded(leaves, chunks, predicates):
+            skipped += 1
+            per_group.append(None)
+            continue
+        group_cols = []
+        for leaf, chunk in zip(leaves, chunks):
+            meta = chunk[3]
+            codec = meta.get(4, CODEC_UNCOMPRESSED)
+            n_values = meta[5]
+            offset = meta.get(9)
+            dict_offset = meta.get(11)
+            start = dict_offset if dict_offset is not None else offset
+            group_cols.append(_read_chunk(blob, start, leaf, codec,
+                                          n_values))
+        per_group.append(group_cols)
+
+    names = [lf.name for lf in leaves]
+    logicals = []
+    for lf in leaves:
+        logicals.append(("list", lf.logical) if lf.max_rep else
+                        lf.logical)
+    kept = [g for g in per_group if g is not None]
     columns: List[np.ndarray] = []
     valids: List[Optional[np.ndarray]] = []
-    logicals: List[Optional[tuple]] = []
-    row_groups = footer[4]
-    if len(row_groups) != 1:
-        raise ValueError("multi-row-group files not supported yet")
-    chunks = row_groups[0][1]
-    for (name, phys, optional, logical), chunk in zip(elems, chunks):
-        meta = chunk[3]
-        if meta.get(4, CODEC_UNCOMPRESSED) != CODEC_UNCOMPRESSED:
-            raise ValueError(
-                f"column {name}: only UNCOMPRESSED codec supported")
-        n_values = meta[5]
-        offset = meta.get(9)
-        dict_offset = meta.get(11)
-        start = dict_offset if dict_offset is not None else offset
-        vals, valid = _read_chunk(blob, start, phys, optional, n_values)
-        names.append(name)
-        columns.append(vals)
-        valids.append(valid)
-        logicals.append(logical)
-    assert all(len(c) == num_rows for c in columns)
-    return names, columns, valids, logicals
+    empty_dtype = {T_INT64: np.int64, T_INT32: np.int32,
+                   T_DOUBLE: np.float64, T_BOOLEAN: np.bool_}
+    for i, lf in enumerate(leaves):
+        if not kept:
+            # dtype must follow the PHYSICAL type even with every group
+            # pruned, or the connector's schema inference flips with the
+            # predicate
+            dt = object if lf.max_rep or lf.phys == T_BYTE_ARRAY else \
+                empty_dtype.get(lf.phys, np.int64)
+            columns.append(np.zeros(0, dtype=dt))
+            valids.append(np.zeros(0, dtype=np.bool_)
+                          if lf.max_def > 0 else None)
+            continue
+        vals = [g[i][0] for g in kept]
+        vds = [g[i][1] for g in kept]
+        columns.append(np.concatenate(vals) if len(vals) > 1 else vals[0])
+        if any(v is not None for v in vds):
+            vds = [v if v is not None else
+                   np.ones(len(d), dtype=np.bool_)
+                   for v, d in zip(vds, vals)]
+            valids.append(np.concatenate(vds) if len(vds) > 1 else vds[0])
+        else:
+            valids.append(None)
+    return ParquetFile(names, columns, valids, logicals, skipped,
+                       len(row_groups))
 
 
-def _read_chunk(blob: bytes, pos: int, phys: int, optional: bool,
+def _group_excluded(leaves, chunks, predicates) -> bool:
+    """True when some predicate column's [min,max] statistics prove the
+    row group empty under (lo, hi) inclusive bounds."""
+    for leaf, chunk in zip(leaves, chunks):
+        rng = predicates.get(leaf.name)
+        if rng is None or leaf.max_rep:
+            continue
+        stats = chunk[3].get(12)
+        if not isinstance(stats, dict):
+            continue
+        cmin = _stats_value(leaf.phys, stats.get(6, stats.get(2)))
+        cmax = _stats_value(leaf.phys, stats.get(5, stats.get(1)))
+        lo, hi = rng
+        if cmin is not None and hi is not None and cmin > hi:
+            return True
+        if cmax is not None and lo is not None and cmax < lo:
+            return True
+    return False
+
+
+def _read_chunk(blob: bytes, pos: int, leaf: _Leaf, codec: int,
                 n_values: int):
-    """Read pages at `pos` until n_values are decoded. Handles an
-    optional leading dictionary page (PLAIN_DICTIONARY data pages)."""
+    """Read pages at `pos` until n_values level entries are decoded.
+    Handles a leading dictionary page and compressed pages. Returns
+    (values, valid) at ROW granularity — repeated leaves assemble rows
+    from definition+repetition levels."""
+    phys = leaf.phys
     dictionary = None
-    values = np.empty(0, dtype=object)
     got = 0
-    out_parts = []
-    def_parts = []
+    out_parts, def_parts, rep_parts = [], [], []
+    max_def, max_rep = leaf.max_def, leaf.max_rep
     while got < n_values:
         tr = ThriftReader(blob, pos)
         header = tr.read_struct()
         page_type = header[1]
+        uncomp_size = header[2]
         size = header[3]
         data = blob[tr.pos:tr.pos + size]
         pos = tr.pos + size
         if page_type == PAGE_DICTIONARY:
             dph = header[7]
+            data = decompress(codec, data, uncomp_size)
             dictionary = _plain_decode(phys, data, dph[1])
             continue
+        if page_type != PAGE_DATA:
+            raise ValueError(f"unsupported page type {page_type} "
+                             "(data page v2 not supported)")
         dph = header[5]
         count = dph[1]
         encoding = dph[2]
-        body = data
-        valid = None
-        if optional:
+        body = decompress(codec, data, uncomp_size)
+        reps = None
+        if max_rep > 0:
+            (rl_len,) = struct.unpack_from("<I", body, 0)
+            bw = max(1, (max_rep).bit_length())
+            reps = rle_decode(body[4:4 + rl_len], bw, count)
+            body = body[4 + rl_len:]
+        defs = None
+        if max_def > 0:
             (dl_len,) = struct.unpack_from("<I", body, 0)
-            defs = rle_decode(body[4:4 + dl_len], 1, count)
-            valid = defs.astype(np.bool_)
+            bw = max(1, (max_def).bit_length())
+            defs = rle_decode(body[4:4 + dl_len], bw, count)
             body = body[4 + dl_len:]
-            n_present = int(valid.sum())
+            n_present = int((defs == max_def).sum())
         else:
             n_present = count
         if encoding in (ENC_PLAIN_DICTIONARY, ENC_RLE_DICTIONARY):
@@ -503,20 +814,59 @@ def _read_chunk(blob: bytes, pos: int, phys: int, optional: bool,
             present = dictionary[idx]
         else:
             present = _plain_decode(phys, body, n_present)
-        if optional:
-            full = np.zeros(count, dtype=present.dtype)
-            if present.dtype == object:
-                full = np.full(count, "", dtype=object)
-            full[valid] = present
-            out_parts.append(full)
-            def_parts.append(valid)
-        else:
-            out_parts.append(present)
+        out_parts.append(present)
+        if defs is not None:
+            def_parts.append(defs)
+        if reps is not None:
+            rep_parts.append(reps)
         got += count
-    vals = np.concatenate(out_parts) if len(out_parts) > 1 else \
+
+    present = np.concatenate(out_parts) if len(out_parts) > 1 else \
         out_parts[0]
-    valid_arr = None
-    if optional:
-        valid_arr = np.concatenate(def_parts) if len(def_parts) > 1 else \
-            def_parts[0]
-    return vals, valid_arr
+    defs = (np.concatenate(def_parts) if len(def_parts) > 1 else
+            def_parts[0]) if def_parts else None
+    if max_rep == 0:
+        if defs is None:
+            return present, None
+        valid = defs == max_def
+        full = np.zeros(len(defs), dtype=present.dtype)
+        if present.dtype == object:
+            full = np.full(len(defs), "", dtype=object)
+        full[valid] = present
+        return full, valid
+    # LIST assembly: rep==0 starts a row; def semantics per level
+    reps = (np.concatenate(rep_parts) if len(rep_parts) > 1 else
+            rep_parts[0])
+    rows: List[Optional[tuple]] = []
+    valid_rows: List[bool] = []
+    cur: Optional[list] = None
+    vi = 0
+    for d, r in zip(defs.tolist(), reps.tolist()):
+        if r == 0:
+            if cur is not None:
+                rows.append(tuple(cur))
+            if d < leaf.def_list:
+                # NULL list (def strictly below the list group's own
+                # level; a REQUIRED list group has def_list == 0, where
+                # d == 0 means EMPTY, never NULL)
+                rows.append(None)
+                valid_rows.append(False)
+                cur = None
+                if d == max_def:          # cannot happen, defensive
+                    vi += 1
+                continue
+            valid_rows.append(True)
+            cur = []
+            if d == leaf.def_list:        # empty list
+                continue
+        if d == max_def:
+            cur.append(present[vi])
+            vi += 1
+        elif d == max_def - 1 and max_def > leaf.def_list:
+            cur.append(None)              # NULL element
+    if cur is not None:
+        rows.append(tuple(cur))
+    vals = np.empty(len(rows), dtype=object)
+    for i, rowv in enumerate(rows):
+        vals[i] = rowv
+    return vals, np.asarray(valid_rows, dtype=np.bool_)
