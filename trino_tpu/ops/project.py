@@ -494,6 +494,26 @@ def eval_scalar_func(expr: ir.ScalarFunc, batch: Batch):
         x = d.astype(jnp.float64)
         return jnp.log(jnp.where(x > 0, x, jnp.float64(1))), v & (x > 0)
 
+    # ---- two-limb decimal accumulation (sum over DECIMAL) ------------
+    # The reference accumulates wide sums in Int128State
+    # (spi/type/Int128.java); here the planner splits each unscaled
+    # value into (hi = x >> 32, lo = x & 0xffffffff) so two ordinary
+    # int64 segment sums carry the state exactly (lo is canonical
+    # non-negative; sums of up to 2^31 rows cannot wrap), and the
+    # post-agg combine hi*2^32 + lo is exact while |total| < 2^63.
+    if name == "$limb_hi":
+        x = d.astype(jnp.int64)
+        return jax.lax.shift_right_arithmetic(x, 32), v
+    if name == "$limb_lo":
+        x = d.astype(jnp.int64)
+        return jnp.bitwise_and(x, jnp.int64(0xFFFFFFFF)), v
+    if name == "$limb_combine":
+        # raw unscaled combine (NULL when either limb sum is NULL —
+        # both are NULL together for empty/all-NULL groups)
+        (lod, lov) = parts[1]
+        hi = d.astype(jnp.int64)
+        return (hi << 32) + lod.astype(jnp.int64), v & lov
+
     # ---- HyperLogLog building blocks (approx_distinct) ---------------
     # The reference keeps an HLL sketch object per group
     # (operator/aggregation/ApproximateCountDistinctAggregation.java +

@@ -1793,6 +1793,7 @@ class Planner:
         # sort-distinct lowering (the rewrite would have to replicate
         # per grouping set).
         hll_calls: List[tuple] = []
+        dsum_types: Dict[A.FunctionCall, DataType] = {}
         # a DISTINCT sum/count shares the sort kernel's dedup column; the
         # HLL rewrite can't carry it through the (keys, bucket) inner
         # grouping, so approx_distinct degrades to exact sort-distinct
@@ -1849,6 +1850,25 @@ class Planner:
                 call_slots[call] = ("plain", len(agg_specs) - 1, -1)
             elif call.name == "sum":
                 out_t = sum_type(t)
+                if t.kind is TypeKind.DECIMAL and not distinct and \
+                        not q.grouping_sets:
+                    # two-limb accumulation (see ops/project.py
+                    # $limb_hi): the states are plain int64 sums, so
+                    # chunked/distributed merging needs no new machinery
+                    hi_slot = add_arg(ir.ScalarFunc(
+                        "$limb_hi", (arg,), BIGINT))
+                    lo_slot = add_arg(ir.ScalarFunc(
+                        "$limb_lo", (arg,), BIGINT))
+                    agg_specs.append(L.AggSpecNode(
+                        "sum", ir.ColumnRef(hi_slot, BIGINT), "$dshi",
+                        BIGINT))
+                    agg_specs.append(L.AggSpecNode(
+                        "sum", ir.ColumnRef(lo_slot, BIGINT), "$dslo",
+                        BIGINT))
+                    call_slots[call] = ("dsum", len(agg_specs) - 2,
+                                        len(agg_specs) - 1)
+                    dsum_types[call] = out_t
+                    continue
                 agg_specs.append(L.AggSpecNode("sum", ir.ColumnRef(slot, t),
                                                "sum", out_t, distinct))
                 call_slots[call] = ("plain", len(agg_specs) - 1, -1)
@@ -1982,6 +2002,15 @@ class Planner:
                             (ir.ColumnRef(n_keys + s1, BIGINT),
                              ir.ColumnRef(n_keys + s2, _D)),
                             BIGINT, (1 << HLL_P,))
+                    if kind == "dsum":
+                        # two-limb decimal sum combine: hi*2^32 + lo on
+                        # RAW unscaled ints (Arith's decimal coercions
+                        # must not rescale limbs), exact while
+                        # |total| < 2^63 (Int128State's role)
+                        hi = ir.ColumnRef(n_keys + s1, BIGINT)
+                        lo = ir.ColumnRef(n_keys + s2, BIGINT)
+                        return ir.ScalarFunc(
+                            "$limb_combine", (hi, lo), dsum_types[node])
                     if kind == "bool":
                         return ir.Compare(
                             "=", ir.ColumnRef(n_keys + s1, BIGINT),
@@ -2744,7 +2773,9 @@ def default_name(expr: A.Node) -> str:
 def sum_type(t: DataType) -> DataType:
     if t.kind is TypeKind.DECIMAL:
         from ..types import decimal as mk
-        return mk(18, t.scale)     # widest short decimal (int64 accumulator)
+        # the reference's sum(decimal(p,s)) -> decimal(38,s)
+        # (DecimalAggregation); device accumulation is two int64 limbs
+        return mk(38, t.scale)
     if t.kind is TypeKind.DOUBLE:
         return DOUBLE
     return BIGINT

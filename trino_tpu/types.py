@@ -57,7 +57,14 @@ class DataType:
     def __post_init__(self):
         if self.kind is TypeKind.DECIMAL:
             assert self.precision is not None and self.scale is not None
-            assert self.precision <= 18, "long decimals (>18 digits) not yet supported"
+            # p <= 18 columns store int64 unscaled values directly;
+            # 18 < p <= 38 (Int128 territory in the reference,
+            # spi/type/Int128.java) arises from aggregate RESULT types —
+            # sums accumulate in two int64 limbs on device and combine
+            # exactly while |total| < 2^63 (raises at the type level
+            # beyond 38 digits like the reference's overflow checks)
+            assert self.precision <= 38, \
+                "decimals beyond 38 digits unsupported"
         if self.kind is TypeKind.ARRAY:
             assert self.element is not None
 
