@@ -18,8 +18,10 @@ from trino_tpu.exec.session import Session
 
 
 def _mem_session():
+    from trino_tpu.connectors.tpch.connector import TpchConnector
     cat = Catalog()
     cat.register("m", MemoryConnector())
+    cat.register("tpch", TpchConnector())
     return Session(catalog=cat, default_cat="m", default_schema="s")
 
 
@@ -37,17 +39,19 @@ def test_sum_beyond_double_mantissa_is_exact():
     path must keep them exact."""
     s = _mem_session()
     s.execute("CREATE TABLE m.s.t (v decimal(18,2))")
-    # 3M rows of 40_000_000_000.01 -> total 1.2e17 + 30k cents; the
-    # unscaled total 1.2e19... keep below 2^63: use 1M rows of 9e12
-    big = Decimal("9000000000000.01")
+    # 1M rows of 9_000_000_000.01 -> unscaled total 9.0e17: past the
+    # float64 mantissa (2^53 ~ 9.0e15) yet inside the two-limb
+    # exactness ceiling (2^63 ~ 9.2e18)
+    big = Decimal("9000000000.01")
     n = 1_000_000
-    s.execute(f"INSERT INTO m.s.t SELECT CAST(9000000000000.01 AS "
+    s.execute(f"INSERT INTO m.s.t SELECT CAST({big} AS "
               f"decimal(18,2)) FROM tpch.sf1.orders LIMIT {n}")
     got = s.execute("SELECT sum(v), count(*) FROM m.s.t").rows[0]
     assert got[1] == n
     assert got[0] == big * n              # exact to the cent
-    # float64 would already be off here
-    assert float(got[0]) != got[0] or True
+    # a float64 accumulator over the unscaled cents could not hold this
+    unscaled_total = int(big.scaleb(2)) * n
+    assert int(float(unscaled_total)) != unscaled_total
 
 
 def test_grouped_and_chunked_sums_match():
