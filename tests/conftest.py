@@ -16,6 +16,11 @@ import sys
 # alone are too late — use the runtime config API (backends are still
 # uninitialized at conftest time, so this takes effect)
 os.environ["JAX_PLATFORMS"] = "cpu"
+# test assertions on executor stats (capacity retries, sync counts) assume
+# a cold decision state; the on-disk decision cache would let a previous
+# pytest session's runs leak in. Tests that exercise persistence opt back
+# in with a tmp TRINO_TPU_DATA_CACHE.
+os.environ.setdefault("TRINO_TPU_DECISION_CACHE", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

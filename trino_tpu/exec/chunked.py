@@ -223,10 +223,11 @@ def _fused_luts(executor, joins) -> Optional[tuple]:
     """Build + validate the dense LUT for every fused join, choosing
     value-packed LUTs whenever the payload fits one word (probe = ONE
     gather) and falling back to row-id LUTs otherwise. LUT+spec pairs
-    reuse the cross-run cache for deterministic builds. Payload min/max
-    stats fuse into one device fetch, and ALL dup/oob validations fuse
-    into a second; any violation aborts the fused path (the per-node
-    loop has the graceful fallbacks)."""
+    reuse the cross-run cache for deterministic builds; their stats and
+    dup/oob validations ride the persistent decision cache (sync-free on
+    replay). Uncacheable builds fuse all stats into one device fetch and
+    all validations into a second. Any violation aborts the fused path
+    (the per-node loop has the graceful fallbacks)."""
     from ..ops.join import dense_build_lut, dense_build_packed_lut
     n = len(joins)
     builds = [executor.run(j.right) for j in joins]
@@ -243,13 +244,21 @@ def _fused_luts(executor, joins) -> Optional[tuple]:
         else:
             fresh.append(k)
     if fresh:
-        # one fused fetch: min/max of every integer payload column of
-        # every fresh build (packing decisions are host-side statics)
-        parts = []
+        # min/max of integer payload columns (packing layouts are
+        # host-side statics). Cacheable builds (deterministic catalogs)
+        # fetch per build through the cross-run decision cache — the tag
+        # carries right_keys/kind/domain because the SAME build subtree
+        # joined on a different key has different stats layout and
+        # validation semantics; the structure hash alone covers only
+        # j.right. A FRESH process then replays with zero device syncs.
+        # Uncacheable builds keep the old behavior: ALL their stats fuse
+        # into one fetch and all their validations into a second.
         big = 1 << 62
-        for k in fresh:
+
+        def minmax_parts(k):
             b, j = builds[k], joins[k]
             bkey = j.right_keys[0]
+            parts = []
             for i in range(len(b.columns)):
                 if i == bkey:
                     continue
@@ -262,16 +271,11 @@ def _fused_luts(executor, joins) -> Optional[tuple]:
                 else:
                     parts.append(jnp.full((), big, jnp.int64))
                     parts.append(jnp.full((), -big, jnp.int64))
-        vals = np.asarray(jnp.stack(parts)) if parts else \
-            np.zeros(0, np.int64)
-        pos = 0
-        checks = []
-        for k in fresh:
+            return parts
+
+        def build_one(k, mins, maxs):
+            """Build LUT k; returns (dup_signal, oob) device scalars."""
             b, j = builds[k], joins[k]
-            npay = len(b.columns) - 1
-            mins = vals[pos:pos + 2 * npay:2]
-            maxs = vals[pos + 1:pos + 2 * npay:2]
-            pos += 2 * npay
             if j.kind in ("semi", "anti"):
                 pk = ((), "int8")         # presence bit only
             else:
@@ -282,17 +286,44 @@ def _fused_luts(executor, joins) -> Optional[tuple]:
                     b, j.right_keys, j.build_key_domain, meta, wd)
                 specs[k] = ("packed", meta, wd, j.right_keys[0],
                             tuple(str(c.data.dtype) for c in b.columns))
-                checks.append(exp - occ)      # >0 = duplicate keys
-                checks.append(oob)
+                dup_sig = exp - occ           # >0 = duplicate keys
             else:
                 lut, dup, oob = dense_build_lut(b, j.right_keys,
                                                 j.build_key_domain)
                 specs[k] = ("rows",)
-                checks.append(dup.astype(jnp.int64))
-                checks.append(oob)
+                dup_sig = dup.astype(jnp.int64)
             luts[k] = lut
-        if int(np.asarray(jnp.stack(checks)).sum()) != 0:
-            return None
+            return dup_sig, oob
+
+        def join_tag(base, j):
+            return (f"{base}:{tuple(j.right_keys)}:{j.kind}:"
+                    f"{j.build_key_domain}")
+
+        cacheable = [k for k in fresh if keys[k] is not None]
+        fused_rest = [k for k in fresh if keys[k] is None]
+        for k in cacheable:
+            j = joins[k]
+            parts = minmax_parts(k)
+            vals = np.asarray(executor.fetch_ints(
+                j.right, join_tag("fusedminmax", j), *parts),
+                dtype=np.int64) if parts else np.zeros(0, np.int64)
+            dup_sig, oob = build_one(k, vals[0::2], vals[1::2])
+            check = executor.fetch_ints(
+                j.right, join_tag("fusedlutcheck", j), dup_sig, oob)
+            if check[0] != 0 or check[1] != 0:
+                return None
+        if fused_rest:
+            all_parts = [minmax_parts(k) for k in fused_rest]
+            flat = [p for ps in all_parts for p in ps]
+            vals = np.asarray(jnp.stack(flat)) if flat else \
+                np.zeros(0, np.int64)
+            pos, checks = 0, []
+            for k, ps in zip(fused_rest, all_parts):
+                vk = vals[pos:pos + len(ps)]
+                pos += len(ps)
+                checks.extend(build_one(k, vk[0::2], vk[1::2]))
+            if int(np.asarray(jnp.stack(checks)).sum()) != 0:
+                return None
         for k in fresh:
             if keys[k] is not None:
                 if len(executor._lut_cache) >= 4:
@@ -484,10 +515,12 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
                 out = fused[0](chunk, fused[1], fused[2])
             else:
                 executor._subst[id(plan.driver)] = chunk
+                executor._subst_opaque.add(id(plan.driver))
                 try:
                     out = executor.run(per_chunk_target)
                 finally:
                     executor._subst.pop(id(plan.driver), None)
+                    executor._subst_opaque.discard(id(plan.driver))
                     # the per-chunk path recomputes these nodes next
                     # iteration; release their reservations now so the
                     # pool reflects only pinned builds + partials
@@ -512,18 +545,24 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
         vals = [np.concatenate([c[j] for c in concat_valids])
                 for j in range(ncols)]
         merged = batch_from_numpy(arrs, valids=vals)
+        # structure-faithful: the concat of all chunks IS root.child's
+        # deterministic value, so decisions above it stay cacheable
         executor._subst[id(root.child)] = merged
         try:
             return executor.run(root)
         finally:
             executor._subst.clear()
+            executor._subst_opaque.clear()
 
     merged = merge_partials(executor, plan.merge_agg, partials)
+    # structure-faithful (see concat mode above): decisions above the
+    # merge point replay from the cross-run cache
     executor._subst[id(plan.merge_agg)] = merged
     try:
         return executor.run(root)
     finally:
         executor._subst.clear()
+        executor._subst_opaque.clear()
 
 
 # --------------------------------------------------------------------------

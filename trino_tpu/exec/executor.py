@@ -67,6 +67,12 @@ def _subtree_scans(node: "L.PlanNode"):
         yield from _subtree_scans(c)
 
 
+def _subtree_nodes(node: "L.PlanNode"):
+    yield node
+    for c in L.children(node):
+        yield from _subtree_nodes(c)
+
+
 class Executor:
     def __init__(self, catalog: Catalog):
         from collections import OrderedDict
@@ -82,6 +88,12 @@ class Executor:
         # chunked-mode substitutions: id(plan node) -> precomputed Batch
         # (streamed scan chunk, pinned build side, or merged partials)
         self._subst: Dict[int, Batch] = {}
+        # ids of substitutions whose batch is NOT derivable from the
+        # node's structure key (worker split chunks, streamed driver
+        # chunks, merged partials). Pinned deterministic builds are
+        # structure-faithful and do NOT register here, so decision
+        # caching stays live through the chunked build phase.
+        self._subst_opaque: set = set()
         # bounded-memory aggregation: process scan chains in chunks of this
         # many rows (the spill-to-host analog; None = off)
         self.spill_chunk_rows: Optional[int] = None
@@ -126,6 +138,15 @@ class Executor:
         # async dispatch chain with a single final result fetch — each
         # avoided sync is a ~100-260 ms tunnel round trip here.
         self._decision_cache: Dict[tuple, tuple] = {}
+        # the decision cache persists to disk (keys are sha256 wire-form
+        # hashes — stable across processes), so a FRESH process replays a
+        # previous run's decisions: identical capacities/layouts mean the
+        # persistent XLA code cache hits too, collapsing cold-start to
+        # ingest + cached-program load. The reference's analog is the
+        # long-lived JVM keeping ExpressionCompiler output warm
+        # (sql/gen/ExpressionCompiler.java:38).
+        self._decision_dirty = False
+        self._decision_loaded = False
         # per-execution memo of build_structure_key: id(node) -> (node,
         # key). The node reference keeps temporaries alive so CPython
         # cannot reuse their id within one execution; cleared at query
@@ -154,13 +175,17 @@ class Executor:
             self.pool.free(b)
         self._node_bytes.clear()
         self._subst.clear()
+        self._subst_opaque.clear()
         self._skey_memo.clear()
-        if self.spill_chunk_rows:
-            from .chunked import execute_chunked
-            out = execute_chunked(self, root)
-            if out is not None:
-                return out
-        return self.run(root.child)
+        try:
+            if self.spill_chunk_rows:
+                from .chunked import execute_chunked
+                out = execute_chunked(self, root)
+                if out is not None:
+                    return out
+            return self.run(root.child)
+        finally:
+            self.save_decisions()
 
     # TRINO_TPU_TRACE_NODES=1 prints per-node dispatch timings to stderr
     # (async dispatch time; sync waits inside a node attribute to it) —
@@ -231,21 +256,92 @@ class Executor:
                 self.enable_mxu_agg, bool(self.stream_build_bytes),
                 self.spill_chunk_rows)
 
+    _DECISION_CACHE_FILE = "decisions.pkl"
+
+    def _decision_path(self) -> Optional[str]:
+        if os.environ.get("TRINO_TPU_DECISION_CACHE") == "0":
+            return None
+        from ..connectors.diskcache import cache_root
+        return os.path.join(cache_root(), self._DECISION_CACHE_FILE)
+
+    def _load_decisions(self) -> None:
+        """Merge the on-disk decision cache in (once per executor).
+        Entries exist only for immutable generator catalogs, so merging
+        stale files is safe; corruption just means a cold start."""
+        self._decision_loaded = True
+        path = self._decision_path()
+        if path is None or not os.path.isfile(path):
+            return
+        import pickle
+        try:
+            with open(path, "rb") as f:
+                disk = pickle.load(f)
+            for k, v in disk.items():
+                self._decision_cache.setdefault(k, v)
+        except Exception:
+            pass
+
+    # on-disk entry cap: this session's entries always survive; older
+    # disk entries backfill up to the cap so the file can't grow without
+    # bound across workloads (entries are ~150 B each)
+    _DECISION_FILE_MAX = 65536
+
+    def save_decisions(self) -> None:
+        """Persist new decision values (atomic tmp+rename; merge with
+        any concurrent writer's file first). The dirty flag clears only
+        after a successful write so transient disk failures retry."""
+        if not self._decision_dirty:
+            return
+        path = self._decision_path()
+        if path is None:
+            self._decision_dirty = False
+            return
+        import pickle
+        try:
+            merged = dict(self._decision_cache)
+            if os.path.isfile(path):
+                with open(path, "rb") as f:
+                    for k, v in pickle.load(f).items():
+                        if len(merged) >= self._DECISION_FILE_MAX:
+                            break
+                        merged.setdefault(k, v)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "wb") as f:
+                pickle.dump(merged, f)
+            os.replace(tmp, path)
+            self._decision_dirty = False
+        except Exception:
+            pass
+
+    def decisions_cacheable(self, node) -> bool:
+        """May `node`'s runtime decision values go through the cross-run
+        decision cache? Chunk mode bypasses (the driver chunk differs per
+        iteration); an OPAQUE substitution anywhere in the subtree
+        bypasses (per-split worker data, streamed chunks, merge batches
+        carry data the structure key doesn't describe — split 2 of a
+        worker task must not reuse split 1's counts). Structure-faithful
+        substitutions (pinned deterministic builds) do NOT bypass."""
+        if self.chunk_mode:
+            return False
+        if not self._subst_opaque:
+            return True
+        return not any(id(n) in self._subst_opaque
+                       for n in _subtree_nodes(node))
+
     def fetch_ints(self, node, tag: str, *vals) -> tuple:
         """Fetch small device integers (validation flags, row counts,
         min/max stats) as host ints — through the cross-run decision
         cache when `node`'s subtree is deterministic. On a hit the
         blocking device round trip is skipped entirely; the device-side
         computation of `vals` was async-dispatched and is dead code XLA
-        never waits on. Chunk mode and ANY active substitution bypass
-        the cache: a substituted node (per-split worker data, pinned
-        builds, merge batches) carries data its structure key doesn't
-        describe, so split 2 of a worker task must not reuse split 1's
-        counts."""
+        never waits on."""
         key = None
-        if node is not None and not self.chunk_mode and not self._subst:
+        if node is not None and self.decisions_cacheable(node):
             skey = self.memo_structure_key(node)
             if skey is not None:
+                if not self._decision_loaded:
+                    self._load_decisions()
                 key = (tag, skey, self._decision_salt())
                 hit = self._decision_cache.get(key)
                 if hit is not None:
@@ -256,6 +352,7 @@ class Executor:
             if len(self._decision_cache) >= 4096:
                 self._decision_cache.clear()
             self._decision_cache[key] = out
+            self._decision_dirty = True
         return out
 
     def memo_structure_key(self, node: L.PlanNode) -> Optional[str]:
@@ -574,8 +671,10 @@ class Executor:
         # measured the true group count, later runs size the output
         # tightly from the decision cache (one recompile, then every
         # re-execution gathers at the real G instead of the estimate)
-        if not self.chunk_mode and not self._subst:
+        if self.decisions_cacheable(node):
             skey = self.memo_structure_key(node)
+            if skey is not None and not self._decision_loaded:
+                self._load_decisions()
             known = self._decision_cache.get(
                 ("aggfinal", skey, self._decision_salt())) \
                 if skey is not None else None
@@ -607,11 +706,12 @@ class Executor:
                 break
             capacity *= 4
             self.stats.agg_capacity_retries += 1
-        if not self.chunk_mode and not self._subst:
+        if self.decisions_cacheable(node):
             skey = self.memo_structure_key(node)
             if skey is not None:
                 self._decision_cache[
                     ("aggfinal", skey, self._decision_salt())] = (n_groups,)
+                self._decision_dirty = True
         if n_groups == 0 and not node.group_keys:
             # zero-key sort aggregation (global DISTINCT) over an empty
             # input: SQL still requires one output row (0 counts / NULL
@@ -1118,7 +1218,7 @@ class Executor:
         # the sync on re-execution (deterministic subtree); one-shot
         # mutable-catalog queries keep the old 64K threshold — for them
         # the probe costs a round trip and the fetch moves little data
-        probe_floor = (1 << 13) if not self._subst and \
+        probe_floor = (1 << 13) if self.decisions_cacheable(root) and \
             self.memo_structure_key(root) is not None else (1 << 16)
         if batch.columns and batch.capacity >= probe_floor:
             live = self.fetch_ints(root, "resultlive",
@@ -1127,6 +1227,9 @@ class Executor:
             if new_cap * 2 <= batch.capacity:
                 batch = compact_batch(batch, new_cap)
         arrays, valids = batch_to_numpy(batch)
+        # decisions taken during result materialization (resultlive)
+        # happen after execute()'s save — persist them too
+        self.save_decisions()
         return list(root.names), arrays, valids
 
 

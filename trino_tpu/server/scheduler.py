@@ -273,6 +273,7 @@ class StageScheduler:
         from ..batch import batch_from_numpy
         ex = self.session.executor
         saved = dict(ex._subst)
+        saved_opaque = set(ex._subst_opaque)
         try:
             if analysis.merge_agg is not None:
                 partials = []
@@ -284,6 +285,7 @@ class StageScheduler:
                 merged = merge_partials(ex, analysis.merge_agg, partials) \
                     if partials else self._empty_like(analysis.merge_agg)
                 ex._subst[id(analysis.merge_agg)] = merged
+                ex._subst_opaque.add(id(analysis.merge_agg))
             else:
                 cols = None
                 for p in pages:
@@ -303,10 +305,13 @@ class StageScheduler:
                     vals = [np.zeros(0, dtype=np.bool_) for _ in arrs]
                 ex._subst[id(root.child)] = batch_from_numpy(
                     arrs, valids=vals)
+                ex._subst_opaque.add(id(root.child))
             return ex.run(root.child)
         finally:
             ex._subst.clear()
             ex._subst.update(saved)
+            ex._subst_opaque.clear()
+            ex._subst_opaque.update(saved_opaque)
 
     # -- source stage ------------------------------------------------------
 

@@ -197,6 +197,7 @@ class TaskManager:
             with self._exec_lock:
                 ex = self._executor
                 ex._subst.clear()
+                ex._subst_opaque.clear()
                 try:
                     # pin maximal driver-free subtrees ONCE per task (join
                     # build sides, HashBuilderOperator's build-once-probe-
@@ -221,10 +222,12 @@ class TaskManager:
                         chunk = batch_from_numpy(arrays, valids=valids,
                                                  capacity=cap)
                         ex._subst[id(driver_scan)] = chunk
+                        ex._subst_opaque.add(id(driver_scan))
                         try:
                             out = ex.run(root)
                         finally:
                             ex._subst.pop(id(driver_scan), None)
+                            ex._subst_opaque.discard(id(driver_scan))
                             # per-split outputs die here; pinned builds
                             # keep their reservations until task end
                             ex.release_path_reservations(
@@ -236,6 +239,7 @@ class TaskManager:
                             task.splits_done += 1
                 finally:
                     ex._subst.clear()
+                    ex._subst_opaque.clear()
                     for b in ex._node_bytes.values():
                         ex.pool.free(b)
                     ex._node_bytes.clear()
