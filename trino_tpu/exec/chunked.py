@@ -403,6 +403,22 @@ def _all_nodes(node):
         yield from _all_nodes(c)
 
 
+import os as _os
+
+# TRINO_TPU_CHUNK_PROFILE=1: per-phase walls to stderr, with a blocking
+# sync per chunk so device time attributes to its dispatch (diagnostic
+# only — the sync costs a tunnel RTT per chunk on this rig)
+_CHUNK_PROFILE = bool(_os.environ.get("TRINO_TPU_CHUNK_PROFILE"))
+
+
+def _prof(msg):
+    if _CHUNK_PROFILE:
+        import sys
+        import time
+        print(f"[chunk {time.monotonic():.3f}] {msg}", file=sys.stderr,
+              flush=True)
+
+
 def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
     """Run `root` with the driver scan streamed in chunks. Returns None if
     the plan shape doesn't support chunking (caller falls back)."""
@@ -416,9 +432,11 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
     # Builds of DETERMINISTIC sources additionally persist across runs in
     # a structural-hash cache (the scan cache's policy extended to build
     # subtrees): a repeated chunked query skips minutes of build joins.
+    _prof("pin builds: start")
     for b in plan.build_roots:
         if id(b) not in executor._subst:
             executor._subst[id(b)] = executor.run_cached_build(b)
+    _prof("pin builds: done")
 
     data = executor.catalog.get_table(plan.driver.catalog,
                                       plan.driver.schema_name,
@@ -490,6 +508,8 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
             if jitted is not None:
                 fused = (jitted, builds, luts)
                 executor.stats.fused_chunk_pipelines += 1
+    _prof(f"luts+fused ready (fused={fused is not None}, "
+          f"fact={fact is not None})")
 
     executor.enter_chunk_mode()
     try:
@@ -513,6 +533,9 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
                                          capacity=cap)
             if fused is not None:
                 out = fused[0](chunk, fused[1], fused[2])
+                if _CHUNK_PROFILE:
+                    jax.block_until_ready(out)
+                    _prof(f"chunk@{start} done")
             else:
                 executor._subst[id(plan.driver)] = chunk
                 executor._subst_opaque.add(id(plan.driver))
@@ -554,6 +577,7 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
             executor._subst.clear()
             executor._subst_opaque.clear()
 
+    _prof("chunk loop dispatched; merging")
     merged = merge_partials(executor, plan.merge_agg, partials)
     # structure-faithful (see concat mode above): decisions above the
     # merge point replay from the cross-run cache

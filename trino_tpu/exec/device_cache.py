@@ -46,6 +46,29 @@ class NarrowColumn:
 
 _INT_STEPS = (np.int8, np.int16, np.int32, np.int64)
 
+_tunnel_warmed = False
+
+
+def warm_transfer_path() -> None:
+    """One small INCOMPRESSIBLE transfer before the first bulk ingest.
+
+    Measured on the tunneled TPU rig: the first sizeable host->device
+    transfer of a process crawls at ~25 MB/s while every later one runs
+    at ~1.3 GB/s — a transport slow-start. A 4 MB random warmup (~0.25 s)
+    opens the fast path, turning a 7.8 GB fact ingest from ~270-435 s
+    into ~6 s. No-op on non-tunneled backends (costs one cheap copy)."""
+    global _tunnel_warmed
+    if _tunnel_warmed:
+        return
+    _tunnel_warmed = True
+    try:
+        import jax
+        x = np.random.default_rng(0).integers(
+            0, 1 << 30, size=1_000_000, dtype=np.int32)
+        jax.block_until_ready(jax.device_put(x))
+    except Exception:     # noqa: BLE001 — warmup must never break a query
+        pass
+
 
 def _narrow_dtype(arr: np.ndarray, valid: Optional[np.ndarray]):
     """Smallest signed integer dtype holding the column's valid values."""
@@ -122,6 +145,7 @@ class FactTableCache:
         hit = self.get(key)
         if hit is not None:
             return hit
+        warm_transfer_path()
         cols: List[NarrowColumn] = []
         total = 0
         for i in column_indices:

@@ -599,6 +599,9 @@ class Executor:
         valids = None
         if data.valids is not None:
             valids = [data.valids[i] for i in node.column_indices]
+        if sum(getattr(a, "nbytes", 0) for a in arrays) > (64 << 20):
+            from .device_cache import warm_transfer_path
+            warm_transfer_path()
         batch = batch_from_numpy(arrays, valids=valids)
         self.stats.scans += 1
         self.stats.rows_scanned += data.num_rows

@@ -30,6 +30,11 @@ from .statemachine import QueryStateMachine, QueryTracker, TrackedQuery
 PAGE_ROWS = 1000          # rows per protocol page (target-result-size analog)
 
 
+class QueryDeclinedError(RuntimeError):
+    """Deterministic user-configuration decline (require_distributed on a
+    shape the cluster can't take) — never retried."""
+
+
 def _is_retryable(e: Exception) -> bool:
     """User errors (bad SQL, missing columns) never retry; runtime/injected
     failures do — the reference draws the same line via error categories
@@ -37,7 +42,7 @@ def _is_retryable(e: Exception) -> bool:
     from ..planner.analyzer import AnalysisError
     from ..sql.tokenizer import SqlSyntaxError
     return not isinstance(e, (AnalysisError, SqlSyntaxError,
-                              AssertionError))
+                              AssertionError, QueryDeclinedError))
 
 
 class RegisteredNode:
@@ -147,6 +152,12 @@ class Dispatcher:
                             result = None   # degrade to local execution
                             tq.fallback_reason = f"task failure: {te}"
                         tq.distributed = result is not None
+                    if result is None and getattr(
+                            self.session, "properties", {}).get(
+                            "require_distributed"):
+                        raise QueryDeclinedError(
+                            "require_distributed: cluster declined the "
+                            f"query ({tq.fallback_reason})")
                     if result is None:
                         result = self.session.execute(tq.sql)
                     tq.elapsed_s = time.monotonic() - t0

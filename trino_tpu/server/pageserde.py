@@ -44,11 +44,35 @@ _F_ZLIB = 2
 
 try:
     import zstandard as _zstd
-    _zc = _zstd.ZstdCompressor(level=3)
-    _zd = _zstd.ZstdDecompressor()
 except Exception:                        # pragma: no cover — zstd absent
     _zstd = None
-    _zc = _zd = None
+
+# zstd (de)compression CONTEXTS are not thread-safe, and pages flow on
+# many threads at once (worker task threads, exchange-consumer pulls,
+# coordinator drains) — sharing one context corrupts frames under
+# concurrency (observed: intermittent ZstdError in the partitioned
+# exchange). Keep one context per thread.
+import threading as _threading
+
+_tls = _threading.local()
+
+
+def _zc():
+    if _zstd is None:
+        return None
+    c = getattr(_tls, "zc", None)
+    if c is None:
+        c = _tls.zc = _zstd.ZstdCompressor(level=3)
+    return c
+
+
+def _zd():
+    if _zstd is None:
+        return None
+    d = getattr(_tls, "zd", None)
+    if d is None:
+        d = _tls.zd = _zstd.ZstdDecompressor()
+    return d
 
 # frames smaller than this ship uncompressed (header cost dominates)
 MIN_COMPRESS = 512
@@ -73,8 +97,9 @@ def encode_page(arrays: List[np.ndarray],
     body = b"".join(parts)
     flags = 0
     if len(body) >= MIN_COMPRESS:
-        if _zc is not None:
-            comp = _zc.compress(body)
+        zc = _zc()
+        if zc is not None:
+            comp = zc.compress(body)
             if len(comp) < len(body):
                 body, flags = comp, _F_ZSTD
         else:
@@ -90,9 +115,10 @@ def decode_page(buf: bytes) -> Tuple[List[np.ndarray], List[np.ndarray]]:
     flags, rawlen = struct.unpack_from("<BQ", buf, 4)
     body = buf[13:13 + rawlen]
     if flags & _F_ZSTD:
-        if _zd is None:
+        zd = _zd()
+        if zd is None:
             raise ValueError("zstd page but zstandard unavailable")
-        body = _zd.decompress(body)
+        body = zd.decompress(body)
     elif flags & _F_ZLIB:
         body = zlib.decompress(body)
     off = 0

@@ -45,12 +45,16 @@ class RemoteTask:
     """Coordinator's proxy of one worker task (HttpRemoteTask.java:135)."""
 
     def __init__(self, node, task_id: str, fragment_blob: str,
-                 splits: List[Split], http_timeout_s: float = 30.0):
+                 splits: List[Split], http_timeout_s: float = 30.0,
+                 partition: Optional[dict] = None,
+                 sources: Optional[dict] = None):
         self.node = node
         self.task_id = task_id
         self.fragment_blob = fragment_blob
         self.splits = splits
         self.http_timeout_s = http_timeout_s
+        self.partition = partition
+        self.sources = sources
         self.pages: List[dict] = []
         self.done = False
 
@@ -73,11 +77,32 @@ class RemoteTask:
             return json.loads(body.decode()) if body else {}
 
     def start(self) -> None:
-        body = json.dumps({
+        payload = {
             "fragment": self.fragment_blob,
             "splits": [vars(s) for s in self.splits],
-        }).encode()
+        }
+        if self.partition is not None:
+            payload["partition"] = self.partition
+        if self.sources is not None:
+            payload["sources"] = self.sources
+        body = json.dumps(payload).encode()
         self._request(self._url(), data=body, method="POST")
+
+    def wait_finished(self, deadline: float) -> None:
+        """Poll task status until FINISHED (producer stages whose buffers
+        are drained by OTHER workers — the coordinator only needs the
+        terminal state, ContinuousTaskStatusFetcher's role)."""
+        while time.time() < deadline:
+            st = self._request(self._url())
+            if st.get("state") == "FINISHED":
+                self.done = True
+                return
+            if st.get("state") in ("FAILED", "CANCELED"):
+                raise TaskFailedError(
+                    f"task {self.task_id} on {self.node.node_id}: "
+                    f"{st.get('error', st.get('state'))}")
+            time.sleep(0.02)
+        raise TaskFailedError(f"task {self.task_id} timed out")
 
     def drain(self, deadline: float) -> List[bytes]:
         """Pull result pages token by token until the buffer completes
@@ -193,6 +218,22 @@ class StageScheduler:
             return None
         rel, root = planned
 
+        # session-forced partitioned join distribution: hash-repartition
+        # both sides across workers instead of broadcasting the build
+        # (DetermineJoinDistributionType.java:51's PARTITIONED choice)
+        props = getattr(self.session, "properties", {})
+        if props.get("join_distribution_type") == "partitioned":
+            desc = self._analyze_partitioned(root)
+            if desc is not None:
+                result = self._execute_partitioned(rel, root, workers,
+                                                   desc)
+                result.elapsed_s = time.monotonic() - t0
+                self.stats["queries"] += 1
+                return result
+            self.fallback_reason = ("join_distribution_type=PARTITIONED "
+                                    "but plan shape does not support a "
+                                    "partitioned exchange")
+
         frags = fragment_plan(root, self.session.catalog,
                               min_build_rows=self.split_rows)
         # the probe spine itself must be split-worthy BEFORE any build
@@ -287,22 +328,8 @@ class StageScheduler:
                 ex._subst[id(analysis.merge_agg)] = merged
                 ex._subst_opaque.add(id(analysis.merge_agg))
             else:
-                cols = None
-                for p in pages:
-                    arrs, vals = decode_columns(p)
-                    if cols is None:
-                        cols = [[a] for a in arrs], [[v] for v in vals]
-                    else:
-                        for j, a in enumerate(arrs):
-                            cols[0][j].append(a)
-                            cols[1][j].append(vals[j])
-                if cols is not None:
-                    arrs = [np.concatenate(c) for c in cols[0]]
-                    vals = [np.concatenate(c) for c in cols[1]]
-                else:     # no pages at all: empty input to the remainder
-                    arrs = [np.zeros(0, dtype=dt.np_dtype)
-                            for _, dt in root.child.output]
-                    vals = [np.zeros(0, dtype=np.bool_) for _ in arrs]
+                from .tasks import concat_pages
+                arrs, vals = concat_pages(pages, root.child.output)
                 ex._subst[id(root.child)] = batch_from_numpy(
                     arrs, valids=vals)
                 ex._subst_opaque.add(id(root.child))
@@ -424,3 +451,153 @@ class StageScheduler:
         from ..batch import batch_from_numpy
         arrs = [np.zeros(0, dtype=dt.np_dtype) for _, dt in agg.output]
         return batch_from_numpy(arrs)
+
+    # -- partitioned worker<->worker exchange ------------------------------
+    #
+    # A 3-stage tree (PipelinedQueryScheduler's FIXED_HASH_DISTRIBUTION
+    # path): stage A streams the probe side's splits and hash-partitions
+    # its output by the join keys into P buffers; stage B does the same
+    # for the build side; stage C runs P exchange-consumer tasks, task p
+    # pulling buffer p from EVERY upstream task (worker<->worker binary
+    # page frames, DirectExchangeClient.java:56) and running
+    # join+partial-agg on its co-partitioned slice; the coordinator FINAL
+    # merges. Pulls overlap production: C tasks start with A/B and poll
+    # buffers until upstream completes.
+
+    def _analyze_partitioned(self, root: L.OutputNode):
+        """Match Agg(Filter/Project*(Join(probe, build))) where BOTH join
+        sides contain split-worthy scans and every join key is integer-
+        typed (dictionary varchar codes are per-table, so hash routing
+        on them would be inconsistent across tables). Returns (join,
+        merge_agg, probe_driver, build_driver) or None."""
+        from ..exec.chunked import MERGE_FUNC
+        from ..planner.fragmenter import _scan_rows, _subtree_nodes
+        # phase 1 — above the merge point: Sort/Limit/Filter/Project all
+        # run on the coordinator after the merge, so they may be skipped
+        node = root.child
+        merge_agg = None
+        while isinstance(node, (L.FilterNode, L.ProjectNode,
+                                L.SortNode, L.LimitNode)):
+            node = node.child
+        if isinstance(node, L.AggregateNode):
+            if any(a.distinct for a in node.aggs) or \
+                    any(a.func not in MERGE_FUNC for a in node.aggs):
+                return None
+            merge_agg = node
+            node = node.child
+        if merge_agg is None:     # concat-mode repartition needs ordered
+            return None           # merge support; agg merge only for now
+        # phase 2 — below the merge point, INSIDE the consumer fragment:
+        # only order-insensitive nodes are allowed (a Sort/Limit here
+        # would compute per-partition top-N, not global)
+        while isinstance(node, (L.FilterNode, L.ProjectNode)):
+            node = node.child
+        if not isinstance(node, L.JoinNode) or node.null_aware or \
+                node.kind not in ("inner", "left", "semi", "anti"):
+            return None
+        join = node
+        for side, keys in ((join.left, join.left_keys),
+                           (join.right, join.right_keys)):
+            for k in keys:
+                dt = side.output[k][1]
+                if not np.issubdtype(np.dtype(dt.np_dtype), np.integer):
+                    return None
+
+        def driver_of(side):
+            scans = [n for n in _subtree_nodes(side)
+                     if isinstance(n, L.ScanNode)]
+            if not scans:
+                return None
+            d = max(scans, key=lambda s: _scan_rows(
+                self.session.catalog, s))
+            return d if _scan_rows(self.session.catalog, d) > \
+                self.split_rows else None
+
+        probe_driver = driver_of(join.left)
+        build_driver = driver_of(join.right)
+        if probe_driver is None or build_driver is None:
+            return None
+        # the worker streams splits of the driver scan; everything else
+        # in the side's subtree must be split-invariant (pinned)
+        for side, driver in ((join.left, probe_driver),
+                             (join.right, build_driver)):
+            an = analyze(L.OutputNode(side, tuple(n for n, _ in
+                                                  side.output),
+                                      side.output),
+                         self.session.catalog, self.split_rows)
+            if an is None or an.driver is not driver:
+                return None
+        return join, merge_agg, probe_driver, build_driver
+
+    def _execute_partitioned(self, rel, root: L.OutputNode, workers,
+                             desc):
+        join, merge_agg, probe_driver, build_driver = desc
+        P = len(workers)
+        t_deadline = time.time() + self.task_timeout_s
+
+        def stage_tasks(side_root, driver, keys):
+            blob = encode_fragment({"root": side_root, "driver": driver})
+            rows = self.session.catalog.get_table(
+                driver.catalog, driver.schema_name, driver.table).num_rows
+            splits = [Split(driver.catalog, driver.schema_name,
+                            driver.table, start,
+                            min(self.split_rows, rows - start))
+                      for start in range(0, rows, self.split_rows)]
+            tasks = []
+            for wi, w in enumerate(workers):
+                sp = [s for i, s in enumerate(splits)
+                      if i % len(workers) == wi]
+                if not sp:
+                    continue
+                with self._lock:
+                    self._seq += 1
+                    tid = f"t{self._seq}"
+                task = RemoteTask(w, tid, blob, sp,
+                                  partition={"keys": list(keys),
+                                             "count": P})
+                task.start()
+                self.stats["tasks"] += 1
+                tasks.append(task)
+            return tasks
+
+        a_tasks = stage_tasks(join.left, probe_driver, join.left_keys)
+        b_tasks = stage_tasks(join.right, build_driver, join.right_keys)
+
+        rs_a = L.RemoteSourceNode(1, join.left.output)
+        rs_b = L.RemoteSourceNode(2, join.right.output)
+        c_root = L.replace_nodes(
+            merge_agg, {id(join.left): rs_a, id(join.right): rs_b})
+        blob_c = encode_fragment({"root": c_root,
+                                  "timeout_s": self.task_timeout_s})
+        c_tasks = []
+        for p in range(P):
+            sources = {
+                "1": [{"uri": t.node.uri, "taskId": t.task_id,
+                       "buffer": p} for t in a_tasks],
+                "2": [{"uri": t.node.uri, "taskId": t.task_id,
+                       "buffer": p} for t in b_tasks],
+            }
+            with self._lock:
+                self._seq += 1
+                tid = f"t{self._seq}"
+            task = RemoteTask(workers[p % len(workers)], tid, blob_c, [],
+                              sources=sources)
+            task.start()
+            self.stats["tasks"] += 1
+            c_tasks.append(task)
+
+        pages: List[bytes] = []
+        try:
+            for t in c_tasks:
+                pages.extend(t.drain(t_deadline))
+            for t in a_tasks + b_tasks:
+                t.wait_finished(t_deadline)
+        except Exception:
+            for t in a_tasks + b_tasks + c_tasks:
+                t.cancel()
+            raise
+        self.stats["stages"] = self.stats.get("stages", 0) + 4
+        self.stats["partitioned_joins"] = \
+            self.stats.get("partitioned_joins", 0) + 1
+        shim = ChunkAnalysis(None, merge_agg, [], 0)
+        return self._run_final_stage(rel, root, shim, pages)

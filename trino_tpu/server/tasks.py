@@ -60,6 +60,29 @@ def decode_columns(page) -> tuple:
     return arrays, valids
 
 
+def concat_pages(pages, out_types) -> tuple:
+    """Decode + concatenate page frames into one (arrays, valids) column
+    set; zero-row input yields empty columns typed from `out_types`
+    (pairs of (name, dtype)). Shared by the coordinator merge and the
+    exchange consumer."""
+    cols = None
+    for p in pages:
+        arrs, vals = decode_columns(p)
+        if len(arrs) == 0 or len(arrs[0]) == 0:
+            continue
+        if cols is None:
+            cols = [[a] for a in arrs], [[v] for v in vals]
+        else:
+            for j, a in enumerate(arrs):
+                cols[0][j].append(a)
+                cols[1][j].append(vals[j])
+    if cols is not None:
+        return ([np.concatenate(c) for c in cols[0]],
+                [np.concatenate(c) for c in cols[1]])
+    arrs = [np.zeros(0, dtype=dt.np_dtype) for _, dt in out_types]
+    return arrs, [np.zeros(0, dtype=np.bool_) for _ in arrs]
+
+
 def encode_fragment(root) -> str:
     """Plan subtree -> wire form: a data-only JSON serde (server/serde.py),
     the analog of the reference's Jackson-serialized PlanFragment — a
@@ -113,6 +136,43 @@ class Split:
 
 
 # --------------------------------------------------------------------------
+# hash partitioning for the worker<->worker exchange
+# (operator/output/PagePartitioner.java:135's role; the hash must be
+# identical on every worker so co-partitioned sides land together)
+# --------------------------------------------------------------------------
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """uint64 -> uint64 mix (same finalizer family as the reference's
+    XxHash64-based partitioning — any good avalanche works, it only has
+    to be consistent across workers)."""
+    z = x + np.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+def partition_assignment(arrays, valids, key_idxs, count: int):
+    """Per-row partition ids from the key columns. Integer-typed keys
+    only (dictionary varchar codes are per-table and would partition
+    inconsistently across tables); NULLs hash to a fixed marker so every
+    worker routes them identically."""
+    n = len(arrays[0]) if arrays else 0
+    h = np.zeros(n, np.uint64)
+    with np.errstate(over="ignore"):
+        for j, i in enumerate(key_idxs):
+            a = arrays[i]
+            if not np.issubdtype(a.dtype, np.integer) and \
+                    a.dtype != np.bool_:
+                raise ValueError(
+                    f"partitioned exchange requires integer keys, "
+                    f"got {a.dtype}")
+            k = a.astype(np.int64).view(np.uint64)
+            k = np.where(valids[i], k, np.uint64(0xA5A5A5A5A5A5A5A5))
+            h ^= _splitmix64(k + np.uint64(j))
+    return (h % np.uint64(count)).astype(np.int64)
+
+
+# --------------------------------------------------------------------------
 # task state + manager
 # --------------------------------------------------------------------------
 
@@ -121,15 +181,33 @@ TASK_STATES = ("PENDING", "RUNNING", "FINISHED", "FAILED", "CANCELED")
 
 @dataclass
 class WorkerTask:
+    """One task's state. Output is a set of numbered buffers: buffer 0
+    for the plain single-consumer case, buffers 0..P-1 when `partition`
+    is set (PartitionedOutputBuffer.java:42's role). `sources` makes the
+    task an exchange CONSUMER: instead of splits it pulls its partition
+    from upstream tasks on other workers (worker<->worker data plane,
+    DirectExchangeClient.java:56)."""
     task_id: str
     fragment_blob: str
     splits: List[Split]
+    # {"keys": [out col idx, ...], "count": P} -> partitioned output
+    partition: Optional[dict] = None
+    # {fragment_id(str): [{"uri","taskId","buffer"}, ...]} -> pull inputs
+    sources: Optional[dict] = None
     state: str = "PENDING"
     error: str = ""
-    pages: List[bytes] = field(default_factory=list)  # binary page frames
-    acked: int = 0                 # tokens below this are released
+    buffers: Dict[int, List[bytes]] = field(default_factory=dict)
+    acked: Dict[int, int] = field(default_factory=dict)
     splits_done: int = 0
     lock: threading.Lock = field(default_factory=threading.Lock)
+
+    @property
+    def pages(self) -> List[bytes]:       # legacy single-buffer view
+        return self.buffers.setdefault(0, [])
+
+    def total_pages(self) -> int:
+        return sum(len(v) for v in self.buffers.values()) + \
+            sum(self.acked.values())
 
 
 class TaskManager:
@@ -151,11 +229,13 @@ class TaskManager:
         self._exec_lock = threading.Lock()
 
     def create_or_update(self, task_id: str, fragment_blob: str,
-                         splits: List[Split]) -> WorkerTask:
+                         splits: List[Split], partition: dict = None,
+                         sources: dict = None) -> WorkerTask:
         with self._lock:
             task = self.tasks.get(task_id)
             if task is None:
-                task = WorkerTask(task_id, fragment_blob, splits)
+                task = WorkerTask(task_id, fragment_blob, splits,
+                                  partition=partition, sources=sources)
                 self.tasks[task_id] = task
                 t = threading.Thread(target=self._run, args=(task,),
                                      name=f"task-{task_id}", daemon=True)
@@ -173,6 +253,25 @@ class TaskManager:
                     task.state = "CANCELED"
 
 
+    def _emit(self, task: WorkerTask, arrs, vals) -> None:
+        """Stage one result batch into the task's output buffers,
+        hash-partitioned when the task has a partition spec."""
+        if task.partition is None:
+            page = encode_columns(arrs, vals)
+            with task.lock:
+                task.pages.append(page)
+            return
+        keys, count = task.partition["keys"], task.partition["count"]
+        part = partition_assignment(arrs, vals, keys, count)
+        for p in range(count):
+            m = part == p
+            if not m.any():
+                continue
+            page = encode_columns([a[m] for a in arrs],
+                                  [v[m] for v in vals])
+            with task.lock:
+                task.buffers.setdefault(p, []).append(page)
+
     def _run(self, task: WorkerTask) -> None:
         from ..batch import batch_from_numpy, batch_to_numpy, pad_capacity
         with task.lock:
@@ -183,6 +282,9 @@ class TaskManager:
         try:
             if self.injector is not None:
                 self.injector.maybe_fail("TASK", task.task_id)
+            if task.sources is not None:
+                self._run_exchange_consumer(task)
+                return
             fragment = decode_fragment(task.fragment_blob)
             root, driver_scan = fragment["root"], fragment["driver"]
             cap = pad_capacity(max(s.count for s in task.splits)) \
@@ -233,9 +335,8 @@ class TaskManager:
                             ex.release_path_reservations(
                                 root, keep=ex._subst)
                         arrs, vals = batch_to_numpy(out)
-                        page = encode_columns(arrs, vals)
+                        self._emit(task, arrs, vals)
                         with task.lock:
-                            task.pages.append(page)
                             task.splits_done += 1
                 finally:
                     ex._subst.clear()
@@ -254,8 +355,112 @@ class TaskManager:
                 if task.state != "CANCELED":
                     task.state = "FAILED"
 
+    # -- exchange consumer: worker<->worker partitioned shuffle ------------
+
+    def _pull_buffer(self, uri: str, task_id: str, buffer: int,
+                     deadline: float, task: WorkerTask) -> List[bytes]:
+        """Pull one upstream buffer to completion (the worker-side twin
+        of the coordinator's RemoteTask.drain — HttpPageBufferClient's
+        loop, running worker-to-worker)."""
+        import json as _json
+        import time as _time
+        from urllib.request import Request, urlopen
+        pages: List[bytes] = []
+        token = 0
+        while _time.time() < deadline:
+            if task.state == "CANCELED":
+                raise RuntimeError("task canceled during exchange pull")
+            req = Request(
+                f"{uri}/v1/task/{task_id}/results/{buffer}/{token}",
+                headers={"Accept": "application/x-trino-pages"})
+            with urlopen(req, timeout=30.0) as resp:
+                body = resp.read()
+                if resp.headers.get("Content-Type", "").startswith(
+                        "application/x-trino-pages"):
+                    pages.append(bytes(body))
+                    token += 1
+                    continue
+                out = _json.loads(body.decode()) if body else {}
+            if out.get("state") == "FAILED":
+                raise RuntimeError(
+                    f"upstream task {task_id} failed: {out.get('error')}")
+            if out.get("complete"):
+                return pages
+            _time.sleep(0.02)
+        raise RuntimeError(f"exchange pull from {task_id} timed out")
+
+    def _run_exchange_consumer(self, task: WorkerTask) -> None:
+        """Execute a fragment whose leaves are RemoteSourceNodes: pull
+        each source's partition from the upstream tasks, bind the
+        concatenated batches, run once, emit (re-partitioned when the
+        task has a partition spec). Pulls happen BEFORE taking the
+        executor lock so an upstream task on this same worker can finish
+        producing while we wait."""
+        import time as _time
+
+        from ..batch import batch_from_numpy
+        from ..planner import logical as L
+        fragment = decode_fragment(task.fragment_blob)
+        root = fragment["root"]
+        deadline = _time.time() + float(fragment.get("timeout_s", 300.0))
+
+        def remote_nodes(n):
+            if isinstance(n, L.RemoteSourceNode):
+                yield n
+            for c in L.children(n):
+                yield from remote_nodes(c)
+
+        by_fid = {}
+        for n in remote_nodes(root):
+            by_fid.setdefault(n.fragment_id, []).append(n)
+        batches = {}
+        for fid_str, srcs in task.sources.items():
+            fid = int(fid_str)
+            pages = []
+            for s in srcs:
+                pages.extend(self._pull_buffer(
+                    s["uri"], s["taskId"], int(s.get("buffer", 0)),
+                    deadline, task))
+            nodes = by_fid.get(fid)
+            arrs, vals = concat_pages(
+                pages, nodes[0].output if nodes else ())
+            batches[fid] = batch_from_numpy(arrs, valids=vals)
+
+        from ..batch import batch_to_numpy
+        with self._exec_lock:
+            ex = self._executor
+            ex._subst.clear()
+            ex._subst_opaque.clear()
+            saved_merge = ex.enable_merge_join
+            # partition sizes differ per consumer task, so the merge-sort
+            # kernel's multi-operand XLA sort would recompile per shape —
+            # and that compile is pathological (minutes even at tiny
+            # shapes). The dense-LUT/expansion paths compile in seconds
+            # at any size; pin the consumer to them.
+            ex.enable_merge_join = False
+            try:
+                for fid, nodes in by_fid.items():
+                    for n in nodes:
+                        ex._subst[id(n)] = batches[fid]
+                        ex._subst_opaque.add(id(n))
+                out = ex.run(root)
+                arrs, vals = batch_to_numpy(out)
+            finally:
+                ex.enable_merge_join = saved_merge
+                ex._subst.clear()
+                ex._subst_opaque.clear()
+                for b in ex._node_bytes.values():
+                    ex.pool.free(b)
+                ex._node_bytes.clear()
+        self._emit(task, arrs, vals)
+        with task.lock:
+            if task.state == "RUNNING":
+                task.state = "FINISHED"
+
     def status_json(self, task: WorkerTask) -> dict:
-        return {"taskId": task.task_id, "state": task.state,
-                "error": task.error.splitlines()[0] if task.error else "",
-                "splitsDone": task.splits_done,
-                "pages": len(task.pages)}
+        with task.lock:      # buffers/acked mutate on the task thread
+            return {"taskId": task.task_id, "state": task.state,
+                    "error": task.error.splitlines()[0]
+                    if task.error else "",
+                    "splitsDone": task.splits_done,
+                    "pages": task.total_pages()}

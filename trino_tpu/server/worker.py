@@ -72,9 +72,11 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             if task is not None:
                 self._send(200, self.worker.task_manager.status_json(task))
             return
-        # GET /v1/task/{id}/results/{token} — output-buffer pull
-        # (server/TaskResource.java:332)
-        if len(parts) == 5 and parts[:2] == ["v1", "task"] and \
+        # GET /v1/task/{id}/results/{token}            — buffer 0
+        # GET /v1/task/{id}/results/{buffer}/{token}   — partitioned
+        # (server/TaskResource.java:332; buffers are the partitioned
+        # output of the worker<->worker exchange)
+        if len(parts) in (5, 6) and parts[:2] == ["v1", "task"] and \
                 parts[3] == "results":
             task = self._task_or_404(parts[2])
             if task is None:
@@ -82,21 +84,25 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             if self.worker.fail_results:     # fault injection hook
                 self._send(500, {"error": "injected results failure"})
                 return
-            token = int(parts[4])
+            buffer = int(parts[4]) if len(parts) == 6 else 0
+            token = int(parts[-1])
             binary = "x-trino-pages" in self.headers.get("Accept", "")
             with task.lock:
+                pages = task.buffers.setdefault(buffer, [])
+                acked = task.acked.get(buffer, 0)
                 # Advancing to `token` acknowledges every page below it
                 # (TaskResource.java:372's implicit-ack contract) — drop
                 # drained pages so a long-lived worker's memory stays flat;
                 # same-token retries after a fetch failure still succeed.
-                while task.acked < token and task.pages:
-                    task.pages.pop(0)
-                    task.acked += 1
-                idx = token - task.acked
-                total = task.acked + len(task.pages)
-                if 0 <= idx < len(task.pages):
+                while acked < token and pages:
+                    pages.pop(0)
+                    acked += 1
+                task.acked[buffer] = acked
+                idx = token - acked
+                total = acked + len(pages)
+                if 0 <= idx < len(pages):
                     if binary:
-                        self._send_page(task.pages[idx],
+                        self._send_page(pages[idx],
                                         {"X-Trino-Token": token,
                                          "X-Trino-Complete": "false"})
                     else:
@@ -104,7 +110,7 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                         self._send(200, {
                             "token": token, "complete": False,
                             "page": {"b64": base64.b64encode(
-                                task.pages[idx]).decode()}})
+                                pages[idx]).decode()}})
                     return
                 done = task.state in ("FINISHED", "FAILED", "CANCELED")
                 self._send(200, {"token": token,
@@ -134,7 +140,9 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             from .tasks import Split
             splits = [Split(**s) for s in body.get("splits", [])]
             task = self.worker.task_manager.create_or_update(
-                parts[2], body["fragment"], splits)
+                parts[2], body["fragment"], splits,
+                partition=body.get("partition"),
+                sources=body.get("sources"))
             self._send(200, self.worker.task_manager.status_json(task))
             return
         self._send(404, {"error": f"no route {path}"})
