@@ -33,9 +33,10 @@ class ClientResult:
 class Client:
     def __init__(self, uri: str, user: str = "anonymous",
                  poll_interval_s: float = 0.05, timeout_s: float = 300.0,
-                 spooled: bool = False):
+                 spooled: bool = False, password: Optional[str] = None):
         self.uri = uri.rstrip("/")
         self.user = user
+        self.password = password   # X-Trino-Password credential
         self.poll_interval_s = poll_interval_s
         self.timeout_s = timeout_s
         self.spooled = spooled     # opt into the spooled result protocol
@@ -44,6 +45,8 @@ class Client:
                  body: Optional[bytes] = None) -> dict:
         headers = {"X-Trino-User": self.user,
                    "Content-Type": "text/plain"}
+        if self.password is not None:
+            headers["X-Trino-Password"] = self.password
         if self.spooled:
             headers["X-Trino-Spooled"] = "true"
         req = Request(url, data=body, method=method, headers=headers)

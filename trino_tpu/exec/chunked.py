@@ -135,7 +135,7 @@ def _plan_packing(build: Batch, node: L.JoinNode, mins, maxs):
 
 
 def compile_fused_chunk(executor, target: L.PlanNode,
-                        driver: L.ScanNode, lut_specs=None):
+                        driver: L.ScanNode, lut_specs=None, adapt=None):
     """Compose the whole per-chunk path (joins with prebuilt LUTs,
     filters, projections, the partial aggregate) into ONE traced
     function so every chunk is a single device dispatch with zero host
@@ -150,33 +150,59 @@ def compile_fused_chunk(executor, target: L.PlanNode,
     word_dtype, bkey, out_dtypes) joins decode everything from ONE
     value-packed gather.
 
-    Returns (fn, join_nodes) where fn(chunk, builds, luts) -> partial
-    Batch and join_nodes lists the JoinNodes in `builds`/`luts` order;
+    `adapt` applies a previous run's measurements (AdaptivePlanner.java:87's
+    role, replayed through the cross-run decision cache): {"windows":
+    {join_idx: W}} probes a packed join through a W-sized LUT window
+    (near-sorted keys); {"compact": (join_idx, cap)} compacts live rows
+    to `cap` after that join so later operators run at the real
+    selectivity. Both are guesses that may be invalidated by new data,
+    so the program reports per-join (escaped, span, live) + compaction
+    overflow in a stats vector the DRIVER must verify (nonzero escaped/
+    overflow => rerun the plain program).
+
+    Returns (fn, join_nodes) where fn(chunk, builds, luts) ->
+    (partial Batch, stats int64[2 + 3*n_joins]); stats layout:
+    [escaped_total, compact_overflow, span_0, live_0, 0, span_1, ...].
     None when the shape doesn't apply (caller uses the per-node loop)."""
     from ..ops.aggregate import (AggSpec, direct_group_aggregate,
                                  global_aggregate)
-    from ..ops.join import dense_join_packed, dense_join_with_lut
+    from ..ops.join import (compact_live, dense_join_packed,
+                            dense_join_packed_windowed,
+                            dense_join_with_lut)
     from ..ops.project import apply_filter, filter_project
 
     joins: List[L.JoinNode] = []
+    windows = (adapt or {}).get("windows", {})
+    compact_at = (adapt or {}).get("compact")
 
     def emit(node):
+        """Returns f(chunk, builds, luts) -> (Batch, stats dict) or
+        None. stats: {"escaped": scalar, "overflow": scalar,
+        "joins": [(span, live), ...]}."""
         if node is driver:
-            return lambda chunk, builds, luts: chunk
+            return lambda chunk, builds, luts: (chunk, {
+                "escaped": jnp.int64(0), "overflow": jnp.int64(0),
+                "joins": []})
         if isinstance(node, L.FilterNode):
             child = emit(node.child)
             if child is None:
                 return None
             pred = executor.fold_scalars(node.predicate)
-            return lambda chunk, b, l: apply_filter(
-                child(chunk, b, l), pred)
+
+            def run_filter(chunk, b, l, _child=child, _pred=pred):
+                bt, st = _child(chunk, b, l)
+                return apply_filter(bt, _pred), st
+            return run_filter
         if isinstance(node, L.ProjectNode):
             child = emit(node.child)
             if child is None:
                 return None
             exprs = executor.fold_scalars_tuple(node.exprs)
-            return lambda chunk, b, l: filter_project(
-                child(chunk, b, l), None, exprs)
+
+            def run_project(chunk, b, l, _child=child, _exprs=exprs):
+                bt, st = _child(chunk, b, l)
+                return filter_project(bt, None, _exprs), st
+            return run_project
         if isinstance(node, L.JoinNode):
             if not _fused_join_ok(node):
                 return None
@@ -187,13 +213,38 @@ def compile_fused_chunk(executor, target: L.PlanNode,
             joins.append(node)
             lk, rk, kind = node.left_keys, node.right_keys, node.kind
             spec = lut_specs.get(id(node)) if lut_specs else None
-            if spec is not None and spec[0] == "packed":
-                _, meta, _wd, bkey, out_dtypes = spec
-                return lambda chunk, b, l: dense_join_packed(
-                    child(chunk, b, l), l[idx], lk, meta, bkey,
-                    out_dtypes, kind)
-            return lambda chunk, b, l: dense_join_with_lut(
-                child(chunk, b, l), b[idx], l[idx], lk, rk, kind)
+            window = windows.get(idx)
+            cap = compact_at[1] if compact_at is not None and \
+                compact_at[0] == idx else None
+
+            def run_join(chunk, b, l, _child=child, _idx=idx, _lk=lk,
+                         _rk=rk, _kind=kind, _spec=spec, _win=window,
+                         _cap=cap):
+                bt, st = _child(chunk, b, l)
+                esc = jnp.int64(0)
+                if _spec is not None and _spec[0] == "packed":
+                    _, meta, _wd, bkey, out_dtypes = _spec
+                    if _win is not None:
+                        out, esc, span = dense_join_packed_windowed(
+                            bt, l[_idx], _lk, meta, bkey, out_dtypes,
+                            _kind, _win)
+                    else:
+                        out = dense_join_packed(
+                            bt, l[_idx], _lk, meta, bkey, out_dtypes,
+                            _kind)
+                        span = _key_span(bt, _lk)
+                else:
+                    out = dense_join_with_lut(bt, b[_idx], l[_idx], _lk,
+                                              _rk, _kind)
+                    span = _key_span(bt, _lk)
+                live = jnp.sum(out.live, dtype=jnp.int64)
+                if _cap is not None:
+                    out, over = compact_live(out, _cap)
+                    st = dict(st, overflow=st["overflow"] + over)
+                return out, dict(
+                    st, escaped=st["escaped"] + esc,
+                    joins=st["joins"] + [(span, live)])
+            return run_join
         if isinstance(node, L.AggregateNode):
             child = emit(node.child)
             if child is None:
@@ -204,19 +255,45 @@ def compile_fused_chunk(executor, target: L.PlanNode,
                                  if a.arg is not None else None)
                          for a in node.aggs)
             if node.strategy == "global":
-                return lambda chunk, b, l: global_aggregate(
-                    child(chunk, b, l), aggs)
+                def run_gagg(chunk, b, l, _child=child, _aggs=aggs):
+                    bt, st = _child(chunk, b, l)
+                    return global_aggregate(bt, _aggs), st
+                return run_gagg
             if node.strategy == "direct":
                 keys, domains = node.group_keys, node.key_domains
-                return lambda chunk, b, l: direct_group_aggregate(
-                    child(chunk, b, l), keys, domains, aggs)
+
+                def run_dagg(chunk, b, l, _child=child, _aggs=aggs,
+                             _keys=keys, _domains=domains):
+                    bt, st = _child(chunk, b, l)
+                    return direct_group_aggregate(
+                        bt, _keys, _domains, _aggs), st
+                return run_dagg
             return None
         return None
 
-    fn = emit(target)
-    if fn is None:
+    inner = emit(target)
+    if inner is None:
         return None
+
+    def fn(chunk, builds, luts):
+        out, st = inner(chunk, builds, luts)
+        parts = [st["escaped"], st["overflow"]]
+        for span, live in st["joins"]:
+            parts.extend((span, live, jnp.int64(0)))
+        return out, jnp.stack(parts) if parts else \
+            jnp.zeros(2, jnp.int64)
     return fn, joins
+
+
+def _key_span(batch: Batch, keys: tuple):
+    """Probe-key extent of live rows (windowing measurement)."""
+    col = batch.columns[keys[0]]
+    ok = batch.live & col.valid
+    d = col.data.astype(jnp.int64)
+    big = jnp.int64(1) << 62
+    lo = jnp.min(jnp.where(ok, d, big))
+    hi = jnp.max(jnp.where(ok, d, -big))
+    return jnp.maximum(hi - lo + 1, 0)
 
 
 def _fused_luts(executor, joins) -> Optional[tuple]:
@@ -334,6 +411,88 @@ def _fused_luts(executor, joins) -> Optional[tuple]:
     return tuple(builds), tuple(luts), tuple(specs)
 
 
+# adaptive re-optimization safety margins: windows/capacities pad the
+# measured maxima so ordinary chunk-to-chunk variance doesn't trip the
+# rerun path; real data changes still do (and then re-measure)
+_ADAPT_MARGIN = 1.25
+
+
+def _fused_adaptation(executor, skey, spine, specs, chunk_cap):
+    """Build the `adapt` argument for compile_fused_chunk from a
+    previous run's recorded measurements (cross-run decision cache):
+    window sizes for packed joins with near-sorted probe keys, and one
+    compaction point where measured selectivity is low. None on the
+    first-ever run (the plain program measures)."""
+    from ..batch import bucket_capacity
+    if skey is None:
+        return None
+    if not executor._decision_loaded:
+        executor._load_decisions()
+    rec = executor._decision_cache.get(
+        ("fusedadapt", skey, executor._decision_salt()))
+    if rec is None or len(rec) != 2 * len(spine):
+        return None
+    allow_windows = getattr(executor, "enable_adapt_windows", True)
+    allow_compact = getattr(executor, "enable_adapt_compact", False)
+    windows = {}
+    compact = None
+    for i, j in enumerate(spine):
+        span, live = rec[2 * i], rec[2 * i + 1]
+        domain = j.build_key_domain
+        if allow_windows and specs[i] is not None and \
+                specs[i][0] == "packed" and span > 0 and domain:
+            w = bucket_capacity(int(span * _ADAPT_MARGIN))
+            if w * 2 <= domain:      # window must actually shrink reads
+                windows[i] = w
+        if allow_compact and compact is None and live >= 0:
+            # NOTE measured on v5e: jnp.nonzero's lowering scatters, and
+            # TPU scatter costs ~80ns/row — in-program compaction LOSES
+            # unless later stages are very wide. Off by default.
+            c = max(1024, bucket_capacity(int(live * _ADAPT_MARGIN)))
+            if c * 4 <= chunk_cap:   # only pay the compact gather when
+                compact = (i, c)     # later operators shrink >=4x
+    if not windows and compact is None:
+        return None
+    return {"windows": windows, "compact": compact}
+
+
+def _verify_record_adaptation(executor, skey, adapt, chunk_stats) -> bool:
+    """ONE fetch over the run's stacked per-chunk stats: correctness
+    flags (escaped window rows, compaction overflow) plus span/live
+    maxima. Plain runs record measurements for the next run's
+    adaptation; adapted runs verify their guesses — False means results
+    are unusable and the caller must rerun plain (the stale record is
+    removed so the rerun re-measures)."""
+    key = ("fusedadapt", skey, executor._decision_salt()) \
+        if skey is not None else None
+    if adapt is None and (key is None or key in executor._decision_cache):
+        return True      # nothing to verify or record: skip the sync
+    stk = jnp.stack(chunk_stats)
+    esc = jnp.sum(stk[:, 0])
+    over = jnp.sum(stk[:, 1])
+    spans = jnp.max(stk[:, 2::3], axis=0)
+    lives = jnp.max(stk[:, 3::3], axis=0)
+    vals = np.asarray(jnp.concatenate(
+        [jnp.stack([esc, over]), spans, lives]))
+    n_joins = len(spans)
+    esc_h, over_h = int(vals[0]), int(vals[1])
+    measured = []
+    for i in range(n_joins):
+        measured.extend((int(vals[2 + i]), int(vals[2 + n_joins + i])))
+    if esc_h > 0 or over_h > 0:
+        # stale guesses: drop the record so the rerun runs PLAIN and
+        # re-measures (an adapted rerun from these numbers could loop —
+        # escaped rows depress the live measurement)
+        if key is not None:
+            executor._decision_cache.pop(key, None)
+            executor._decision_dirty = True
+        return False
+    if adapt is None and key is not None:
+        executor._decision_cache[key] = tuple(measured)
+        executor._decision_dirty = True
+    return True
+
+
 class ChunkAnalysis:
     """Where to cut the plan for chunked execution."""
 
@@ -403,20 +562,12 @@ def _all_nodes(node):
         yield from _all_nodes(c)
 
 
-import os as _os
-
-# TRINO_TPU_CHUNK_PROFILE=1: per-phase walls to stderr, with a blocking
-# sync per chunk so device time attributes to its dispatch (diagnostic
-# only — the sync costs a tunnel RTT per chunk on this rig)
-_CHUNK_PROFILE = bool(_os.environ.get("TRINO_TPU_CHUNK_PROFILE"))
-
-
-def _prof(msg):
-    if _CHUNK_PROFILE:
-        import sys
-        import time
-        print(f"[chunk {time.monotonic():.3f}] {msg}", file=sys.stderr,
-              flush=True)
+# TRINO_TPU_CHUNK_PROFILE=1 (shared helper in device_cache): per-phase
+# walls to stderr, with a blocking sync per chunk so device time
+# attributes to its dispatch (diagnostic only — the sync costs a tunnel
+# RTT per chunk on this rig)
+from .device_cache import prof as _prof
+from .device_cache import profile_enabled as _profile_enabled
 
 
 def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
@@ -466,8 +617,10 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
                 # them) — drop them first, NOT the fact cache itself
                 executor._scan_cache.clear()
                 executor._scan_cache_bytes.clear()
-            fact = executor.fact_cache.load(key, data,
-                                            plan.driver.column_indices)
+            fact = executor.fact_cache.load(
+                key, data, plan.driver.column_indices,
+                persist_ok=plan.driver.catalog in ("tpch", "tpcds",
+                                                   "bench"))
     if fact is not None:
         fact_datas = tuple(c.data for c in fact)
         fact_valids = tuple(c.valid for c in fact)
@@ -486,18 +639,20 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
         bl = _fused_luts(executor, spine) if spine is not None else None
         if bl is not None:
             builds, luts, specs = bl
-            # one jitted wrapper per (plan structure, packing layout),
-            # reused across runs so re-executions hit the in-memory
-            # trace cache (a replan produces new node objects but
-            # identical static values)
+            # one jitted wrapper per (plan structure, packing layout,
+            # adaptation), reused across runs so re-executions hit the
+            # in-memory trace cache (a replan produces new node objects
+            # but identical static values)
             skey = executor.build_structure_key(per_chunk_target)
-            ckey = (skey, specs) if skey is not None else None
+            adapt = _fused_adaptation(executor, skey, spine, specs, cap)
+            ckey = (skey, specs, repr(adapt)) if skey is not None \
+                else None
             jitted = executor._fused_cache.get(ckey) \
                 if ckey is not None else None
             if jitted is None:
                 mine = compile_fused_chunk(
                     executor, per_chunk_target, plan.driver,
-                    {id(j): s for j, s in zip(spine, specs)})
+                    {id(j): s for j, s in zip(spine, specs)}, adapt)
                 if mine is not None:
                     jitted = jax.jit(mine[0])
                     if ckey is not None:
@@ -506,11 +661,13 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
                                 next(iter(executor._fused_cache)))
                         executor._fused_cache[ckey] = jitted
             if jitted is not None:
-                fused = (jitted, builds, luts)
+                fused = (jitted, builds, luts, skey, adapt)
                 executor.stats.fused_chunk_pipelines += 1
     _prof(f"luts+fused ready (fused={fused is not None}, "
+          f"adapt={fused[4] if fused else None}, "
           f"fact={fact is not None})")
 
+    chunk_stats: List[object] = []
     executor.enter_chunk_mode()
     try:
         for start in range(0, plan.driver_rows, chunk_rows):
@@ -532,8 +689,9 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
                 chunk = batch_from_numpy(arrays, valids=valids,
                                          capacity=cap)
             if fused is not None:
-                out = fused[0](chunk, fused[1], fused[2])
-                if _CHUNK_PROFILE:
+                out, stats_vec = fused[0](chunk, fused[1], fused[2])
+                chunk_stats.append(stats_vec)
+                if _profile_enabled():
                     jax.block_until_ready(out)
                     _prof(f"chunk@{start} done")
             else:
@@ -577,6 +735,16 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
             executor._subst.clear()
             executor._subst_opaque.clear()
 
+    if fused is not None and chunk_stats:
+        ok = _verify_record_adaptation(executor, fused[3], fused[4],
+                                       chunk_stats)
+        if not ok:
+            # the adaptation's window/capacity guesses were violated by
+            # this run's data: results would be wrong — rerun with the
+            # plain program (the stale measurement was just invalidated,
+            # so the retry does not re-adapt)
+            _prof("adaptation violated; plain rerun")
+            return execute_chunked(executor, root)
     _prof("chunk loop dispatched; merging")
     merged = merge_partials(executor, plan.merge_agg, partials)
     # structure-faithful (see concat mode above): decisions above the

@@ -55,6 +55,9 @@ SESSION_PROPERTY_DEFAULTS = {
     "spill_chunk_rows": (0, int),
     # Pallas MXU one-pass aggregation kernel (ops/pallas_agg.py)
     "mxu_agg": (False, _bool),
+    # dense 'direct' aggregation bound (GroupByHash strategy choice);
+    # capped by the kernel's compile-bound MAX_DIRECT_GROUPS
+    "direct_agg_max_groups": (64, int),
     # join distribution (SystemSessionProperties JOIN_DISTRIBUTION_TYPE):
     # AUTO picks by estimated build bytes against the threshold
     "join_distribution_type": ("auto", lambda v: str(v).lower()),

@@ -52,12 +52,32 @@ class WinSpec:
     def __post_init__(self):
         assert self.func in RANKING + VALUE_FUNCS + AGG_FUNCS, self.func
         assert self.frame in FRAMES or \
-            self.frame.startswith("rows_bounded:"), self.frame
+            self.frame.startswith(("rows_bounded:",
+                                   "range_bounded:")), self.frame
 
 
 def _scan_max(vals: jax.Array) -> jax.Array:
     """Running maximum (propagates the latest boundary index forward)."""
     return lax.associative_scan(jnp.maximum, vals)
+
+
+def _lower_bound(vals: jax.Array, lo0: jax.Array, hi0: jax.Array,
+                 target: jax.Array) -> jax.Array:
+    """Per-row vectorized binary search: first j in [lo0, hi0] with
+    vals[j] >= target (vals non-decreasing on that range). 31 unrolled
+    halvings cover any capacity < 2^31 — the RANGE-frame boundary
+    finder (the role OrderingCompiler-built comparators play in
+    WindowOperator's frame addressing)."""
+    import math
+    n = vals.shape[0]
+    lo, hi = lo0, hi0 + 1
+    for _ in range(max(1, math.ceil(math.log2(n + 1)))):
+        cont = lo < hi
+        mid = (lo + hi) >> 1
+        less = vals[jnp.clip(mid, 0, n - 1)] < target
+        lo = jnp.where(cont & less, mid + 1, lo)
+        hi = jnp.where(cont & ~less, mid, hi)
+    return lo
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3))
@@ -188,6 +208,47 @@ def window_compute(batch: Batch, partition_keys: tuple, order_keys: tuple,
                 fstart = jnp.maximum(part_start, idx - int(p_s))
                 end = jnp.minimum(part_end, idx + int(f_s))
                 empty = end < fstart
+                end = jnp.clip(end, 0, n - 1)
+            elif spec.frame.startswith("range_bounded:"):
+                # RANGE x PRECEDING .. y FOLLOWING: frame bounds are
+                # VALUE offsets over the single ORDER BY key. Rows are
+                # already sorted by (partition, key), so the bounds are
+                # per-partition binary searches over the sorted values;
+                # NULL-key rows frame their peer group (SQL: NULL is its
+                # own peer class in RANGE mode).
+                _, p_s, f_s = spec.frame.split(":")
+                prec, foll = int(p_s), int(f_s)
+                unbounded_prec = prec >= (1 << 62)
+                ki, asc, nf = order_keys[0]
+                okey = batch.columns[ki]
+                ovalid_s = okey.valid[perm]
+                imax = jnp.int64(jnp.iinfo(jnp.int64).max)
+                ov = okey.data[perm].astype(jnp.int64)
+                ov = ov if asc else -ov
+                # NULL keys sit in one block at the partition edge; a
+                # sentinel on the sorted side keeps v monotone so the
+                # searches never land inside the block. Bound arithmetic
+                # SATURATES so 63-bit key values can't wrap past it.
+                v = jnp.where(ovalid_s, ov, -imax if nf else imax)
+                if unbounded_prec:
+                    # frame starts at the partition's first row,
+                    # INCLUDING a leading NULL block (SQL semantics)
+                    lo_t = jnp.full_like(v, -imax)
+                else:
+                    lo_t = jnp.where(v < -imax + prec, -imax, v - prec)
+                hi_t = jnp.where(v > imax - 1 - foll, imax - 1, v + foll)
+                fstart = _lower_bound(v, part_start, idx, lo_t)
+                end = _lower_bound(v, idx, part_end, hi_t + 1) - 1
+                peer_start = _scan_max(
+                    jnp.where(peer_boundary, idx, -1))
+                # NULL rows: frame = their peer block — except with an
+                # UNBOUNDED PRECEDING start, which reaches back to the
+                # partition's first row regardless of NULL placement
+                null_start = part_start if unbounded_prec else peer_start
+                fstart = jnp.where(ovalid_s, fstart, null_start)
+                end = jnp.where(ovalid_s, end, peer_end)
+                empty = end < fstart
+                fstart = jnp.clip(fstart, 0, n - 1)
                 end = jnp.clip(end, 0, n - 1)
             else:
                 fstart = part_start

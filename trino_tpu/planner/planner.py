@@ -1526,32 +1526,38 @@ class Planner:
         f = call.frame
         if f is None:
             return "range_running"    # SQL default frame
+        kind = "rows" if f.unit == "rows" else "range"
         if f.start != "unbounded_preceding":
-            # bounded N-row frames: ROWS BETWEEN p PRECEDING AND
-            # (CURRENT ROW | f FOLLOWING) — FramedWindowFunction's role
-            if f.unit == "rows" and f.start.endswith("_preceding") and \
-                    f.start[0].isdigit():
+            # bounded frames: (ROWS|RANGE) BETWEEN p PRECEDING AND
+            # (CURRENT ROW | f FOLLOWING) — FramedWindowFunction's role.
+            # RANGE bounds are VALUE offsets over the single numeric
+            # ORDER BY key (WindowOperator.java:70 frame semantics).
+            if f.start.endswith("_preceding") and f.start[0].isdigit():
                 p = int(f.start.split("_")[0])
-                if f.end == "current_row":
-                    fl = 0
-                elif f.end.endswith("_following") and f.end[0].isdigit():
-                    fl = int(f.end.split("_")[0])
-                else:
-                    raise AnalysisError(
-                        f"unsupported ROWS frame end {f.end!r}")
-                return f"rows_bounded:{p}:{fl}"
-            raise AnalysisError(
-                "only UNBOUNDED PRECEDING or n PRECEDING (ROWS) frame "
-                "starts are supported")
+            elif f.start == "current_row":
+                p = 0
+            else:
+                raise AnalysisError(
+                    "only UNBOUNDED PRECEDING, n PRECEDING or CURRENT "
+                    "ROW frame starts are supported")
+            if f.end == "current_row":
+                fl = 0
+            elif f.end.endswith("_following") and f.end[0].isdigit():
+                fl = int(f.end.split("_")[0])
+            else:
+                raise AnalysisError(
+                    f"unsupported {f.unit.upper()} frame end {f.end!r}")
+            return f"{kind}_bounded:{p}:{fl}"
         if f.end == "current_row":
             return "rows_running" if f.unit == "rows" else "range_running"
         if f.end.endswith("_following") and f.end[0].isdigit():
+            fl = int(f.end.split("_")[0])
             if f.unit != "rows":
-                raise AnalysisError(
-                    "RANGE frames with numeric bounds are unsupported")
+                # UNBOUNDED PRECEDING .. v FOLLOWING by value
+                return f"range_bounded:{(1 << 62)}:{fl}"
             # UNBOUNDED PRECEDING .. f FOLLOWING: bounded with a huge
             # preceding span (partition sizes are < 2^31)
-            return f"rows_bounded:{(1 << 31) - 1}:{int(f.end.split('_')[0])}"
+            return f"rows_bounded:{(1 << 31) - 1}:{fl}"
         if f.end.endswith("_preceding") and f.end[0].isdigit():
             raise AnalysisError(
                 "frames ending before CURRENT ROW are unsupported")
@@ -1603,11 +1609,33 @@ class Planner:
                 okeys.append(L.SortKey(idx, o.ascending, nf))
             rec = {"part": part, "order": tuple(okeys)}
             name, frame = call.name, self.frame_mode(call)
-            if frame.startswith("rows_bounded") and \
+            if frame.startswith(("rows_bounded", "range_bounded")) and \
                     name not in ("sum", "count", "avg"):
                 raise AnalysisError(
-                    f"bounded ROWS frames support sum/count/avg "
+                    f"bounded ROWS/RANGE frames support sum/count/avg "
                     f"(not {name})")
+            if frame.startswith("range_bounded"):
+                # value-offset frames need ONE numeric sort key whose
+                # comparisons the kernel's binary search can run on
+                # int64 lanes (WindowOperator's RANGE frame contract);
+                # DECIMAL keys scale the bound to unscaled units
+                if len(okeys) != 1:
+                    raise AnalysisError(
+                        "RANGE frames with numeric bounds require "
+                        "exactly one ORDER BY key")
+                kdt = pre_cols[okeys[0].index][1]
+                if kdt.kind is TypeKind.DECIMAL:
+                    _, p_s, f_s = frame.split(":")
+                    mul = 10 ** kdt.scale
+                    cap = 1 << 62
+                    frame = (f"range_bounded:"
+                             f"{min(int(p_s) * mul, cap)}:"
+                             f"{min(int(f_s) * mul, cap)}")
+                elif kdt.kind not in (TypeKind.BIGINT, TypeKind.INTEGER,
+                                      TypeKind.DATE):
+                    raise AnalysisError(
+                        "RANGE frame bounds require an integer-valued "
+                        f"ORDER BY key (got {kdt.kind.name})")
             fields[call] = None
             if name in ("row_number", "rank", "dense_rank"):
                 rec["specs"] = [L.WinSpecNode(name, None, frame, 1, None,
@@ -2269,9 +2297,37 @@ class Planner:
             domains.append(d)
         if domains is not None:
             prod = math.prod(domains)
-            if prod <= MAX_DIRECT_GROUPS:
+            # stats-driven cutoff (GroupByHash.java:82-93's role): the
+            # direct strategy is a G-pass masked-reduction graph whose
+            # compile time AND runtime scale with G, so it only pays
+            # when groups are dense — many rows per group. The bound is
+            # session-tunable; estimated rows-per-group below 64 fall to
+            # the sort kernel (its cost is shape-, not G-, bound).
+            limit = int(self.properties.get("direct_agg_max_groups",
+                                            MAX_DIRECT_GROUPS))
+            limit = min(limit, MAX_DIRECT_GROUPS)
+            est = self._input_rows_estimate(pre_node)
+            if prod <= limit and (est is None or est >= prod * 64):
                 return "direct", tuple(domains), prod
         return "sort", (), self._sort_capacity(group_irs, scope, pre_node)
+
+    def _input_rows_estimate(self, pre_node) -> Optional[int]:
+        """Rough input-row bound for strategy choice: the largest scan
+        under the aggregate's input chain (filters only shrink it)."""
+        node = pre_node
+        while isinstance(node, (L.FilterNode, L.ProjectNode)):
+            node = node.child
+        from .fragmenter import _subtree_nodes
+        scans = [n for n in _subtree_nodes(node)
+                 if isinstance(n, L.ScanNode)]
+        if not scans:
+            return None
+        try:
+            return max(self.catalog.get_table(
+                s.catalog, s.schema_name, s.table).num_rows
+                for s in scans)
+        except Exception:      # noqa: BLE001 — stats are best-effort
+            return None
 
     def _sort_capacity(self, group_irs, scope: Scope, pre_node) -> int:
         """Size the sort-aggregation output from stats (NDV product capped

@@ -87,6 +87,11 @@ class Dispatcher:
         self.resource_groups = ResourceGroupManager(
             ResourceGroupConfig("root",
                                 hard_concurrency_limit=max_concurrency))
+        # security hooks (AccessControlManager's seat): authn gates the
+        # HTTP intake, authz runs at dispatch with resolved table refs
+        from .security import AllowAllAccessControl
+        self.authenticator = None            # None = open cluster
+        self.access_control = AllowAllAccessControl()
 
     def submit(self, sql: str, user: str) -> TrackedQuery:
         qid = self.tracker.next_query_id()
@@ -120,6 +125,17 @@ class Dispatcher:
                         if self.retry_policy == "QUERY" else 0)
         if not sm.transition("PLANNING"):
             return                        # canceled while queued
+        # authorization BEFORE any execution, with resolved table refs
+        # (DispatchManager.createQueryInternal's access-check step)
+        from .security import AccessDeniedError, check_statement_access
+        try:
+            check_statement_access(self.access_control, self.session,
+                                   tq.sql, tq.session_user)
+        except AccessDeniedError as e:
+            sm.fail(str(e))
+            return
+        except Exception:     # noqa: BLE001 — malformed SQL fails later
+            pass              # with its real parse/analysis error
         last_error: Optional[str] = None
         for attempt in range(attempts):
             if sm.is_done():
@@ -154,7 +170,12 @@ class Dispatcher:
                         tq.distributed = result is not None
                     if result is None and getattr(
                             self.session, "properties", {}).get(
-                            "require_distributed"):
+                            "require_distributed") and \
+                            tq.fallback_reason != \
+                            "coordinator-only statement":
+                        # SET SESSION/SHOW and friends never distribute
+                        # by design — erroring on them would brick the
+                        # very statement that turns the property off
                         raise QueryDeclinedError(
                             "require_distributed: cluster declined the "
                             f"query ({tq.fallback_reason})")
@@ -300,14 +321,43 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- routes -----------------------------------------------------------
 
+    def _authenticate(self):
+        """Returns the authenticated user, or None after sending 401.
+        Open clusters (no authenticator) pass the header user through."""
+        user = self.headers.get("X-Trino-User", "anonymous")
+        auth = self.state.dispatcher.authenticator
+        if auth is None:
+            return user
+        from .security import AuthenticationError
+        secret = self.headers.get("X-Trino-Password")
+        if secret is None:
+            bearer = self.headers.get("Authorization", "")
+            if bearer.startswith("Bearer "):
+                secret = bearer[len("Bearer "):]
+        try:
+            return auth.authenticate(user, secret)
+        except AuthenticationError as e:
+            self.send_response(401)
+            body = json.dumps(
+                {"error": {"message": str(e),
+                           "errorName": "AUTHENTICATION_FAILED"}}).encode()
+            self.send_header("Content-Type", "application/json")
+            self.send_header("WWW-Authenticate", "Basic")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return None
+
     def do_POST(self):
         path = urlparse(self.path).path
         if path == "/v1/statement":
+            user = self._authenticate()
+            if user is None:
+                return
             sql = self._read_body()
             if not sql.strip():
                 self._send(400, {"error": {"message": "empty statement"}})
                 return
-            user = self.headers.get("X-Trino-User", "anonymous")
             tq = self.state.dispatcher.submit(sql, user)
             self._send(200, self._query_payload(tq, 0))
             return
@@ -327,6 +377,10 @@ class _Handler(BaseHTTPRequestHandler):
                 "nodeVersion": {"version": "trino-tpu-0.1"},
                 "coordinator": True, "starting": False,
                 "uptime": time.time() - self.state.started_at})
+            return
+        # every other GET exposes query texts/results: authenticate
+        # (liveness /v1/info stays open, like the reference's /v1/status)
+        if self._authenticate() is None:
             return
         if path == "/v1/status":
             self._send(200, {"nodeId": "coordinator", "state": "ACTIVE"})
@@ -385,6 +439,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         path = urlparse(self.path).path
         parts = [p for p in path.split("/") if p]
+        if self._authenticate() is None:    # cancel/ack need credentials
+            return
         if len(parts) == 4 and parts[:3] == ["v1", "spooled", "segments"]:
             self.state.spooling.ack(parts[3])
             self._send(204, {})

@@ -1,0 +1,167 @@
+"""Authentication + access control (minimal production shape).
+
+Reference: the layered security stack — password authenticators
+(plugin/trino-password-authenticators), AccessControlManager dispatching
+to system access controls (security/AccessControlManager.java), and the
+file-based rules plugin (FileBasedSystemAccessControl). Here: a static
+password/token authenticator on the coordinator's HTTP intake, and a
+rule-list access control consulted at dispatch with the statement's
+RESOLVED table references (post-planning, so views/CTEs can't smuggle
+reads past the checker).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+
+class AccessDeniedError(RuntimeError):
+    """Authorization failure — never retried, surfaced to the client
+    (spi/security/AccessDeniedException.java)."""
+
+
+class AuthenticationError(RuntimeError):
+    """Credential failure — HTTP 401 at the protocol layer."""
+
+
+class PasswordAuthenticator:
+    """Static user -> secret map (the PasswordAuthenticator SPI shape;
+    file/LDAP backends would subclass). Secrets compare in constant
+    time."""
+
+    def __init__(self, credentials: dict):
+        self._creds = dict(credentials)
+
+    def authenticate(self, user: str, secret: Optional[str]) -> str:
+        import hmac
+        want = self._creds.get(user)
+        if want is None or secret is None or \
+                not hmac.compare_digest(str(want), str(secret)):
+            raise AuthenticationError(f"invalid credentials for {user!r}")
+        return user
+
+
+@dataclass(frozen=True)
+class AccessRule:
+    """One allow/deny rule; glob patterns per part
+    (FileBasedSystemAccessControl's catalog/schema/table rules)."""
+    user: str = "*"
+    catalog: str = "*"
+    schema: str = "*"
+    table: str = "*"
+    privileges: Tuple[str, ...] = ("select", "write")
+    allow: bool = True
+
+    def matches(self, user, catalog, schema, table, privilege) -> bool:
+        return (fnmatch.fnmatchcase(user, self.user) and
+                fnmatch.fnmatchcase(catalog, self.catalog) and
+                fnmatch.fnmatchcase(schema, self.schema) and
+                fnmatch.fnmatchcase(table, self.table) and
+                privilege in self.privileges)
+
+
+class AllowAllAccessControl:
+    """Default: open cluster (AllowAllSystemAccessControl)."""
+
+    def check(self, user, catalog, schema, table, privilege) -> None:
+        pass
+
+
+class RuleAccessControl:
+    """First-match-wins rule list; NO match denies (the reference's
+    file-based control denies whatever the rules don't grant)."""
+
+    def __init__(self, rules: List[AccessRule]):
+        self.rules = list(rules)
+
+    def check(self, user, catalog, schema, table, privilege) -> None:
+        for r in self.rules:
+            if r.matches(user, catalog, schema, table, privilege):
+                if r.allow:
+                    return
+                break
+        raise AccessDeniedError(
+            f"Access Denied: user {user!r} cannot {privilege} "
+            f"{catalog}.{schema}.{table}")
+
+
+def statement_table_refs(session, sql: str):
+    """(privilege, catalog, schema, table) references of a statement,
+    resolved through the planner (scans of the final plan, not raw AST
+    names — CTEs/derived tables resolve first). DML adds a write ref on
+    its target."""
+    from ..planner import logical as L
+    from ..planner.fragmenter import _subtree_nodes
+    from ..sql import ast_nodes as A
+    from ..sql.parser import parse
+    stmt = parse(sql)
+    refs = []
+
+    def scan_refs(node):
+        for n in _subtree_nodes(node):
+            if isinstance(n, L.ScanNode):
+                refs.append(("select", n.catalog, n.schema_name, n.table))
+
+    def qualify(name_parts):
+        parts = list(name_parts)
+        if len(parts) == 3:
+            return parts
+        if len(parts) == 2:
+            return [session.default_cat] + parts
+        return [session.default_cat, session.default_schema] + parts
+
+    if isinstance(stmt, (A.Query, A.SetOp, A.Values)):
+        rel = session.planner().plan_query(stmt)
+        scan_refs(rel.node)
+    elif isinstance(stmt, (A.InsertInto, A.Update, A.Delete,
+                           A.MergeInto, A.CreateTable, A.DropTable)):
+        target = getattr(stmt, "table", None) or \
+            getattr(stmt, "target", None)
+        if target is not None:
+            parts = qualify(target if isinstance(target, (list, tuple))
+                            else str(target).split("."))
+            refs.append(("write", *parts))
+        inner = getattr(stmt, "query", None)
+        if isinstance(inner, (A.Query, A.SetOp, A.Values)):
+            rel = session.planner().plan_query(inner)
+            scan_refs(rel.node)
+        # MERGE's USING relation (and any relation AST) is READ: wrap it
+        # in a trivial query so the planner resolves its table refs —
+        # a denied table must not leak through the source side
+        src = getattr(stmt, "source", None)
+        if isinstance(src, A.Node) and not isinstance(src, (A.Query,)):
+            if isinstance(src, A.TableRef):
+                refs.append(("select", *qualify(src.name)))
+            else:
+                for n in _ast_subtree(src):
+                    if isinstance(n, A.TableRef):
+                        refs.append(("select", *qualify(n.name)))
+        elif isinstance(src, A.Query):
+            rel = session.planner().plan_query(src)
+            scan_refs(rel.node)
+    # SET SESSION / SHOW / EXPLAIN etc: no table privileges involved
+    return refs
+
+
+def _ast_subtree(node):
+    import dataclasses
+    yield node
+    if dataclasses.is_dataclass(node):
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            items = v if isinstance(v, tuple) else (v,)
+            for it in items:
+                if dataclasses.is_dataclass(it):
+                    yield from _ast_subtree(it)
+
+
+def check_statement_access(access_control, session, sql: str,
+                           user: str) -> None:
+    """Dispatch-time authorization (DispatchManager.createQueryInternal's
+    access-check step). Raises AccessDeniedError."""
+    if isinstance(access_control, AllowAllAccessControl):
+        return
+    for privilege, cat, sch, tbl in statement_table_refs(session, sql):
+        access_control.check(user, cat, sch, tbl, privilege)

@@ -404,15 +404,11 @@ class TaskManager:
         root = fragment["root"]
         deadline = _time.time() + float(fragment.get("timeout_s", 300.0))
 
-        def remote_nodes(n):
-            if isinstance(n, L.RemoteSourceNode):
-                yield n
-            for c in L.children(n):
-                yield from remote_nodes(c)
-
+        from ..planner.fragmenter import _subtree_nodes
         by_fid = {}
-        for n in remote_nodes(root):
-            by_fid.setdefault(n.fragment_id, []).append(n)
+        for n in _subtree_nodes(root):
+            if isinstance(n, L.RemoteSourceNode):
+                by_fid.setdefault(n.fragment_id, []).append(n)
         batches = {}
         for fid_str, srcs in task.sources.items():
             fid = int(fid_str)

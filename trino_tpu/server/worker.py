@@ -87,6 +87,11 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             buffer = int(parts[4]) if len(parts) == 6 else 0
             token = int(parts[-1])
             binary = "x-trino-pages" in self.headers.get("Accept", "")
+            # only bookkeeping under the lock: P concurrent consumer
+            # pulls + the producer's _emit all contend on it, so socket
+            # writes must happen after release
+            frame = None
+            envelope = None
             with task.lock:
                 pages = task.buffers.setdefault(buffer, [])
                 acked = task.acked.get(buffer, 0)
@@ -101,22 +106,26 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 idx = token - acked
                 total = acked + len(pages)
                 if 0 <= idx < len(pages):
-                    if binary:
-                        self._send_page(pages[idx],
-                                        {"X-Trino-Token": token,
-                                         "X-Trino-Complete": "false"})
-                    else:
-                        import base64
-                        self._send(200, {
-                            "token": token, "complete": False,
-                            "page": {"b64": base64.b64encode(
-                                pages[idx]).decode()}})
-                    return
-                done = task.state in ("FINISHED", "FAILED", "CANCELED")
-                self._send(200, {"token": token,
-                                 "complete": done and token >= total,
-                                 "state": task.state, "error": task.error,
-                                 "page": None})
+                    frame = pages[idx]
+                else:
+                    done = task.state in ("FINISHED", "FAILED",
+                                          "CANCELED")
+                    envelope = {"token": token,
+                                "complete": done and token >= total,
+                                "state": task.state,
+                                "error": task.error, "page": None}
+            if frame is not None:
+                if binary:
+                    self._send_page(frame, {"X-Trino-Token": token,
+                                            "X-Trino-Complete": "false"})
+                else:
+                    import base64
+                    self._send(200, {
+                        "token": token, "complete": False,
+                        "page": {"b64": base64.b64encode(
+                            frame).decode()}})
+            else:
+                self._send(200, envelope)
             return
         self._send(404, {"error": f"no route {path}"})
 
