@@ -53,11 +53,11 @@ def test_parquet_codecs_from_pyarrow(tmp_path, codec):
     assert valids[3].tolist() == [True, False, True, True]
 
 
-def test_parquet_zstd_rejected_loudly(tmp_path):
+def test_parquet_zstd_mixed_table(tmp_path):
     path = str(tmp_path / "t.parquet")
     pq.write_table(_mixed_table(), path, compression="zstd")
-    with pytest.raises(ValueError, match="codec"):
-        read_parquet(path)
+    names, cols, valids, _ = read_parquet(path)
+    assert names and len(cols[0]) == _mixed_table().num_rows
 
 
 def test_parquet_row_group_pruning_from_stats(tmp_path):
@@ -177,3 +177,120 @@ def test_parquet_list_through_connector(tmp_path):
     r = s.execute("SELECT id, x FROM pq.s.t, UNNEST(xs) AS u(x) "
                   "ORDER BY id, x")
     assert r.rows == [(1, 5), (1, 6), (3, 7)]
+
+
+def test_orc_writer_roundtrip_and_pyarrow(tmp_path):
+    """Round-4 verdict item #10: ORC write parity — our writer's files
+    read back identically through BOTH our reader and pyarrow."""
+    import decimal
+
+    import numpy as np
+    import pyarrow.orc as po
+
+    from trino_tpu.formats.orc import read_orc, write_orc
+    p = str(tmp_path / "w.orc")
+    n = 4000
+    rng = np.random.default_rng(5)
+    names = ["i", "f", "s", "dec", "day", "b"]
+    cols = [rng.integers(-1 << 40, 1 << 40, n),
+            rng.normal(size=n),
+            np.asarray([f"v{i % 13}" for i in range(n)], dtype=object),
+            rng.integers(-10**12, 10**12, n),
+            rng.integers(0, 20000, n).astype(np.int32),
+            rng.integers(0, 2, n).astype(bool)]
+    valids = [None, (np.arange(n) % 7 != 0), None, None, None, None]
+    logicals = [None, None, None, ("decimal", 18, 4), ("date",), None]
+    write_orc(p, names, cols, valids, logicals,
+              stripe_rows=1500)                  # multi-stripe
+    ns, cs, vs, lg = read_orc(p)
+    assert ns == names
+    assert np.array_equal(cs[0], cols[0])
+    m = valids[1]
+    assert np.allclose(cs[1][m], cols[1][m]) and np.array_equal(vs[1], m)
+    assert list(cs[2]) == list(cols[2])
+    assert np.array_equal(cs[3], cols[3]) and lg[3] == ("decimal", 18, 4)
+    assert np.array_equal(cs[4], cols[4]) and lg[4] == ("date",)
+    assert np.array_equal(cs[5], cols[5])
+
+    t = po.read_table(p)
+    assert t.num_rows == n
+    assert t.column("i").to_pylist() == cols[0].tolist()
+    f_got = t.column("f").to_pylist()
+    assert f_got[0] is None and abs(f_got[1] - cols[1][1]) < 1e-12
+    assert t.column("dec").to_pylist()[0] == \
+        decimal.Decimal(int(cols[3][0])).scaleb(-4)
+
+
+def test_orc_timestamp_read_from_pyarrow(tmp_path):
+    """TIMESTAMP columns decode (seconds-from-2015 + nanos trick)."""
+    import datetime
+
+    import pyarrow as pa
+    import pyarrow.orc as po
+
+    from trino_tpu.formats.orc import read_orc
+    ts = [datetime.datetime(2021, 3, 4, 5, 6, 7, 250000),
+          datetime.datetime(1999, 12, 31, 23, 59, 59, 1),
+          datetime.datetime(2015, 1, 1, 0, 0, 0, 0),
+          None]
+    p = str(tmp_path / "ts.orc")
+    po.write_table(pa.table({"t": pa.array(ts, pa.timestamp("us"))}), p)
+    ns, cs, vs, lg = read_orc(p)
+    assert lg[0] == ("timestamp",)
+    epoch = datetime.datetime(1970, 1, 1)
+    for i, want in enumerate(ts[:3]):
+        assert int(cs[0][i]) == int(
+            (want - epoch).total_seconds() * 1_000_000), (i, want)
+    assert not vs[0][3] and all(vs[0][:3])
+
+
+def test_parquet_zstd_read(tmp_path):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from trino_tpu.formats.parquet import read_parquet
+    p = str(tmp_path / "z.parquet")
+    vals = np.arange(50_000, dtype=np.int64) * 7
+    pq.write_table(pa.table({"x": vals}), p, compression="zstd")
+    names, cols, valids, _ = read_parquet(p)
+    assert names == ["x"]
+    assert np.array_equal(cols[0], vals)
+
+
+def test_orc_zstd_read(tmp_path):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.orc as po
+
+    from trino_tpu.formats.orc import read_orc
+    p = str(tmp_path / "z.orc")
+    vals = np.arange(50_000, dtype=np.int64) * 3
+    po.write_table(pa.table({"x": vals}), p, compression="zstd")
+    ns, cs, vs, lg = read_orc(p)
+    assert np.array_equal(cs[0], vals)
+
+
+def test_orc_connector_export_roundtrip(tmp_path):
+    """Engine table -> ORC file -> engine table, through the orcdir
+    connector pair (export_table/load_orc) — SQL-level write parity."""
+    from trino_tpu.connectors.orcdir import export_table, load_orc
+    from trino_tpu.exec.session import Session
+    s = Session(default_schema="tiny")
+    t = s.catalog.get_table("tpch", "tiny", "nation")
+    p = str(tmp_path / "nation.orc")
+    export_table(t, p)
+    back = load_orc(p, "nation")
+    assert [f.name for f in back.schema] == [f.name for f in t.schema]
+    for i, f in enumerate(t.schema):
+        a, b = np.asarray(t.columns[i]), np.asarray(back.columns[i])
+        if f.dictionary is not None:
+            ap = np.array(f.dictionary, dtype=object)[a]
+            bp = np.array(back.schema.fields[i].dictionary,
+                          dtype=object)[b]
+            assert list(ap) == list(bp)
+        else:
+            assert np.array_equal(a, b)
+    # and pyarrow can read the exported file
+    import pyarrow.orc as po
+    assert po.read_table(p).num_rows == t.num_rows

@@ -98,3 +98,35 @@ class OrcConnector:
 
     def get_table_schema(self, schema: str, table: str) -> Schema:
         return self.get_table(schema, table).schema
+
+
+def export_table(data: TableData, path: str) -> None:
+    """Engine TableData -> ORC file (formats/orc.py write_orc):
+    dictionary codes decode back to strings; DECIMAL/DATE carry their
+    logical annotations so a round trip reconstructs the engine types.
+    The write-parity twin of parquetdir.export_table
+    (lib/trino-orc OrcWriter.java's role)."""
+    from ..formats.orc import write_orc
+    names, arrays, valids, logicals = [], [], [], []
+    for i, f in enumerate(data.schema):
+        names.append(f.name)
+        col = np.asarray(data.columns[i])
+        valid = None if data.valids is None else data.valids[i]
+        logical = None
+        if f.dtype.kind is TypeKind.ARRAY:
+            raise ValueError(
+                f"{data.name}.{f.name}: ARRAY columns cannot be "
+                "exported to ORC yet")
+        if f.dtype.kind is TypeKind.VARCHAR:
+            pool = np.array(f.dictionary, dtype=object)
+            col = pool[col]
+        elif f.dtype.kind is TypeKind.DECIMAL:
+            col = col.astype(np.int64)
+            logical = ("decimal", f.dtype.precision, f.dtype.scale)
+        elif f.dtype.kind is TypeKind.DATE:
+            col = col.astype(np.int32)
+            logical = ("date",)
+        arrays.append(col)
+        valids.append(None if valid is None else np.asarray(valid))
+        logicals.append(logical)
+    write_orc(path, names, arrays, valids, logicals)

@@ -127,6 +127,10 @@ def _decompress_stream(kind: int, data: bytes) -> bytes:
             out += snappy_decompress(chunk)
         elif kind == C_LZ4:
             out += lz4_raw_decompress(chunk, -1)
+        elif kind == C_ZSTD:
+            import zstandard
+            out += zstandard.ZstdDecompressor().decompress(
+                chunk, max_output_size=1 << 26)
         else:
             raise ValueError(f"unsupported ORC compression kind {kind}")
     return bytes(out)
@@ -325,7 +329,7 @@ def read_orc_file(path: str) -> OrcFile:
     names = [n.decode() for n in root.get(3, [])]
     for cid in child_ids:
         k = types[cid].get(1, [None])[0]
-        if k in (K_LIST, K_MAP, K_UNION, K_TIMESTAMP, K_BINARY):
+        if k in (K_LIST, K_MAP, K_UNION, K_BINARY):
             raise ValueError(f"unsupported ORC column kind {k}")
 
     stripes = [pb_decode(s) for s in footer.get(3, [])]
@@ -383,6 +387,8 @@ def read_orc_file(path: str) -> OrcFile:
                              types[cid].get(6, [0])[0]))
         elif kind == K_DATE:
             logicals.append(("date",))
+        elif kind == K_TIMESTAMP:
+            logicals.append(("timestamp",))
         else:
             logicals.append(None)
     return OrcFile(names, columns, valids, logicals)
@@ -447,6 +453,22 @@ def _read_column(kind, enc, dict_size, streams, comp, n_rows, tmeta):
             s = int(scales[i])
             vals.append(v * (10 ** (scale - s)) if s != scale else v)
         vals_p = np.asarray(vals, dtype=np.int64)
+    elif kind == K_TIMESTAMP:
+        # DATA = seconds from 2015-01-01 UTC (signed RLE); SECONDARY =
+        # nanos with the trailing-zero trick (low 3 bits k != 0 =>
+        # nanos = (v >> 3) * 10^(k+2)). Engine lanes are microseconds.
+        secs = rle_ints(data, n_present).astype(np.int64)
+        sec_raw = _decompress_stream(comp, streams.get(S_SECONDARY,
+                                                       b""))
+        nraw = rle_ints(sec_raw, n_present, signed=False).astype(
+            np.int64)
+        # low 3 bits k != 0 => (k+1) trailing zeros were stripped
+        # (verified against pyarrow: 1000ns -> (1<<3)|2, 2.5e8 -> 25|6)
+        zeros = nraw & 7
+        nanos = np.where(zeros == 0, nraw >> 3,
+                         (nraw >> 3) * np.power(10, zeros + 1))
+        base = 1420070400      # 2015-01-01T00:00:00Z
+        vals_p = (secs + base) * 1_000_000 + nanos // 1000
     else:
         raise ValueError(f"unsupported ORC column kind {kind}")
 
@@ -458,3 +480,200 @@ def _read_column(kind, enc, dict_size, streams, comp, n_rows, tmeta):
         full = np.zeros(n_rows, dtype=vals_p.dtype)
     full[valid] = vals_p
     return full, valid
+
+
+# --------------------------------------------------------------------------
+# writer — minimal valid ORC (RLE v1 / DIRECT encodings, NONE compression)
+# Reference role: lib/trino-orc OrcWriter.java. The simplest spec-legal
+# encodings are chosen for writability; any conforming reader (including
+# this module's own and pyarrow's) decodes them.
+# --------------------------------------------------------------------------
+
+def _pb_varint_enc(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def pb_encode(fields: Dict[int, list]) -> bytes:
+    """Inverse of pb_decode: {field id: [int | bytes, ...]} -> proto2
+    wire bytes (varint for ints, length-delimited for bytes)."""
+    out = bytearray()
+    for fid in sorted(fields):
+        for v in fields[fid]:
+            if isinstance(v, (bytes, bytearray)):
+                out += _pb_varint_enc((fid << 3) | 2)
+                out += _pb_varint_enc(len(v))
+                out += v
+            else:
+                out += _pb_varint_enc((fid << 3) | 0)
+                out += _pb_varint_enc(int(v))
+    return bytes(out)
+
+
+def _zz_enc(v: int) -> int:
+    return (v << 1) ^ (v >> 63) if v < 0 else v << 1
+
+
+def _rle_v1_ints(vals, signed=True) -> bytes:
+    """Integer RLE v1, all-literal runs (header = -(n) as signed byte,
+    then n base-128 varints, zigzag when signed)."""
+    out = bytearray()
+    vals = [int(v) for v in vals]
+    for i in range(0, len(vals), 128):
+        group = vals[i:i + 128]
+        out.append(256 - len(group))          # -n two's complement
+        for v in group:
+            out += _pb_varint_enc(_zz_enc(v) if signed else v)
+    return bytes(out)
+
+
+def _byte_rle_enc(data: bytes) -> bytes:
+    """Byte RLE, all-literal runs."""
+    out = bytearray()
+    for i in range(0, len(data), 128):
+        group = data[i:i + 128]
+        out.append(256 - len(group))
+        out += group
+    return bytes(out)
+
+
+def _bool_rle_enc(bits: np.ndarray) -> bytes:
+    packed = np.packbits(bits.astype(np.uint8))
+    return _byte_rle_enc(packed.tobytes())
+
+
+def write_orc(path: str, names, columns, valids=None, logicals=None,
+              stripe_rows: int = 1 << 20) -> None:
+    """Write columns to an ORC file. Types map from numpy dtypes unless
+    `logicals[i]` overrides: ("decimal", p, s) or ("date",). Strings
+    pass as object/str arrays. NULLs via `valids` boolean masks."""
+    n = len(columns[0]) if columns else 0
+    valids = valids or [None] * len(columns)
+    logicals = logicals or [None] * len(columns)
+
+    def orc_kind(i):
+        lg = logicals[i]
+        if lg is not None:
+            if lg[0] == "decimal":
+                return K_DECIMAL
+            if lg[0] == "date":
+                return K_DATE
+        a = columns[i]
+        if a.dtype == np.bool_:
+            return K_BOOLEAN
+        if np.issubdtype(a.dtype, np.integer):
+            return K_INT if a.dtype.itemsize <= 4 else K_LONG
+        if np.issubdtype(a.dtype, np.floating):
+            return K_DOUBLE
+        return K_STRING
+
+    kinds = [orc_kind(i) for i in range(len(columns))]
+
+    body = bytearray(b"ORC")
+    stripe_infos = []
+    for start in range(0, max(n, 1), stripe_rows):
+        count = min(stripe_rows, n - start)
+        if count <= 0 and n > 0:
+            break
+        streams = []        # (kind, col_id, bytes)
+        encodings = [{1: [E_DIRECT]}]          # root struct
+        for ci, arr in enumerate(columns):
+            cid = ci + 1
+            a = arr[start:start + count]
+            v = None if valids[ci] is None else \
+                np.asarray(valids[ci][start:start + count], dtype=bool)
+            if v is not None and not v.all():
+                streams.append((S_PRESENT, cid, _bool_rle_enc(v)))
+                sel = v
+            else:
+                sel = np.ones(count, dtype=bool)
+                v = None
+            present_vals = a[sel] if v is not None else a
+            k = kinds[ci]
+            enc = {1: [E_DIRECT]}
+            if k == K_BOOLEAN:
+                streams.append((S_DATA, cid, _bool_rle_enc(
+                    np.asarray(present_vals, dtype=bool))))
+            elif k in (K_INT, K_LONG, K_DATE):
+                streams.append((S_DATA, cid,
+                                _rle_v1_ints(present_vals)))
+            elif k == K_DOUBLE:
+                streams.append((S_DATA, cid, np.asarray(
+                    present_vals, dtype="<f8").tobytes()))
+            elif k == K_DECIMAL:
+                out = bytearray()
+                for x in present_vals:
+                    out += _pb_varint_enc(_zz_enc(int(x)))
+                streams.append((S_DATA, cid, bytes(out)))
+                scale = logicals[ci][2]
+                streams.append((S_SECONDARY, cid, _rle_v1_ints(
+                    [scale] * len(present_vals))))
+            else:                               # strings
+                strs = [("" if s is None else str(s)).encode()
+                        for s in present_vals]
+                streams.append((S_DATA, cid, b"".join(strs)))
+                streams.append((S_LENGTH, cid, _rle_v1_ints(
+                    [len(s) for s in strs], signed=False)))
+            encodings.append(enc)
+
+        offset = len(body)
+        data_len = 0
+        stream_msgs = []
+        for skind, cid, blob in streams:
+            body += blob
+            data_len += len(blob)
+            stream_msgs.append(pb_encode(
+                {1: [skind], 2: [cid], 3: [len(blob)]}))
+        sfooter = pb_encode({
+            1: [bytes(m) for m in stream_msgs],
+            2: [pb_encode(e) for e in encodings],
+        })
+        body += sfooter
+        stripe_infos.append(pb_encode({
+            1: [offset], 2: [0], 3: [data_len], 4: [len(sfooter)],
+            5: [count]}))
+        if n == 0:
+            break
+
+    # footer: type tree (root STRUCT + one child per column)
+    types = [pb_encode({1: [K_STRUCT],
+                        2: list(range(1, len(columns) + 1)),
+                        3: [nm.encode() for nm in names]})]
+    for ci in range(len(columns)):
+        t = {1: [kinds[ci]]}
+        if kinds[ci] == K_DECIMAL:
+            t[5] = [logicals[ci][1]]
+            t[6] = [logicals[ci][2]]
+        types.append(pb_encode(t))
+    footer = pb_encode({
+        1: [len(body)],                        # headerLength.. content
+        2: [len(body)],
+        3: stripe_infos,
+        4: types,
+        6: [n],                                # numberOfRows
+        8: [10000],                            # rowIndexStride
+    })
+    body += footer
+    ps = pb_encode({
+        1: [len(footer)],
+        2: [C_NONE],
+        3: [262144],
+        4: [0, 12],                            # version 0.12
+        5: [0],                                # metadataLength
+        6: [6],                                # writerVersion
+        8000: [b"ORC"],
+    })
+    body += ps
+    body.append(len(ps))
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(bytes(body))
+    import os
+    os.replace(tmp, path)

@@ -163,6 +163,10 @@ def decompress(codec: int, data: bytes, out_len: int) -> bytes:
         return snappy_decompress(data)
     if codec == CODEC_GZIP:
         return zlib.decompress(data, wbits=zlib.MAX_WBITS | 32)
+    if codec == CODEC_ZSTD:
+        import zstandard
+        return zstandard.ZstdDecompressor().decompress(
+            data, max_output_size=max(out_len, 1 << 20))
     if codec == CODEC_LZ4_RAW:
         return lz4_raw_decompress(data, out_len)
     raise ValueError(
