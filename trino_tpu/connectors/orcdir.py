@@ -44,6 +44,10 @@ def load_orc(path: str, name: str) -> TableData:
         elif logical is not None and logical[0] == "date":
             arrays.append(np.asarray(col, dtype=np.int32))
             fields.append(Field(cname, DATE))
+        elif logical is not None and logical[0] == "timestamp":
+            from ..types import TIMESTAMP
+            arrays.append(np.asarray(col, dtype=np.int64))
+            fields.append(Field(cname, TIMESTAMP))
         elif col.dtype == np.bool_:
             arrays.append(np.asarray(col))
             fields.append(Field(cname, BOOLEAN))
@@ -101,32 +105,10 @@ class OrcConnector:
 
 
 def export_table(data: TableData, path: str) -> None:
-    """Engine TableData -> ORC file (formats/orc.py write_orc):
-    dictionary codes decode back to strings; DECIMAL/DATE carry their
-    logical annotations so a round trip reconstructs the engine types.
-    The write-parity twin of parquetdir.export_table
-    (lib/trino-orc OrcWriter.java's role)."""
+    """Engine TableData -> ORC file (formats/orc.py write_orc), the
+    write-parity twin of parquetdir.export_table (lib/trino-orc
+    OrcWriter.java's role); flattening is shared with the parquet
+    exporter."""
     from ..formats.orc import write_orc
-    names, arrays, valids, logicals = [], [], [], []
-    for i, f in enumerate(data.schema):
-        names.append(f.name)
-        col = np.asarray(data.columns[i])
-        valid = None if data.valids is None else data.valids[i]
-        logical = None
-        if f.dtype.kind is TypeKind.ARRAY:
-            raise ValueError(
-                f"{data.name}.{f.name}: ARRAY columns cannot be "
-                "exported to ORC yet")
-        if f.dtype.kind is TypeKind.VARCHAR:
-            pool = np.array(f.dictionary, dtype=object)
-            col = pool[col]
-        elif f.dtype.kind is TypeKind.DECIMAL:
-            col = col.astype(np.int64)
-            logical = ("decimal", f.dtype.precision, f.dtype.scale)
-        elif f.dtype.kind is TypeKind.DATE:
-            col = col.astype(np.int32)
-            logical = ("date",)
-        arrays.append(col)
-        valids.append(None if valid is None else np.asarray(valid))
-        logicals.append(logical)
-    write_orc(path, names, arrays, valids, logicals)
+    from .parquetdir import flatten_table
+    write_orc(path, *flatten_table(data, "ORC"))

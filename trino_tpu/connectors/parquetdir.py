@@ -104,10 +104,11 @@ def load_parquet(path: str, name: str) -> TableData:
                      valids=out_valids)
 
 
-def export_table(data: TableData, path: str) -> None:
-    """Engine TableData -> parquet file: dictionary codes decode back to
-    strings; DECIMAL/DATE columns carry converted-type annotations so a
-    round trip reconstructs the exact engine types."""
+def flatten_table(data: TableData, fmt: str):
+    """Engine TableData -> (names, arrays, valids, logicals) for a
+    columnar file writer: dictionary codes decode back to strings;
+    DECIMAL/DATE carry logical annotations so a round trip reconstructs
+    the exact engine types. Shared by the parquet and ORC exporters."""
     names, arrays, valids, logicals = [], [], [], []
     for i, f in enumerate(data.schema):
         names.append(f.name)
@@ -115,11 +116,11 @@ def export_table(data: TableData, path: str) -> None:
         valid = None if data.valids is None else data.valids[i]
         logical = None
         if f.dtype.kind is TypeKind.ARRAY:
-            # the flat writer cannot represent repeated leaves; silent
+            # the flat writers cannot represent repeated leaves; silent
             # code-column output would corrupt a round trip
             raise ValueError(
                 f"{data.name}.{f.name}: ARRAY columns cannot be "
-                "exported to parquet yet")
+                f"exported to {fmt} yet")
         if f.dtype.kind is TypeKind.VARCHAR:
             pool = np.array(f.dictionary, dtype=object)
             col = pool[col]
@@ -132,7 +133,12 @@ def export_table(data: TableData, path: str) -> None:
         arrays.append(col)
         valids.append(None if valid is None else np.asarray(valid))
         logicals.append(logical)
-    write_parquet(path, names, arrays, valids, logicals)
+    return names, arrays, valids, logicals
+
+
+def export_table(data: TableData, path: str) -> None:
+    """Engine TableData -> parquet file."""
+    write_parquet(path, *flatten_table(data, "parquet"))
 
 
 class ParquetConnector:

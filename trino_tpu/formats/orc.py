@@ -1,19 +1,24 @@
-"""ORC reader — pure numpy, no external dependencies.
+"""ORC reader + writer — from scratch (numpy; zstandard only for the
+optional ZSTD codec).
 
-Reference: lib/trino-orc (reader/OrcRecordReader.java:83, the stripe /
-stream / RLE decoding stack). Coverage, built from the ORC v1 spec:
+Reference: lib/trino-orc (reader/OrcRecordReader.java:83 and
+OrcWriter.java, the stripe / stream / RLE stack). Coverage, built from
+the ORC v1 spec:
 
-- protobuf wire decoding for PostScript / Footer / StripeFooter metadata
-  (a small generic field->values reader; ORC metadata is plain proto2)
-- compression kinds NONE / ZLIB (raw deflate) / SNAPPY / LZ4, applied
-  per ORC's 3-byte chunk framing (header = length << 1 | isOriginal)
+- protobuf wire decoding/encoding for PostScript / Footer /
+  StripeFooter metadata (ORC metadata is plain proto2)
+- compression kinds NONE / ZLIB (raw deflate) / SNAPPY / LZ4 / ZSTD,
+  applied per ORC's 3-byte chunk framing (header = len << 1|isOriginal)
 - column types BOOLEAN / BYTE / SHORT / INT / LONG / FLOAT / DOUBLE /
-  STRING / VARCHAR / CHAR / DATE / DECIMAL (<=18 digits) inside a
-  top-level STRUCT; LIST/MAP/UNION/TIMESTAMP are rejected loudly
+  STRING / VARCHAR / CHAR / DATE / DECIMAL (<=18 digits) / TIMESTAMP
+  inside a top-level STRUCT; LIST/MAP/UNION are rejected loudly
 - integer RLE v1 and v2 (SHORT_REPEAT / DIRECT / PATCHED_BASE / DELTA),
   boolean/byte RLE for presence bits, string DIRECT_V2 and
   DICTIONARY_V2 encodings
 - multiple stripes; NULLs via PRESENT streams
+- writer: RLE v1 / DIRECT encodings, NONE compression, multi-stripe —
+  the simplest spec-legal choices, readable by any conforming reader
+  (pyarrow-verified)
 """
 
 from __future__ import annotations
@@ -24,7 +29,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .parquet import lz4_raw_decompress, snappy_decompress
+from .parquet import (_zstd_decompress, lz4_raw_decompress,
+                      snappy_decompress)
 
 # compression kinds (PostScript field 2)
 C_NONE, C_ZLIB, C_SNAPPY, C_LZO, C_LZ4, C_ZSTD = 0, 1, 2, 3, 4, 5
@@ -128,9 +134,7 @@ def _decompress_stream(kind: int, data: bytes) -> bytes:
         elif kind == C_LZ4:
             out += lz4_raw_decompress(chunk, -1)
         elif kind == C_ZSTD:
-            import zstandard
-            out += zstandard.ZstdDecompressor().decompress(
-                chunk, max_output_size=1 << 26)
+            out += _zstd_decompress(chunk, 1 << 26)
         else:
             raise ValueError(f"unsupported ORC compression kind {kind}")
     return bytes(out)
@@ -653,8 +657,8 @@ def write_orc(path: str, names, columns, valids=None, logicals=None,
             t[6] = [logicals[ci][2]]
         types.append(pb_encode(t))
     footer = pb_encode({
-        1: [len(body)],                        # headerLength.. content
-        2: [len(body)],
+        1: [3],                                # headerLength: "ORC" magic
+        2: [len(body)],                        # contentLength
         3: stripe_infos,
         4: types,
         6: [n],                                # numberOfRows

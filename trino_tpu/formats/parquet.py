@@ -1,4 +1,5 @@
-"""Parquet reader/writer — pure numpy, no external dependencies.
+"""Parquet reader/writer — from scratch (numpy; zstandard only for the
+optional ZSTD codec).
 
 Reference: lib/trino-parquet (reader/ParquetReader.java:103, writer/) —
 the columnar file format tier. Coverage:
@@ -10,9 +11,8 @@ the columnar file format tier. Coverage:
 - dictionary-encoded pages (PLAIN_DICTIONARY / RLE_DICTIONARY) on read
 - codecs: UNCOMPRESSED always; SNAPPY and LZ4_RAW via from-scratch
   block decoders (the two formats are byte-oriented LZ77 variants);
-  GZIP/ZLIB via the stdlib. ZSTD/BROTLI are rejected loudly (no
-  library in this environment and the formats are not reimplementable
-  in reasonable space).
+  GZIP/ZLIB via the stdlib; ZSTD via the optional zstandard package
+  (loud error when absent). BROTLI is rejected loudly.
 - multiple row groups; per-chunk min/max statistics on write; row-group
   skipping from statistics given predicate ranges (the reader-side
   analog of trino-parquet's predicate pushdown,
@@ -56,6 +56,23 @@ PAGE_DATA, PAGE_INDEX, PAGE_DICTIONARY, PAGE_DATA_V2 = 0, 1, 2, 3
 # --------------------------------------------------------------------------
 # codecs
 # --------------------------------------------------------------------------
+
+try:
+    import zstandard as _zstandard
+except Exception:                    # pragma: no cover — optional codec
+    _zstandard = None
+
+
+def _zstd_decompress(data: bytes, max_out: int) -> bytes:
+    """ZSTD via the optional zstandard package; loud, actionable error
+    when it is absent (shared by the parquet and ORC readers)."""
+    if _zstandard is None:
+        raise ValueError(
+            "ZSTD-compressed file but the zstandard package is not "
+            "installed")
+    return _zstandard.ZstdDecompressor().decompress(
+        data, max_output_size=max_out)
+
 
 def snappy_decompress(data: bytes) -> bytes:
     """Snappy block format (format_description.txt): uvarint output
@@ -164,9 +181,7 @@ def decompress(codec: int, data: bytes, out_len: int) -> bytes:
     if codec == CODEC_GZIP:
         return zlib.decompress(data, wbits=zlib.MAX_WBITS | 32)
     if codec == CODEC_ZSTD:
-        import zstandard
-        return zstandard.ZstdDecompressor().decompress(
-            data, max_output_size=max(out_len, 1 << 20))
+        return _zstd_decompress(data, max(out_len, 1 << 20))
     if codec == CODEC_LZ4_RAW:
         return lz4_raw_decompress(data, out_len)
     raise ValueError(
