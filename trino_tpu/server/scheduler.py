@@ -41,6 +41,49 @@ class TaskFailedError(RuntimeError):
     pass
 
 
+def _merge_sorted_runs(sort_node, pages):
+    """Order-preserving n-way merge of sorted page runs by the sort
+    keys (operator/MergeOperator.java + MergeHashSort's role: a
+    priority queue over per-run cursors — each page is one split's
+    independently sorted output). Returns (arrays, valids)."""
+    import heapq
+
+    from .tasks import decode_columns
+    runs = []
+    for p in pages:
+        arrs, vals = decode_columns(p)
+        if len(arrs) and len(arrs[0]):
+            runs.append((arrs, vals))
+    if not runs:
+        return [], []
+    keys = sort_node.keys
+
+    def run_iter(ri, arrs, vals):
+        n = len(arrs[0])
+        for i in range(n):
+            kt = []
+            for k in keys:
+                ok = bool(vals[k.index][i])
+                nr = (0 if k.nulls_first else 1) if not ok else \
+                    (1 if k.nulls_first else 0)
+                v = arrs[k.index][i] if ok else 0
+                if not k.ascending and ok:
+                    v = -v
+                kt.append((nr, v))
+            yield tuple(kt), ri, i
+    order = list(heapq.merge(*[run_iter(ri, a, v)
+                               for ri, (a, v) in enumerate(runs)]))
+    offsets = np.cumsum([0] + [len(a[0]) for a, _ in runs])
+    flat = np.fromiter((offsets[ri] + i for _, ri, i in order),
+                       dtype=np.int64, count=len(order))
+    ncols = len(runs[0][0])
+    arrays = [np.concatenate([a[j] for a, _ in runs])[flat]
+              for j in range(ncols)]
+    valids = [np.concatenate([v[j] for _, v in runs])[flat]
+              for j in range(ncols)]
+    return arrays, valids
+
+
 class RemoteTask:
     """Coordinator's proxy of one worker task (HttpRemoteTask.java:135)."""
 
@@ -254,7 +297,8 @@ class StageScheduler:
                 self.failure_injector.maybe_fail("STAGE_BOUNDARY", sql)
         root = self._bind_remotes(frags[-1].root, materialized)
 
-        analysis = analyze(root, self.session.catalog, self.split_rows)
+        analysis = analyze(root, self.session.catalog, self.split_rows,
+                           allow_sort_merge=True)
         if analysis is None:
             self.fallback_reason = ("plan shape not split-streamable "
                                     "(sort/window/distinct below the "
@@ -327,6 +371,12 @@ class StageScheduler:
                     if partials else self._empty_like(analysis.merge_agg)
                 ex._subst[id(analysis.merge_agg)] = merged
                 ex._subst_opaque.add(id(analysis.merge_agg))
+            elif analysis.merge_sort is not None:
+                arrs, vals = _merge_sorted_runs(
+                    analysis.merge_sort, pages)
+                ex._subst[id(analysis.merge_sort)] = batch_from_numpy(
+                    arrs, valids=vals)
+                ex._subst_opaque.add(id(analysis.merge_sort))
             else:
                 from .tasks import concat_pages
                 arrs, vals = concat_pages(pages, root.child.output)
@@ -351,10 +401,13 @@ class StageScheduler:
 
     def _run_source_stage(self, workers, analysis: ChunkAnalysis,
                           root: L.OutputNode) -> List[dict]:
-        # agg mode: workers compute PARTIAL aggregates; concat mode: they
-        # run everything below the output node and the coordinator concats
+        # agg mode: workers compute PARTIAL aggregates; sort mode: they
+        # sort per split (sorted RUNS the coordinator n-way merges);
+        # concat mode: they run everything below the output node
         fragment_root = analysis.merge_agg if analysis.merge_agg \
-            is not None else root.child
+            is not None else (analysis.merge_sort
+                              if analysis.merge_sort is not None
+                              else root.child)
         blob = encode_fragment({"root": fragment_root,
                                 "driver": analysis.driver})
         # the work key hashes (fragment, splits) but not data contents:
