@@ -687,19 +687,24 @@ class Executor:
         # operands — the general kernel's 2-per-key operand count makes
         # XLA TPU compiles explode at scale (see SORT_COMPILE_BUDGET)
         pack = None
+        # pack when rows are big OR the key list is wide: the general
+        # kernel sorts ~2 operands per key and XLA TPU sort compiles
+        # explode in operand count at ANY row count (q10's 7-key GROUP
+        # BY was a >900s compile at 131k rows)
+        wide_keys = 2 * len(node.group_keys) + 4 > MAX_SORT_OPERANDS
         if not any(a.distinct for a in aggs) and node.group_keys and \
-                child.capacity > SORT_SMALL_ROWS:
-            from ..ops.aggregate import (key_pack_plan,
+                (child.capacity > SORT_SMALL_ROWS or wide_keys):
+            from ..ops.aggregate import (key_pack_plan_words,
                                          packed_sort_group_aggregate)
-            pack = key_pack_plan(
+            pack = key_pack_plan_words(
                 child, node.group_keys,
                 fetch=lambda *v: self.fetch_ints(node, "aggpack", *v))
         while True:
             if pack is not None:
-                kmins, bits = pack
+                kmins, bits, splits = pack
                 out = packed_sort_group_aggregate(
                     child, jnp.asarray(kmins), node.group_keys, bits,
-                    aggs, capacity)
+                    aggs, capacity, splits)
             else:
                 out = sort_group_aggregate(child, node.group_keys, aggs,
                                            capacity)

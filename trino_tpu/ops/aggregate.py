@@ -312,6 +312,51 @@ def key_pack_plan(batch: Batch, key_indices: tuple, fetch=None):
     rows). Collapsing any number of integer keys into ONE int64 keeps
     every big sort at (packed, index) — the same range-compression idea
     as BigintGroupByHash's dense path, applied to the sort domain."""
+    # `fetch` (the executor's cross-run decision cache) turns the
+    # min/max measurement into a zero-round-trip host decision on
+    # re-execution
+    plan = _measure_key_bits(batch, key_indices, fetch)
+    if plan is None:
+        return None
+    kmins, bits = plan
+    if sum(bits) > 62:
+        return None
+    return kmins, bits
+
+
+def key_pack_plan_words(batch: Batch, key_indices: tuple, fetch=None,
+                        max_words: int = 3):
+    """key_pack_plan generalized to MULTIPLE packed words: keys are
+    assigned IN ORDER to words of <=62 bits each, and the sort becomes
+    an LSD-radix sequence of stable 2-operand sorts (least-significant
+    word first) — wide GROUP BYs (TPC-H q10's 7 keys ~ 111 bits) stay
+    at compile-cheap operand counts instead of exploding into the
+    general kernel's 2-per-key sort. Returns (kmins, bits, word_splits)
+    where word_splits are (start, end) key ranges per word; None when
+    any single key exceeds 62 bits, a key isn't integer-typed, or more
+    than max_words words would be needed."""
+    plan = _measure_key_bits(batch, key_indices, fetch)
+    if plan is None:
+        return None
+    kmins, bits = plan
+    splits = []
+    start, cur = 0, 0
+    for i, b in enumerate(bits):
+        if b > 62:
+            return None
+        if cur + b > 62:
+            splits.append((start, i))
+            start, cur = i, 0
+        cur += b
+    splits.append((start, len(bits)))
+    if len(splits) > max_words:
+        return None
+    return kmins, bits, tuple(splits)
+
+
+def _measure_key_bits(batch: Batch, key_indices: tuple, fetch=None):
+    """Shared measurement: per-key [min, max] -> (kmins, bits) with no
+    total-width cap (key_pack_plan applies the single-word cap)."""
     import numpy as np
     stats = []
     for ki in key_indices:
@@ -324,50 +369,57 @@ def key_pack_plan(batch: Batch, key_indices: tuple, fetch=None):
         big = jnp.iinfo(jnp.int64)
         stats.append(jnp.min(jnp.where(m, data, big.max)))
         stats.append(jnp.max(jnp.where(m, data, big.min)))
-    # `fetch` (the executor's cross-run decision cache) turns this into
-    # a zero-round-trip host decision on re-execution
     vals = fetch(*stats) if fetch is not None else \
         np.asarray(jnp.stack(stats))
     kmins, bits = [], []
-    total = 0
     for i in range(len(key_indices)):
         lo, hi = int(vals[2 * i]), int(vals[2 * i + 1])
-        if hi < lo:                 # all-NULL key column
+        if hi < lo:
             lo, hi = 0, 0
-        b = max(2, int(hi - lo + 3).bit_length())
         kmins.append(lo)
-        bits.append(b)
-        total += b
-    if total > 62:
-        return None
+        bits.append(max(2, int(hi - lo + 3).bit_length()))
     return np.asarray(kmins, dtype=np.int64), tuple(bits)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
 def packed_sort_group_aggregate(batch: Batch, kmins, key_indices: tuple,
                                 key_bits: tuple, aggs: tuple,
-                                out_capacity: int) -> Batch:
-    """sort_group_aggregate with all keys packed into one int64 (see
-    key_pack_plan). Dead rows pack to int64.max so they sort last; group
-    keys are read back from representative rows (gathers at G positions,
-    not N). No DISTINCT support (callers route distinct to the general
-    kernel)."""
+                                out_capacity: int,
+                                word_splits: tuple = None) -> Batch:
+    """sort_group_aggregate with all keys packed into int64 words (see
+    key_pack_plan / key_pack_plan_words). One word sorts directly;
+    multiple words run an LSD radix: stable 2-operand sorts from the
+    least-significant word up, so even 7-key GROUP BYs never exceed two
+    sort operands per pass (XLA TPU sort compile cost is operand-count
+    bound). Dead rows pack to int64.max in every word so they sort
+    last; group keys are read back from representative rows (gathers at
+    G positions, not N). No DISTINCT support (callers route distinct to
+    the general kernel)."""
     n = batch.capacity
-    packed = jnp.zeros(n, dtype=jnp.int64)
-    for j, (ki, b) in enumerate(zip(key_indices, key_bits)):
-        col = batch.columns[ki]
-        norm = col.data.astype(jnp.int64) - kmins[j] + 1
-        norm = jnp.where(col.valid, norm, 0)      # NULL slot
-        packed = (packed << b) | norm
-    packed = jnp.where(batch.live, packed,
-                       jnp.iinfo(jnp.int64).max)
+    if word_splits is None:
+        word_splits = ((0, len(key_indices)),)
+    words = []
+    for (s, e) in word_splits:
+        w = jnp.zeros(n, dtype=jnp.int64)
+        for j in range(s, e):
+            col = batch.columns[key_indices[j]]
+            norm = col.data.astype(jnp.int64) - kmins[j] + 1
+            norm = jnp.where(col.valid, norm, 0)      # NULL slot
+            w = (w << key_bits[j]) | norm
+        words.append(jnp.where(batch.live, w,
+                               jnp.iinfo(jnp.int64).max))
     idx = jnp.arange(n, dtype=jnp.int32)
-    packed_s, perm = jax.lax.sort((packed, idx), num_keys=1,
-                                  is_stable=True)
+    perm = idx
+    for w in reversed(words):             # LSD over words
+        _, perm = jax.lax.sort((w[perm], perm), num_keys=1,
+                               is_stable=True)
     live_s = batch.live[perm]
 
     first = jnp.arange(n) == 0
-    diff = packed_s != jnp.roll(packed_s, 1)
+    diff = jnp.zeros(n, dtype=jnp.bool_)
+    for w in words:
+        ws = w[perm]
+        diff = diff | (ws != jnp.roll(ws, 1))
     boundary = live_s & (first | diff)
     return _grouped_reduce(batch, key_indices, aggs, out_capacity, perm,
                            live_s, boundary, {})
