@@ -43,7 +43,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # recompiles cost seconds on CPU.
 # ---------------------------------------------------------------------------
 
-_CLEAR_EVERY = 30
+_CLEAR_EVERY = 10
 _test_count = [0]
 
 
