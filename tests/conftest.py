@@ -41,6 +41,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # cache off, so it is backend-state accumulation, not this engine. Dropping
 # the live executables every N tests keeps the compiler healthy; the
 # recompiles cost seconds on CPU.
+#
+# The SLOW mesh tier (tests/test_distributed.py, -m slow) additionally hits
+# an intermittent virtual-device collective rendezvous abort
+# (rendezvous.cc "only 7 of 8 arrived") after ~44 jit-heavy mesh tests in
+# one process — each test passes in isolation, and the tier passes under
+# process isolation: run it as `pytest tests/test_distributed.py -m slow
+# -n 2` (xdist). The quick tier (the CI gate) is unaffected.
 # ---------------------------------------------------------------------------
 
 _CLEAR_EVERY = 10
@@ -50,4 +57,6 @@ _test_count = [0]
 def pytest_runtest_teardown(item, nextitem):
     _test_count[0] += 1
     if _test_count[0] % _CLEAR_EVERY == 0:
+        import gc
         jax.clear_caches()
+        gc.collect()      # drop executables whose last ref died mid-test

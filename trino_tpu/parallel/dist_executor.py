@@ -54,6 +54,22 @@ class MeshExecutor(Executor):
         self._row_sharding = NamedSharding(
             self.mesh, P(tuple(self.mesh.axis_names)))
 
+    # Dynamic filtering's eager min/max over SHARDED build columns
+    # dispatches a tiny cross-module all-reduce per probe; on the
+    # virtual-CPU-device runtime those rendezvous intermittently
+    # deadlock and XLA kills the process (rendezvous.cc "only 7 of 8
+    # arrived", reproduced deterministically on TPC-DS q77). It is an
+    # optimization, not semantics — pinned OFF on the mesh path (the
+    # session rewires the flag from properties each query, hence a
+    # set-proof property); the single-chip executor keeps it.
+    @property
+    def enable_dynamic_filtering(self):
+        return False
+
+    @enable_dynamic_filtering.setter
+    def enable_dynamic_filtering(self, value):
+        pass
+
     def run_scan(self, node: L.ScanNode) -> Batch:
         batch = super().run_scan(node)
         cap = batch.capacity
