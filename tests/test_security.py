@@ -115,3 +115,19 @@ def test_merge_source_reads_are_checked():
             MERGE INTO m.s.t USING tpch.tiny.nation n
               ON t.k = n.n_nationkey
             WHEN MATCHED THEN UPDATE SET v = n.n_regionkey""", "w")
+
+
+def test_liveness_stays_open_on_secured_cluster(coord):
+    """Load-balancer probes must not need credentials (documented
+    contract; the failure detector pings /v1/status the same way)."""
+    import json
+    from urllib.request import urlopen
+    coord.state.dispatcher.authenticator = PasswordAuthenticator(
+        {"alice": "pw"})
+    try:
+        for route in ("/v1/status", "/v1/info"):
+            with urlopen(f"{coord.uri}{route}") as resp:
+                assert resp.status == 200
+                json.loads(resp.read())
+    finally:
+        coord.state.dispatcher.authenticator = None

@@ -378,12 +378,14 @@ class _Handler(BaseHTTPRequestHandler):
                 "coordinator": True, "starting": False,
                 "uptime": time.time() - self.state.started_at})
             return
-        # every other GET exposes query texts/results: authenticate
-        # (liveness /v1/info stays open, like the reference's /v1/status)
-        if self._authenticate() is None:
-            return
         if path == "/v1/status":
+            # liveness for load balancers / the failure detector: open
+            # even on a secured cluster (no query data exposed)
             self._send(200, {"nodeId": "coordinator", "state": "ACTIVE"})
+            return
+        # every other GET exposes query texts/results: authenticate
+        # (liveness /v1/info and /v1/status stay open)
+        if self._authenticate() is None:
             return
         if len(parts) == 4 and parts[:3] == ["v1", "spooled", "segments"]:
             data = self.state.spooling.read(parts[3])
