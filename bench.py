@@ -470,7 +470,9 @@ def main():
         emit()
 
     # ---- config 3: q3 SF10 end-to-end -------------------------------
-    if "q3" in configs and budget_left(0.8):
+    # 0.85: with the round-5 caches q3 runs warm in ~60-90 s, so it can
+    # still land before the watchdog even after a slow q5 cold
+    if "q3" in configs and budget_left(0.85):
         t0 = time.monotonic()
         session10 = Session(default_schema="sf10")
         tables10 = {t: session10.catalog.get_table("tpch", "sf10", t)
