@@ -222,7 +222,10 @@ def test_orc_writer_roundtrip_and_pyarrow(tmp_path):
 
 
 def test_orc_timestamp_read_from_pyarrow(tmp_path):
-    """TIMESTAMP columns decode (seconds-from-2015 + nanos trick)."""
+    """TIMESTAMP columns decode (seconds-from-2015 + nanos trick),
+    including pre-1970 fractional seconds: the C++ writer (pyarrow)
+    stores trunc-toward-zero seconds with sign-carrying nanos, which
+    must NOT receive the Java readers' negative-time adjustment."""
     import datetime
 
     import pyarrow as pa
@@ -232,16 +235,49 @@ def test_orc_timestamp_read_from_pyarrow(tmp_path):
     ts = [datetime.datetime(2021, 3, 4, 5, 6, 7, 250000),
           datetime.datetime(1999, 12, 31, 23, 59, 59, 1),
           datetime.datetime(2015, 1, 1, 0, 0, 0, 0),
-          None]
+          None,
+          datetime.datetime(1969, 12, 31, 23, 59, 59, 500000),
+          datetime.datetime(1960, 6, 1, 0, 0, 0, 250000),
+          datetime.datetime(1960, 6, 1, 0, 0, 0, 0)]
     p = str(tmp_path / "ts.orc")
     po.write_table(pa.table({"t": pa.array(ts, pa.timestamp("us"))}), p)
     ns, cs, vs, lg = read_orc(p)
     assert lg[0] == ("timestamp",)
     epoch = datetime.datetime(1970, 1, 1)
-    for i, want in enumerate(ts[:3]):
+    for i, want in enumerate(ts):
+        if want is None:
+            continue
         assert int(cs[0][i]) == int(
             (want - epoch).total_seconds() * 1_000_000), (i, want)
-    assert not vs[0][3] and all(vs[0][:3])
+    assert not vs[0][3] and all(vs[0][:3]) and all(vs[0][4:])
+
+
+def test_orc_timestamp_java_negative_adjustment():
+    """Java ORC writers store trunc-toward-zero seconds with POSITIVE
+    nanos; a pre-1970 fractional timestamp then needs the reader-side
+    secs-1 adjustment (ADVICE round-5: without it those values read one
+    second high vs Java). Exercised on raw stream values since our
+    writer doesn't emit timestamps."""
+    import numpy as np
+
+    from trino_tpu.formats.orc import timestamp_micros
+    base = 1420070400
+    # 1969-12-31 23:59:58.5: Java stores secs1970 = trunc(-1.5) = -1
+    # with nanos = +5e8, encoded (5 << 3) | 7 (8 trailing zeros
+    # stripped); the reader must subtract the second back
+    secs = np.array([-1 - base], dtype=np.int64)
+    nraw = np.array([(5 << 3) | 7], dtype=np.int64)
+    assert timestamp_micros(secs, nraw)[0] == -1_500_000
+    # the C++ (pyarrow) convention for the same instants: signed nanos,
+    # no adjustment — (-5 << 3) | 7 encodes -5e8
+    secs = np.array([0 - base, -1 - base], dtype=np.int64)
+    nraw = np.array([(-5 << 3) | 7, (-5 << 3) | 7], dtype=np.int64)
+    got = timestamp_micros(secs, nraw)
+    assert got[0] == -500_000 and got[1] == -1_500_000
+    # positive side unaffected: 2015-01-01 00:00:00.000001
+    secs = np.array([0], dtype=np.int64)
+    nraw = np.array([(1 << 3) | 2], dtype=np.int64)
+    assert timestamp_micros(secs, nraw)[0] == base * 1_000_000 + 1
 
 
 def test_parquet_zstd_read(tmp_path):

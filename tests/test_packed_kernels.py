@@ -247,3 +247,31 @@ def test_multiword_packing_nulls_and_dead_rows():
         return int(np.asarray(batch.columns[j].data)[live].sum())
     assert total(got, 2) == total(want, 2)
     assert total(got, 3) == total(want, 3)
+
+
+def test_key_span_measures_combined_packed_key():
+    """Multi-key packed joins window by the COMBINED key (32 bits per
+    trailing column); _key_span measuring keys[0] alone underestimated
+    by ~2^32, so adapted windows always escaped (ADVICE round-5)."""
+    import numpy as np
+
+    from trino_tpu.exec.chunked import _key_span
+    from trino_tpu.ops.join import _combined_key
+
+    b = batch_from_numpy([np.array([5, 5, 5, 5], dtype=np.int64),
+                          np.array([1, 9, 2, 7], dtype=np.int64)])
+    key, _ = _combined_key(b, (0, 1))
+    k = np.asarray(key)[np.asarray(b.live)]
+    assert int(_key_span(b, (0, 1))) == int(k.max() - k.min() + 1)
+    # the old keys[0]-only measurement would collapse distinct combined
+    # keys: a second leading-key value must widen the span past 2^32
+    b3 = batch_from_numpy([np.array([5, 6], dtype=np.int64),
+                           np.array([1, 1], dtype=np.int64)])
+    assert int(_key_span(b3, (0, 1))) == (1 << 32) + 1
+    # single-key measurement is unchanged
+    assert int(_key_span(b, (1,))) == 9
+    # and a NULL-masked row is excluded from the extent
+    b2 = batch_from_numpy([np.array([5, 5, 5], dtype=np.int64),
+                           np.array([1, 2, 1000], dtype=np.int64)],
+                          valids=[None, np.array([True, True, False])])
+    assert int(_key_span(b2, (0, 1))) == 2

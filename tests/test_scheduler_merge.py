@@ -1,0 +1,157 @@
+"""Coordinator-side merge of sorted runs (server/scheduler.py):
+the vectorized np.lexsort merge must reproduce the priority-queue
+semantics exactly — key order with nulls-first/last and descending
+handled per key, ties broken by run order then within-run order — and
+must also handle non-numeric sort keys (the old per-row heapq negated
+values for descending, which assumed a numeric dtype)."""
+
+import heapq
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import trino_tpu.server.scheduler as sched
+
+
+def _key(index, ascending=True, nulls_first=False):
+    return SimpleNamespace(index=index, ascending=ascending,
+                           nulls_first=nulls_first)
+
+
+def _sort_node(*keys):
+    return SimpleNamespace(keys=list(keys))
+
+
+def _merge(monkeypatch, sort_node, runs):
+    """Feed (arrays, valids) runs straight through (pages are already
+    decoded in these unit tests)."""
+    import trino_tpu.server.tasks as tasks
+    monkeypatch.setattr(tasks, "decode_columns", lambda p: p)
+    return sched._merge_sorted_runs(sort_node, runs)
+
+
+def _heapq_reference(keys, runs):
+    """The old per-row priority-queue merge (rank-coded so it also
+    works for strings), as the semantics oracle."""
+    pool = {}
+    for k in keys:
+        vals = np.concatenate([r[0][k.index] for r in runs])
+        pool[k.index] = {v: i for i, v in enumerate(sorted(set(vals)))}
+
+    def run_iter(ri, arrs, vals):
+        for i in range(len(arrs[0])):
+            kt = []
+            for k in keys:
+                ok = bool(vals[k.index][i])
+                nr = (0 if k.nulls_first else 1) if not ok else \
+                    (1 if k.nulls_first else 0)
+                v = pool[k.index][arrs[k.index][i]] if ok else 0
+                if not k.ascending and ok:
+                    v = -v
+                kt.append((nr, v))
+            yield tuple(kt), ri, i
+    order = list(heapq.merge(*[run_iter(ri, a, v)
+                               for ri, (a, v) in enumerate(runs)]))
+    out_rows = []
+    for _, ri, i in order:
+        arrs, vals = runs[ri]
+        out_rows.append(tuple(
+            (arrs[j][i], bool(vals[j][i])) for j in range(len(arrs))))
+    return out_rows
+
+
+def _rows(arrays, valids):
+    return [tuple((arrays[j][i], bool(valids[j][i]))
+                  for j in range(len(arrays)))
+            for i in range(len(arrays[0]))]
+
+
+def _make_runs(rng, n_runs, n, keyspec, dtype=np.int64, with_nulls=True):
+    runs = []
+    for _ in range(n_runs):
+        k = rng.integers(-20, 20, n).astype(dtype)
+        v = rng.integers(0, 1000, n).astype(np.int64)
+        kv = rng.random(n) > 0.15 if with_nulls else np.ones(n, bool)
+        order = np.lexsort(_levels_for(keyspec, k, kv))
+        runs.append(([k[order], v[order]],
+                     [kv[order], np.ones(n, bool)]))
+    return runs
+
+
+def _levels_for(keyspec, k, kv):
+    codes = np.unique(k, return_inverse=True)[1].astype(np.int64)
+    if not keyspec.ascending:
+        codes = -codes
+    codes = np.where(kv, codes, 0)
+    nr = np.where(kv, 1 if keyspec.nulls_first else 0,
+                  0 if keyspec.nulls_first else 1)
+    return [codes, nr]
+
+
+@pytest.mark.parametrize("asc,nf", [(True, False), (True, True),
+                                    (False, False), (False, True)])
+def test_merge_matches_heapq_reference(monkeypatch, asc, nf):
+    rng = np.random.default_rng(hash((asc, nf)) % (1 << 31))
+    key = _key(0, ascending=asc, nulls_first=nf)
+    runs = _make_runs(rng, 3, 50, key)
+    arrays, valids = _merge(monkeypatch, _sort_node(key), runs)
+    assert _rows(arrays, valids) == _heapq_reference([key], runs)
+
+
+def test_merge_non_numeric_descending(monkeypatch):
+    """Object-dtype string keys can't be negated; rank codes sort them
+    descending correctly."""
+    key = _key(0, ascending=False)
+    r1 = ([np.array(["apple", "mango", "zebra"], dtype=object)[::-1],
+           np.array([1, 2, 3])],
+          [np.ones(3, bool), np.ones(3, bool)])
+    r2 = ([np.array(["kiwi", "pear"], dtype=object)[::-1],
+           np.array([4, 5])],
+          [np.ones(2, bool), np.ones(2, bool)])
+    arrays, valids = _merge(monkeypatch, _sort_node(key), [r1, r2])
+    assert list(arrays[0]) == ["zebra", "pear", "mango", "kiwi",
+                               "apple"]
+
+
+def test_merge_stable_run_order_tiebreak(monkeypatch):
+    """Equal keys must come out in run order, runs keeping their
+    internal order — heapq.merge's stability contract."""
+    key = _key(0)
+    r1 = ([np.array([5, 5, 5]), np.array([10, 11, 12])],
+          [np.ones(3, bool), np.ones(3, bool)])
+    r2 = ([np.array([5, 5]), np.array([20, 21])],
+          [np.ones(2, bool), np.ones(2, bool)])
+    arrays, _ = _merge(monkeypatch, _sort_node(key), [r1, r2])
+    assert list(arrays[1]) == [10, 11, 12, 20, 21]
+
+
+def test_merge_two_keys_mixed_directions(monkeypatch):
+    rng = np.random.default_rng(9)
+    k1 = _key(0, ascending=True, nulls_first=True)
+    k2 = _key(1, ascending=False, nulls_first=False)
+    runs = []
+    for _ in range(3):
+        n = 40
+        a = rng.integers(0, 5, n).astype(np.int64)
+        b = rng.integers(0, 7, n).astype(np.int64)
+        v = rng.integers(0, 100, n).astype(np.int64)
+        av = rng.random(n) > 0.2
+        bv = rng.random(n) > 0.2
+        order = np.lexsort(_levels_for(k2, b, bv) +
+                           _levels_for(k1, a, av))
+        runs.append(([a[order], b[order], v[order]],
+                     [av[order], bv[order], np.ones(n, bool)]))
+    arrays, valids = _merge(monkeypatch, _sort_node(k1, k2), runs)
+    assert _rows(arrays, valids) == _heapq_reference([k1, k2], runs)
+
+
+def test_merge_empty_and_unequal_runs(monkeypatch):
+    key = _key(0)
+    r1 = ([np.array([], dtype=np.int64), np.array([], dtype=np.int64)],
+          [np.array([], dtype=bool), np.array([], dtype=bool)])
+    r2 = ([np.array([3, 7]), np.array([1, 2])],
+          [np.ones(2, bool), np.ones(2, bool)])
+    arrays, valids = _merge(monkeypatch, _sort_node(key), [r1, r2])
+    assert list(arrays[0]) == [3, 7]
+    assert sched._merge_sorted_runs(_sort_node(key), []) == ([], [])

@@ -135,7 +135,8 @@ def _plan_packing(build: Batch, node: L.JoinNode, mins, maxs):
 
 
 def compile_fused_chunk(executor, target: L.PlanNode,
-                        driver: L.ScanNode, lut_specs=None, adapt=None):
+                        driver: L.ScanNode, lut_specs=None, adapt=None,
+                        gather_mode: str = "off"):
     """Compose the whole per-chunk path (joins with prebuilt LUTs,
     filters, projections, the partial aggregate) into ONE traced
     function so every chunk is a single device dispatch with zero host
@@ -160,7 +161,14 @@ def compile_fused_chunk(executor, target: L.PlanNode,
     overflow in a stats vector the DRIVER must verify (nonzero escaped/
     overflow => rerun the plain program).
 
-    Returns (fn, join_nodes) where fn(chunk, builds, luts) ->
+    `gather_mode` routes windowed packed probes through the Pallas
+    tiled-gather kernel (ops/pallas_gather.py): the driver prepares
+    per-LUT int32 planes ONCE and passes them as the program's fourth
+    argument; kernel window escapes fold into the same escaped flag the
+    verifier already checks, so a violated near-sorted guess reruns
+    plain exactly as before.
+
+    Returns (fn, join_nodes) where fn(chunk, builds, luts, gplanes) ->
     (partial Batch, stats int64[2 + 3*n_joins]); stats layout:
     [escaped_total, compact_overflow, span_0, live_0, 0, span_1, ...].
     None when the shape doesn't apply (caller uses the per-node loop)."""
@@ -176,11 +184,11 @@ def compile_fused_chunk(executor, target: L.PlanNode,
     compact_at = (adapt or {}).get("compact")
 
     def emit(node):
-        """Returns f(chunk, builds, luts) -> (Batch, stats dict) or
-        None. stats: {"escaped": scalar, "overflow": scalar,
+        """Returns f(chunk, builds, luts, gplanes) -> (Batch, stats
+        dict) or None. stats: {"escaped": scalar, "overflow": scalar,
         "joins": [(span, live), ...]}."""
         if node is driver:
-            return lambda chunk, builds, luts: (chunk, {
+            return lambda chunk, builds, luts, gp: (chunk, {
                 "escaped": jnp.int64(0), "overflow": jnp.int64(0),
                 "joins": []})
         if isinstance(node, L.FilterNode):
@@ -189,8 +197,8 @@ def compile_fused_chunk(executor, target: L.PlanNode,
                 return None
             pred = executor.fold_scalars(node.predicate)
 
-            def run_filter(chunk, b, l, _child=child, _pred=pred):
-                bt, st = _child(chunk, b, l)
+            def run_filter(chunk, b, l, g, _child=child, _pred=pred):
+                bt, st = _child(chunk, b, l, g)
                 return apply_filter(bt, _pred), st
             return run_filter
         if isinstance(node, L.ProjectNode):
@@ -199,8 +207,8 @@ def compile_fused_chunk(executor, target: L.PlanNode,
                 return None
             exprs = executor.fold_scalars_tuple(node.exprs)
 
-            def run_project(chunk, b, l, _child=child, _exprs=exprs):
-                bt, st = _child(chunk, b, l)
+            def run_project(chunk, b, l, g, _child=child, _exprs=exprs):
+                bt, st = _child(chunk, b, l, g)
                 return filter_project(bt, None, _exprs), st
             return run_project
         if isinstance(node, L.JoinNode):
@@ -217,25 +225,27 @@ def compile_fused_chunk(executor, target: L.PlanNode,
             cap = compact_at[1] if compact_at is not None and \
                 compact_at[0] == idx else None
 
-            def run_join(chunk, b, l, _child=child, _idx=idx, _lk=lk,
-                         _rk=rk, _kind=kind, _spec=spec, _win=window,
-                         _cap=cap):
-                bt, st = _child(chunk, b, l)
+            def run_join(chunk, b, l, g, _child=child, _idx=idx,
+                         _lk=lk, _rk=rk, _kind=kind, _spec=spec,
+                         _win=window, _cap=cap):
+                bt, st = _child(chunk, b, l, g)
                 esc = jnp.int64(0)
                 if _spec is not None and _spec[0] == "packed":
                     _, meta, _wd, bkey, out_dtypes = _spec
                     if _win is not None:
+                        gp = g[_idx] if _idx < len(g) else None
                         out, esc, span = dense_join_packed_windowed(
                             bt, l[_idx], _lk, meta, bkey, out_dtypes,
-                            _kind, _win)
+                            _kind, _win, word_dtype=_wd,
+                            gather_mode=gather_mode, lut_planes=gp)
                     else:
                         out = dense_join_packed(
                             bt, l[_idx], _lk, meta, bkey, out_dtypes,
-                            _kind)
+                            _kind, gather_mode)
                         span = _key_span(bt, _lk)
                 else:
                     out = dense_join_with_lut(bt, b[_idx], l[_idx], _lk,
-                                              _rk, _kind)
+                                              _rk, _kind, gather_mode)
                     span = _key_span(bt, _lk)
                 live = jnp.sum(out.live, dtype=jnp.int64)
                 if _cap is not None:
@@ -255,16 +265,16 @@ def compile_fused_chunk(executor, target: L.PlanNode,
                                  if a.arg is not None else None)
                          for a in node.aggs)
             if node.strategy == "global":
-                def run_gagg(chunk, b, l, _child=child, _aggs=aggs):
-                    bt, st = _child(chunk, b, l)
+                def run_gagg(chunk, b, l, g, _child=child, _aggs=aggs):
+                    bt, st = _child(chunk, b, l, g)
                     return global_aggregate(bt, _aggs), st
                 return run_gagg
             if node.strategy == "direct":
                 keys, domains = node.group_keys, node.key_domains
 
-                def run_dagg(chunk, b, l, _child=child, _aggs=aggs,
+                def run_dagg(chunk, b, l, g, _child=child, _aggs=aggs,
                              _keys=keys, _domains=domains):
-                    bt, st = _child(chunk, b, l)
+                    bt, st = _child(chunk, b, l, g)
                     return direct_group_aggregate(
                         bt, _keys, _domains, _aggs), st
                 return run_dagg
@@ -275,8 +285,8 @@ def compile_fused_chunk(executor, target: L.PlanNode,
     if inner is None:
         return None
 
-    def fn(chunk, builds, luts):
-        out, st = inner(chunk, builds, luts)
+    def fn(chunk, builds, luts, gplanes=()):
+        out, st = inner(chunk, builds, luts, gplanes)
         parts = [st["escaped"], st["overflow"]]
         for span, live in st["joins"]:
             parts.extend((span, live, jnp.int64(0)))
@@ -286,13 +296,21 @@ def compile_fused_chunk(executor, target: L.PlanNode,
 
 
 def _key_span(batch: Batch, keys: tuple):
-    """Probe-key extent of live rows (windowing measurement)."""
-    col = batch.columns[keys[0]]
-    ok = batch.live & col.valid
-    d = col.data.astype(jnp.int64)
+    """Probe-key extent of live rows (windowing measurement).
+
+    Measured over the COMBINED packed key — the same key the windowed
+    probe (dense_join_packed_windowed) slices by. Measuring keys[0]
+    alone underestimated multi-key packed joins by ~2^32 per trailing
+    column, so the adapted window always escaped: every run compiled
+    the adapted program, failed verification, dropped the record, reran
+    plain, and re-recorded the same bad span — a permanent ~2x
+    device-work cycle (ADVICE round-5 low)."""
+    from ..ops.join import _combined_key
+    key, valid = _combined_key(batch, keys)
+    ok = batch.live & valid
     big = jnp.int64(1) << 62
-    lo = jnp.min(jnp.where(ok, d, big))
-    hi = jnp.max(jnp.where(ok, d, -big))
+    lo = jnp.min(jnp.where(ok, key, big))
+    hi = jnp.max(jnp.where(ok, key, -big))
     return jnp.maximum(hi - lo + 1, 0)
 
 
@@ -409,6 +427,19 @@ def _fused_luts(executor, joins) -> Optional[tuple]:
                 executor._lut_cache[(keys[k], joins[k].build_key_domain)] \
                     = (luts[k], specs[k])
     return tuple(builds), tuple(luts), tuple(specs)
+
+
+def _windowed_planes(gmode: str, adapt, specs, luts, k):
+    """int32 gather planes for join k's LUT, or None when the Pallas
+    windowed probe won't run for it (mode off, not adapted to a window,
+    not value-packed, or domain too wide for 32-bit kernel indices)."""
+    from ..ops import pallas_gather
+    windows = (adapt or {}).get("windows", {})
+    if gmode == "off" or k not in windows or specs[k] is None or \
+            specs[k][0] != "packed" or \
+            luts[k].shape[0] > pallas_gather.MAX_WINDOWED_ELEMS:
+        return None
+    return pallas_gather.prepare_word_planes(luts[k])
 
 
 # adaptive re-optimization safety margins: windows/capacities pad the
@@ -655,19 +686,27 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
         if bl is not None:
             builds, luts, specs = bl
             # one jitted wrapper per (plan structure, packing layout,
-            # adaptation), reused across runs so re-executions hit the
-            # in-memory trace cache (a replan produces new node objects
-            # but identical static values)
+            # adaptation, gather mode), reused across runs so
+            # re-executions hit the in-memory trace cache (a replan
+            # produces new node objects but identical static values)
+            gmode = executor.gather_mode()
             skey = executor.build_structure_key(per_chunk_target)
             adapt = _fused_adaptation(executor, skey, spine, specs, cap)
-            ckey = (skey, specs, repr(adapt)) if skey is not None \
-                else None
+            # Pallas windowed probes gather off int32 planes prepared
+            # ONCE per pinned LUT (per-chunk re-splitting would re-read
+            # the whole domain-sized table every chunk)
+            gplanes = tuple(
+                _windowed_planes(gmode, adapt, specs, luts, k)
+                for k in range(len(spine)))
+            ckey = (skey, specs, repr(adapt), gmode) \
+                if skey is not None else None
             jitted = executor._fused_cache.get(ckey) \
                 if ckey is not None else None
             if jitted is None:
                 mine = compile_fused_chunk(
                     executor, per_chunk_target, plan.driver,
-                    {id(j): s for j, s in zip(spine, specs)}, adapt)
+                    {id(j): s for j, s in zip(spine, specs)}, adapt,
+                    gather_mode=gmode)
                 if mine is not None:
                     jitted = jax.jit(mine[0])
                     if ckey is not None:
@@ -676,8 +715,10 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
                                 next(iter(executor._fused_cache)))
                         executor._fused_cache[ckey] = jitted
             if jitted is not None:
-                fused = (jitted, builds, luts, skey, adapt)
+                fused = (jitted, builds, luts, skey, adapt, gplanes)
                 executor.stats.fused_chunk_pipelines += 1
+                if gmode != "off":
+                    executor.stats.pallas_gather_calls += 1
     _prof(f"luts+fused ready (fused={fused is not None}, "
           f"adapt={fused[4] if fused else None}, "
           f"fact={fact is not None})")
@@ -704,7 +745,8 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
                 chunk = batch_from_numpy(arrays, valids=valids,
                                          capacity=cap)
             if fused is not None:
-                out, stats_vec = fused[0](chunk, fused[1], fused[2])
+                out, stats_vec = fused[0](chunk, fused[1], fused[2],
+                                          fused[5])
                 chunk_stats.append(stats_vec)
                 if _profile_enabled():
                     jax.block_until_ready(out)
@@ -906,4 +948,4 @@ def merge_partials(executor, node: L.AggregateNode,
     capacity = max(node.out_capacity, pad_capacity(
         int(np.asarray(merged.live).sum())))
     return sort_group_aggregate(merged, tuple(range(n_keys)), merge_aggs,
-                                capacity)
+                                capacity, executor.gather_mode())

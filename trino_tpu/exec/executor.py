@@ -54,6 +54,8 @@ class ExecStats:
     fact_cache_chunks: int = 0       # chunks sliced from device-resident
     chunk_lut_joins: int = 0         # sync-free reused-LUT probes
     fused_chunk_pipelines: int = 0   # whole-chunk-path single programs
+    pallas_gather_calls: int = 0     # probe sites dispatched with the
+                                     # tiled-gather kernel enabled
 
 
 class QueryDeadlineError(RuntimeError):
@@ -98,6 +100,11 @@ class Executor:
         # many rows (the spill-to-host analog; None = off)
         self.spill_chunk_rows: Optional[int] = None
         self.enable_mxu_agg = False    # Pallas MXU aggregation (opt-in)
+        # Pallas tiled-gather probe kernel (ops/pallas_gather.py):
+        # "auto" = on for TPU backends; "true" forces it (interpret mode
+        # off-TPU, which is how tier-1 exercises the kernel logic);
+        # "false" = every site keeps its jnp.take path
+        self.enable_pallas_gather = "auto"
         # session-property knobs (exec/session.py wires these per query)
         self.enable_dynamic_filtering = True
         self.enable_merge_join = True
@@ -636,6 +643,12 @@ class Executor:
         child = self.run(node.child)
         return self.aggregate_batch(node, child, aggs)
 
+    def gather_mode(self) -> str:
+        """Resolved Pallas tiled-gather mode for this query: 'device' |
+        'interpret' | 'off' (see ops/pallas_gather.resolve_mode)."""
+        from ..ops.pallas_gather import resolve_mode
+        return resolve_mode(self.enable_pallas_gather)
+
     def use_mxu_agg(self, child: Batch, aggs, domains) -> bool:
         """Pallas MXU aggregation: TPU backend, sum/count aggregates over
         integer columns, small dense group domain (ops/pallas_agg.py).
@@ -699,15 +712,16 @@ class Executor:
             pack = key_pack_plan_words(
                 child, node.group_keys,
                 fetch=lambda *v: self.fetch_ints(node, "aggpack", *v))
+        gm = self.gather_mode()
         while True:
             if pack is not None:
                 kmins, bits, splits = pack
                 out = packed_sort_group_aggregate(
                     child, jnp.asarray(kmins), node.group_keys, bits,
-                    aggs, capacity, splits)
+                    aggs, capacity, splits, gm)
             else:
                 out = sort_group_aggregate(child, node.group_keys, aggs,
-                                           capacity)
+                                           capacity, gm)
             n_groups = self.fetch_ints(node, f"agggroups{capacity}",
                                        jnp.sum(out.live))[0]
             if n_groups < capacity or capacity >= child.capacity:
@@ -997,6 +1011,9 @@ class Executor:
             out = self._chunk_lut_join(node, probe, build, domain)
             if out is not None:
                 return out
+        gm = self.gather_mode()
+        if gm != "off":
+            self.stats.pallas_gather_calls += 1
         n_sort_ops = 2 * (len(probe.columns) + len(build.columns)) + 4
         merge_ok = self.enable_merge_join and \
             n_sort_ops <= MAX_SORT_OPERANDS and \
@@ -1031,16 +1048,16 @@ class Executor:
                         self.stats.dynamic_filter_compactions += 1
                         return dense_join_compacted(
                             probe, src, matched, build, node.left_keys,
-                            node.right_keys, new_cap)
+                            node.right_keys, new_cap, gm)
                     out, dup2, oob2 = join_unique_build_dense(
                         probe, build, node.left_keys, node.right_keys,
-                        node.kind, domain)
+                        node.kind, domain, gm)
                     return out
                 self.stats.join_domain_fallbacks += 1
             else:
                 out, dup, oob = join_unique_build_dense(
                     probe, build, node.left_keys, node.right_keys,
-                    node.kind, domain)
+                    node.kind, domain, gm)
                 dup, oob, live = self.fetch_ints(
                     node, f"jdense:{domain}", dup, oob,
                     jnp.sum(out.live))
@@ -1081,7 +1098,8 @@ class Executor:
         from ..ops.join import dense_join_with_lut
         self.stats.chunk_lut_joins += 1
         return dense_join_with_lut(probe, build, rec, node.left_keys,
-                                   node.right_keys, node.kind)
+                                   node.right_keys, node.kind,
+                                   self.gather_mode())
 
     def enter_chunk_mode(self) -> None:
         self.chunk_mode = True
@@ -1143,7 +1161,7 @@ class Executor:
             if domain is not None:
                 dout, _dup, oob = join_unique_build_dense(
                     probe, build, node.left_keys, node.right_keys,
-                    "semi", domain)
+                    "semi", domain, self.gather_mode())
                 if self.fetch_ints(node, f"markoob:{domain}",
                                    oob)[0] == 0:
                     out = dout
@@ -1189,7 +1207,7 @@ class Executor:
             if domain is not None:
                 out, _dup, oob = join_unique_build_dense(
                     probe, build, node.left_keys, node.right_keys,
-                    node.kind, domain)
+                    node.kind, domain, self.gather_mode())
                 if self.fetch_ints(node, f"memoob:{domain}",
                                    oob)[0] == 0:
                     return out

@@ -55,6 +55,10 @@ SESSION_PROPERTY_DEFAULTS = {
     "spill_chunk_rows": (0, int),
     # Pallas MXU one-pass aggregation kernel (ops/pallas_agg.py)
     "mxu_agg": (False, _bool),
+    # Pallas tiled-gather probe kernel (ops/pallas_gather.py): auto =
+    # on for TPU backends; true forces it (interpret mode on CPU, the
+    # tier-1 test path); false = jnp.take everywhere
+    "enable_pallas_gather": ("auto", lambda v: str(v).lower()),
     # dense 'direct' aggregation bound (GroupByHash strategy choice);
     # capped by the kernel's compile-bound MAX_DIRECT_GROUPS
     "direct_agg_max_groups": (64, int),
@@ -136,6 +140,7 @@ class Session:
         ex.deadline = (t0 + max_s) if max_s else None
         kb = self.properties["stream_build_min_kb"]
         ex.stream_build_bytes = (kb << 10) if kb else None
+        ex.enable_pallas_gather = self.properties["enable_pallas_gather"]
 
     def execute_query(self, stmt, t0) -> QueryResult:
         # spans mirror the reference's: planner / fragment-plan / execute
@@ -242,6 +247,9 @@ class Session:
                 self.properties[stmt.name] or None
         elif stmt.name == "mxu_agg":
             self.executor.enable_mxu_agg = self.properties[stmt.name]
+        elif stmt.name == "enable_pallas_gather":
+            self.executor.enable_pallas_gather = \
+                self.properties[stmt.name]
         return QueryResult(["result"], [("SET SESSION",)],
                            time.monotonic() - t0)
 

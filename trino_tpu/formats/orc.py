@@ -398,6 +398,32 @@ def read_orc_file(path: str) -> OrcFile:
     return OrcFile(names, columns, valids, logicals)
 
 
+def timestamp_micros(secs: np.ndarray, nraw: np.ndarray) -> np.ndarray:
+    """Compose TIMESTAMP microseconds from the raw (seconds-from-2015,
+    encoded-nanos) stream pair.
+
+    Nanos: low 3 bits k != 0 => (k+1) trailing zeros were stripped
+    (verified against pyarrow: 1000ns -> (1<<3)|2, 2.5e8 -> 25|6).
+
+    Negative-time adjustment: Java ORC writers store trunc-toward-zero
+    seconds with a POSITIVE sub-second part, so a pre-1970 timestamp
+    with fractional seconds carries seconds one above the floor — a
+    conforming reader subtracts one second when the 1970-relative
+    seconds are negative and nanos are non-zero (TreeReaderFactory's
+    TimestampTreeReader). The C++ writer (pyarrow) instead truncates
+    toward zero WITH sign-carrying nanos; those rows arrive here with
+    nanos < 0 and must NOT be adjusted — hence the nanos > 0 condition,
+    which distinguishes the two encodings exactly."""
+    zeros = nraw & 7
+    nanos = np.where(zeros == 0, nraw >> 3,
+                     (nraw >> 3) * np.power(10, zeros + 1))
+    base = 1420070400      # 2015-01-01T00:00:00Z
+    abs_secs = secs + base
+    abs_secs = np.where((abs_secs < 0) & (nanos > 0), abs_secs - 1,
+                        abs_secs)
+    return abs_secs * 1_000_000 + nanos // 1000
+
+
 def _read_column(kind, enc, dict_size, streams, comp, n_rows, tmeta):
     present = streams.get(S_PRESENT)
     valid = None
@@ -460,19 +486,13 @@ def _read_column(kind, enc, dict_size, streams, comp, n_rows, tmeta):
     elif kind == K_TIMESTAMP:
         # DATA = seconds from 2015-01-01 UTC (signed RLE); SECONDARY =
         # nanos with the trailing-zero trick (low 3 bits k != 0 =>
-        # nanos = (v >> 3) * 10^(k+2)). Engine lanes are microseconds.
+        # nanos = (v >> 3) * 10^(k+1)). Engine lanes are microseconds.
         secs = rle_ints(data, n_present).astype(np.int64)
         sec_raw = _decompress_stream(comp, streams.get(S_SECONDARY,
                                                        b""))
         nraw = rle_ints(sec_raw, n_present, signed=False).astype(
             np.int64)
-        # low 3 bits k != 0 => (k+1) trailing zeros were stripped
-        # (verified against pyarrow: 1000ns -> (1<<3)|2, 2.5e8 -> 25|6)
-        zeros = nraw & 7
-        nanos = np.where(zeros == 0, nraw >> 3,
-                         (nraw >> 3) * np.power(10, zeros + 1))
-        base = 1420070400      # 2015-01-01T00:00:00Z
-        vals_p = (secs + base) * 1_000_000 + nanos // 1000
+        vals_p = timestamp_micros(secs, nraw)
     else:
         raise ValueError(f"unsupported ORC column kind {kind}")
 

@@ -154,9 +154,10 @@ def _segmented_scan(vals: jax.Array, boundary: jax.Array, op):
     return out
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
 def sort_group_aggregate(batch: Batch, key_indices: tuple, aggs: tuple,
-                         out_capacity: int) -> Batch:
+                         out_capacity: int,
+                         gather_mode: str = "off") -> Batch:
     """Group by arbitrary key columns via lexicographic sort.
 
     Exact (sorts real key values, not hashes). Output capacity is a static
@@ -211,16 +212,24 @@ def sort_group_aggregate(batch: Batch, key_indices: tuple, aggs: tuple,
             (ddata_s != jnp.roll(ddata_s, 1)) | \
             (dvinv_s != jnp.roll(dvinv_s, 1))
     return _grouped_reduce(batch, key_indices, aggs, out_capacity, perm,
-                           live_s, boundary, distinct_fresh)
+                           live_s, boundary, distinct_fresh, gather_mode)
 
 
 def _grouped_reduce(batch: Batch, key_indices: tuple, aggs: tuple,
                     out_capacity: int, perm, live_s, boundary,
-                    distinct_fresh) -> Batch:
+                    distinct_fresh, gather_mode: str = "off") -> Batch:
     """Shared segment machinery for the sorted aggregation kernels: given
     the sort permutation and group boundaries, locate segment extents and
     reduce every aggregate — used by both the general multi-operand kernel
-    and the packed 2-operand kernel (traced inside their jits)."""
+    and the packed 2-operand kernel (traced inside their jits).
+
+    `gather_mode` routes the GROUP READBACK gathers (representative row
+    per output group -> key columns) through the Pallas tiled-gather
+    kernel (ops/pallas_gather.py): one index decomposition feeds every
+    key data/validity plane. The kernel's win region is small batches
+    (its scan cost grows with the gathered table's length), so the
+    shape gate falls back to the jnp.take path at scale — bit-exact
+    either way."""
     n = batch.capacity
     seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1      # 0-based group id
     num_groups = boundary.sum()
@@ -241,11 +250,28 @@ def _grouped_reduce(batch: Batch, key_indices: tuple, aggs: tuple,
                         jnp.clip(next_start - 1, 0, n - 1), n - 1)
 
     out_cols = []
-    rep = perm[start_c]                   # representative row per group
+    key_tables = []
     for ki in key_indices:
-        col = batch.columns[ki]
-        out_cols.append(Column(data=col.data[rep],
-                               valid=col.valid[rep] & group_live))
+        key_tables.extend((batch.columns[ki].data,
+                           batch.columns[ki].valid))
+    from . import pallas_gather
+    if gather_mode != "off" and \
+            pallas_gather.gather_supported([perm] + key_tables):
+        # the group gather: ONE fused pass resolves the representative
+        # row (perm at segment starts) and every key data/valid plane
+        rep = pallas_gather.gather_columns([perm], start_c,
+                                           mode=gather_mode)[0]
+        outs = pallas_gather.gather_columns(key_tables, rep,
+                                            mode=gather_mode)
+        for j, ki in enumerate(key_indices):
+            out_cols.append(Column(data=outs[2 * j],
+                                   valid=outs[2 * j + 1] & group_live))
+    else:
+        rep = perm[start_c]               # representative row per group
+        for ki in key_indices:
+            col = batch.columns[ki]
+            out_cols.append(Column(data=col.data[rep],
+                                   valid=col.valid[rep] & group_live))
 
     def seg_total(values_sorted):
         """Per-group totals of a sorted value array via cumsum diff."""
@@ -381,11 +407,12 @@ def _measure_key_bits(batch: Batch, key_indices: tuple, fetch=None):
     return np.asarray(kmins, dtype=np.int64), tuple(bits)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
 def packed_sort_group_aggregate(batch: Batch, kmins, key_indices: tuple,
                                 key_bits: tuple, aggs: tuple,
                                 out_capacity: int,
-                                word_splits: tuple = None) -> Batch:
+                                word_splits: tuple = None,
+                                gather_mode: str = "off") -> Batch:
     """sort_group_aggregate with all keys packed into int64 words (see
     key_pack_plan / key_pack_plan_words). One word sorts directly;
     multiple words run an LSD radix: stable 2-operand sorts from the
@@ -422,7 +449,7 @@ def packed_sort_group_aggregate(batch: Batch, kmins, key_indices: tuple,
         diff = diff | (ws != jnp.roll(ws, 1))
     boundary = live_s & (first | diff)
     return _grouped_reduce(batch, key_indices, aggs, out_capacity, perm,
-                           live_s, boundary, {})
+                           live_s, boundary, {}, gather_mode)
 
 
 # --------------------------------------------------------------------------

@@ -43,11 +43,17 @@ class TaskFailedError(RuntimeError):
 
 def _merge_sorted_runs(sort_node, pages):
     """Order-preserving n-way merge of sorted page runs by the sort
-    keys (operator/MergeOperator.java + MergeHashSort's role: a
-    priority queue over per-run cursors — each page is one split's
-    independently sorted output). Returns (arrays, valids)."""
-    import heapq
+    keys (operator/MergeOperator.java + MergeHashSort's role — each
+    page is one split's independently sorted output).
 
+    Vectorized: one np.lexsort over the concatenated runs with a stable
+    (run, within-run) tiebreak reproduces exactly what a priority queue
+    over per-run cursors yields — the per-row Python key tuples of the
+    old heapq merge cost tens of seconds at SF1 ORDER BY sizes,
+    defeating the worker-side sort. Descending keys sort by NEGATED
+    RANK codes (np.unique inverse), not negated values, so non-numeric
+    sort keys (e.g. object-dtype strings) merge correctly.
+    Returns (arrays, valids)."""
     from .tasks import decode_columns
     runs = []
     for p in pages:
@@ -58,30 +64,34 @@ def _merge_sorted_runs(sort_node, pages):
         return [], []
     keys = sort_node.keys
 
-    def run_iter(ri, arrs, vals):
-        n = len(arrs[0])
-        for i in range(n):
-            kt = []
-            for k in keys:
-                ok = bool(vals[k.index][i])
-                nr = (0 if k.nulls_first else 1) if not ok else \
-                    (1 if k.nulls_first else 0)
-                v = arrs[k.index][i] if ok else 0
-                if not k.ascending and ok:
-                    v = -v
-                kt.append((nr, v))
-            yield tuple(kt), ri, i
-    order = list(heapq.merge(*[run_iter(ri, a, v)
-                               for ri, (a, v) in enumerate(runs)]))
-    offsets = np.cumsum([0] + [len(a[0]) for a, _ in runs])
-    flat = np.fromiter((offsets[ri] + i for _, ri, i in order),
-                       dtype=np.int64, count=len(order))
     ncols = len(runs[0][0])
-    arrays = [np.concatenate([a[j] for a, _ in runs])[flat]
+    arrays = [np.concatenate([a[j] for a, _ in runs])
               for j in range(ncols)]
-    valids = [np.concatenate([v[j] for _, v in runs])[flat]
+    valids = [np.concatenate([v[j] for _, v in runs])
               for j in range(ncols)]
-    return arrays, valids
+    lens = [len(a[0]) for a, _ in runs]
+    run_id = np.repeat(np.arange(len(runs), dtype=np.int64), lens)
+    within = np.concatenate([np.arange(n, dtype=np.int64)
+                             for n in lens])
+
+    # lexsort levels, least significant first: (within, run) tiebreak
+    # mirrors heapq.merge's stability (equal keys come out in run
+    # order, preserving each run's internal order), then per key —
+    # rank code below its null-rank, keys[0]'s pair last (= primary)
+    levels = [within, run_id]
+    for k in reversed(keys):
+        ok = np.asarray(valids[k.index], dtype=bool)
+        codes = np.unique(arrays[k.index], return_inverse=True)[1] \
+            .astype(np.int64)
+        if not k.ascending:
+            codes = -codes
+        codes = np.where(ok, codes, 0)
+        nr = np.where(ok, 1 if k.nulls_first else 0,
+                      0 if k.nulls_first else 1).astype(np.int8)
+        levels.append(codes)
+        levels.append(nr)
+    order = np.lexsort(levels)
+    return [a[order] for a in arrays], [v[order] for v in valids]
 
 
 class RemoteTask:
