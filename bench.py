@@ -435,6 +435,176 @@ def gather_micro(table_sizes=None, probe_rows=None, n_tables=3, runs=3,
 
 
 # ---------------------------------------------------------------------------
+# --chaos: seeded randomized fault-injection soak (round-7 robustness PR)
+# ---------------------------------------------------------------------------
+
+# name -> (sql, unordered): unordered queries (no ORDER BY) compare as
+# multisets — page arrival order legitimately varies under retry/hedging
+CHAOS_QUERIES = {
+    "agg": (("SELECT l_returnflag, l_linestatus, sum(l_quantity) AS q, "
+             "count(*) AS c FROM lineitem WHERE l_shipdate <= DATE "
+             "'1998-09-02' GROUP BY l_returnflag, l_linestatus "
+             "ORDER BY l_returnflag, l_linestatus"), False),
+    "concat": (("SELECT l_orderkey, l_quantity FROM lineitem "
+                "WHERE l_shipdate > DATE '1998-11-01'"), True),
+    "sort": (("SELECT l_orderkey, l_linenumber FROM lineitem "
+              "WHERE l_shipdate > DATE '1998-10-01' "
+              "ORDER BY l_orderkey, l_linenumber"), False),
+}
+
+
+def _chaos_rows(rows):
+    return [tuple(v if v is None or isinstance(v, (int, float, str, bool))
+                  else str(v) for v in r) for r in rows]
+
+
+def chaos_soak(n_seeds=None, cluster=None, out_path="BENCH_chaos.json"):
+    """Seeded chaos soak: run the query matrix under generated fault
+    schedules (crash / delay / drop / corrupt at every distributed
+    control-plane point) and require bit-identical results vs the
+    fault-free run — zero wrong-answer escapes, corrupted pages always
+    caught by the CRC32C page checksums and recovered via task retry.
+
+    CPU smoke path: a 3-worker in-process cluster over real HTTP, tiny
+    schema, small splits. Emits BENCH_chaos.json with injected-fault
+    counts and recovery latencies (fault wall minus fault-free median).
+    Pass `cluster=(coord, workers, session)` to reuse a live cluster
+    (the slow-tier pytest soak does); `out_path=None` skips the file."""
+    from trino_tpu.client.client import Client, QueryError
+    from trino_tpu.exec.session import Session
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.failuredetector import HeartbeatFailureDetector
+    from trino_tpu.server.failureinjector import FailureInjector
+    from trino_tpu.server.worker import WorkerServer
+
+    n = n_seeds if n_seeds is not None else \
+        int(os.environ.get("TRINO_TPU_CHAOS_SEEDS", 50))
+    budget_s = float(os.environ.get("TRINO_TPU_CHAOS_BUDGET_S", 600))
+    t_start = time.monotonic()
+    owns = cluster is None
+    detector = None
+    if owns:
+        session = Session(default_schema="tiny")
+        coord = CoordinatorServer(session, retry_policy="QUERY").start()
+        coord.state.scheduler.split_rows = 8192
+        workers = [WorkerServer(f"chaos-w{i}", coord.uri,
+                                announce_interval_s=0.1,
+                                catalog=session.catalog).start()
+                   for i in range(3)]
+        detector = HeartbeatFailureDetector(coord.state,
+                                            interval_s=0.2).start()
+    else:
+        coord, workers, session = cluster
+        detector = coord.state.failure_detector
+    sched = coord.state.scheduler
+    saved = (sched.max_task_retries, sched.hedge_min_s,
+             sched.hedge_multiplier)
+    # chaos schedules can burn several retry rounds; hedge threshold
+    # sits well below the injected straggler delays (up to 1s) so DELAY
+    # faults actually exercise the speculative re-dispatch path
+    sched.max_task_retries = 8
+    sched.hedge_min_s, sched.hedge_multiplier = 0.3, 2.0
+    client = Client(coord.uri, user="chaos", timeout_s=120)
+
+    def wait_active(k=3, timeout=5.0):
+        deadline = time.time() + timeout
+        while len(coord.state.active_nodes()) < k and \
+                time.time() < deadline:
+            time.sleep(0.05)
+
+    wait_active()
+    # fault-free baselines THROUGH the cluster (also warms the worker
+    # fragments so XLA compile doesn't pollute recovery latencies)
+    baselines, base_wall = {}, {}
+    for name, (q, unordered) in CHAOS_QUERIES.items():
+        walls = []
+        for _ in range(2):
+            sched.spool.clear()
+            t0 = time.monotonic()
+            r = client.execute(q)
+            walls.append(time.monotonic() - t0)
+        rows = _chaos_rows(r.rows)
+        baselines[name] = sorted(rows) if unordered else rows
+        base_wall[name] = min(walls)
+
+    rec = {"metric": "chaos_soak", "schedules": 0, "queries_run": 0,
+           "wrong_answers": 0, "failed_queries": 0, "injected_total": 0,
+           "injected_by_fault": {}, "corrupt_detected": 0,
+           "recovery_latency_s": [], "task_retries": 0,
+           "hedged_tasks": 0, "spool_hits": 0, "budget_exhausted": False}
+    retries0 = sched.stats["task_retries"]
+    hedged0 = sched.stats["hedged_tasks"]
+    spool0 = sched.stats["spool_hits"]
+    crc0 = sched.stats["checksum_failures"]
+    for seed in range(n):
+        if time.monotonic() - t_start > budget_s:
+            rec["budget_exhausted"] = True
+            break
+        inj = FailureInjector.from_seed(seed, max_delay_s=1.0)
+        sched.failure_injector = inj
+        if detector is not None:
+            detector.injector = inj
+        for w in workers:
+            w.task_manager.injector = inj
+        try:
+            for name, (q, unordered) in CHAOS_QUERIES.items():
+                sched.spool.clear()
+                fired_before = inj.injected_count
+                t0 = time.monotonic()
+                try:
+                    r = client.execute(q)
+                except QueryError:
+                    rec["failed_queries"] += 1
+                    continue
+                wall = time.monotonic() - t0
+                rec["queries_run"] += 1
+                got = _chaos_rows(r.rows)
+                if unordered:
+                    got = sorted(got)
+                if got != baselines[name]:
+                    rec["wrong_answers"] += 1
+                if inj.injected_count > fired_before:
+                    rec["recovery_latency_s"].append(
+                        round(max(0.0, wall - base_wall[name]), 3))
+        finally:
+            sched.failure_injector = None
+            if detector is not None:
+                detector.injector = None
+            for w in workers:
+                w.task_manager.injector = None
+        rec["schedules"] += 1
+        rec["injected_total"] += inj.injected_count
+        for fault, cnt in inj.injected_by_fault.items():
+            if cnt:
+                rec["injected_by_fault"][fault] = \
+                    rec["injected_by_fault"].get(fault, 0) + cnt
+        inj.clear()
+        wait_active()
+    rec["task_retries"] = sched.stats["task_retries"] - retries0
+    rec["hedged_tasks"] = sched.stats["hedged_tasks"] - hedged0
+    rec["spool_hits"] = sched.stats["spool_hits"] - spool0
+    rec["corrupt_detected"] = sched.stats["checksum_failures"] - crc0 + \
+        sched.spool.checksum_rejects
+    lat = sorted(rec["recovery_latency_s"])
+    rec["recovery_p50_s"] = lat[len(lat) // 2] if lat else 0.0
+    rec["recovery_p95_s"] = lat[int(len(lat) * 0.95)] if lat else 0.0
+    rec["elapsed_s"] = round(time.monotonic() - t_start, 1)
+    sched.max_task_retries, sched.hedge_min_s, sched.hedge_multiplier = \
+        saved
+    if owns:
+        if detector is not None:
+            detector.stop()
+        for w in workers:
+            w.stop()
+        coord.stop()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+# ---------------------------------------------------------------------------
 
 def run_config(session, sql, runs=RUNS, prewarm=PREWARM):
     """End-to-end timings: cold (first exec: compiles + ingest), then
@@ -478,6 +648,9 @@ def cached_baseline(key: str, fn):
 
 
 def main():
+    if "--chaos" in sys.argv:
+        chaos_soak()
+        return
     if "--gather-micro" in sys.argv:
         gather_micro()
         return

@@ -4,19 +4,114 @@ Reference pattern: BaseFailureRecoveryTest (testing/trino-testing/...
 /BaseFailureRecoveryTest.java:85) — inject failures mid-query via the
 engine's FailureInjector and assert the query still produces identical
 results under the retry policy.
+
+Two tiers: the HTTP-protocol cluster tests stay `slow`; the in-process
+dispatcher subset below runs in tier-1 (the round-7 chaos PR's fast
+gate — same injection points, no sockets).
 """
+
+import time
 
 import pytest
 
-pytestmark = pytest.mark.slow
-
 from trino_tpu.client.client import Client, QueryError
 from trino_tpu.exec.session import Session
-from trino_tpu.server.coordinator import CoordinatorServer
+from trino_tpu.server.coordinator import CoordinatorServer, Dispatcher
 from trino_tpu.server.failureinjector import FailureInjector
+from trino_tpu.server.statemachine import QueryTracker
 
 SQL = ("SELECT n_regionkey, count(*) AS c FROM nation "
        "GROUP BY n_regionkey ORDER BY n_regionkey")
+
+
+# ---------------------------------------------------------------------------
+# fast tier: in-process dispatcher (no HTTP)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def dispatcher():
+    session = Session(default_schema="tiny")
+    tracker = QueryTracker()
+    d = Dispatcher(session, tracker, retry_policy="QUERY")
+    d.failure_injector = FailureInjector()
+    yield d
+    d.pool.shutdown(wait=False)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector(request):
+    if "dispatcher" not in request.fixturenames:
+        yield
+        return
+    d = request.getfixturevalue("dispatcher")
+    d.failure_injector.clear()
+    yield
+    d.failure_injector.clear()
+
+
+def _run(dispatcher, sql, timeout_s=30.0):
+    tq = dispatcher.submit(sql, "ft")
+    deadline = time.time() + timeout_s
+    while not tq.state_machine.is_done() and time.time() < deadline:
+        time.sleep(0.01)
+    assert tq.state_machine.is_done(), "query did not finish"
+    return tq
+
+
+def test_inprocess_baseline(dispatcher):
+    tq = _run(dispatcher, SQL)
+    assert tq.state == "FINISHED"
+    assert [row[1] for row in tq.result.rows] == [5, 5, 5, 5, 5]
+
+
+def test_inprocess_recovers_from_dispatch_failure(dispatcher):
+    dispatcher.failure_injector.inject("DISPATCH", times=2,
+                                       match_sql="n_regionkey")
+    tq = _run(dispatcher, SQL)
+    assert tq.state == "FINISHED"
+    assert [row[1] for row in tq.result.rows] == [5, 5, 5, 5, 5]
+    assert tq.retries == 2
+
+
+def test_inprocess_recovers_from_execution_failure(dispatcher):
+    dispatcher.failure_injector.inject("EXECUTION", times=1,
+                                       match_sql="n_regionkey")
+    tq = _run(dispatcher, SQL)
+    assert tq.state == "FINISHED"
+    assert tq.retries == 1
+
+
+def test_inprocess_fails_after_retries_exhausted(dispatcher):
+    dispatcher.failure_injector.inject("EXECUTION", times=100,
+                                       match_sql="n_regionkey")
+    tq = _run(dispatcher, SQL)
+    assert tq.state == "FAILED"
+    assert "injected" in tq.state_machine.error
+
+
+def test_inprocess_user_errors_do_not_retry(dispatcher):
+    tq = _run(dispatcher, "SELECT nope FROM nation")
+    assert tq.state == "FAILED"
+    assert tq.retries == 0
+
+
+def test_inprocess_retry_attempts_are_backed_off(dispatcher):
+    """QUERY retries wait between attempts (RetryPolicy jitter) instead
+    of hammering the engine back-to-back."""
+    dispatcher.failure_injector.inject("DISPATCH", times=2,
+                                       match_sql="n_regionkey")
+    t0 = time.monotonic()
+    tq = _run(dispatcher, SQL)
+    assert tq.state == "FINISHED" and tq.retries == 2
+    # two backoff sleeps at base >= 0.05s each
+    assert time.monotonic() - t0 >= 0.1
+
+
+# ---------------------------------------------------------------------------
+# slow tier: full HTTP statement protocol
+# ---------------------------------------------------------------------------
+
+pytest_http = pytest.mark.slow
 
 
 @pytest.fixture(scope="module")
@@ -30,19 +125,24 @@ def cluster():
 
 
 @pytest.fixture(autouse=True)
-def clean_injector(cluster):
-    _, injector, _ = cluster
+def clean_injector(request):
+    if "cluster" not in request.fixturenames:
+        yield
+        return
+    _, injector, _ = request.getfixturevalue("cluster")
     injector.clear()
     yield
     injector.clear()
 
 
+@pytest_http
 def test_no_failures_baseline(cluster):
     _, _, client = cluster
     r = client.execute(SQL)
     assert [row[1] for row in r.rows] == [5, 5, 5, 5, 5]
 
 
+@pytest_http
 def test_recovers_from_dispatch_failure(cluster):
     coord, injector, client = cluster
     injector.inject("DISPATCH", times=2, match_sql="n_regionkey")
@@ -53,6 +153,7 @@ def test_recovers_from_dispatch_failure(cluster):
     assert injector.injected_count >= 2
 
 
+@pytest_http
 def test_recovers_from_execution_failure(cluster):
     coord, injector, client = cluster
     injector.inject("EXECUTION", times=1, match_sql="n_regionkey")
@@ -61,6 +162,7 @@ def test_recovers_from_execution_failure(cluster):
     assert client.query_info(r.query_id)["retries"] == 1
 
 
+@pytest_http
 def test_fails_after_retries_exhausted(cluster):
     coord, injector, client = cluster
     injector.inject("EXECUTION", times=100, match_sql="n_regionkey")
@@ -69,6 +171,7 @@ def test_fails_after_retries_exhausted(cluster):
     assert "injected" in str(ei.value)
 
 
+@pytest_http
 def test_user_errors_do_not_retry(cluster):
     coord, injector, client = cluster
     with pytest.raises(QueryError):

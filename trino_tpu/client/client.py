@@ -8,10 +8,12 @@ current uri to cancel.
 
 from __future__ import annotations
 
+import http.client
 import json
 import time
 from dataclasses import dataclass, field
 from typing import List, Optional
+from urllib.error import HTTPError
 from urllib.request import Request, urlopen
 
 
@@ -82,12 +84,36 @@ class Client:
                     doc.get("stats", {}).get("state", "FINISHED"),
                     doc.get("stats", {}).get("elapsedTimeMillis", 0))
             if time.time() > deadline:
-                self._request("DELETE", next_uri)
+                # cancel the server-side query BEFORE raising — a bare
+                # CLIENT_TIMEOUT used to leak the executing query (it
+                # keeps burning cluster slots until ITS timeout); the
+                # DELETE is best-effort so a dead coordinator can't mask
+                # the timeout error itself
+                try:
+                    self._request("DELETE", next_uri)
+                except Exception:     # noqa: BLE001 — best-effort cancel
+                    pass
                 raise QueryError("client timeout", "CLIENT_TIMEOUT")
             state = doc.get("stats", {}).get("state", "")
             if state in ("QUEUED", "PLANNING", "RUNNING", "STARTING"):
                 time.sleep(self.poll_interval_s)
-            doc = self._request("GET", next_uri)
+            doc = self._poll(next_uri)
+
+    def _poll(self, next_uri: str) -> dict:
+        """One nextUri advance, tolerating a single transient connection
+        failure: a reset/refused/dropped connection mid-poll is retried
+        once after a short pause (nextUri GETs are idempotent — the
+        token pins the page), so a coordinator hiccup doesn't abort a
+        query that is still running fine. HTTP status errors are real
+        answers and propagate (StatementClientV1.advance retries the
+        same way)."""
+        try:
+            return self._request("GET", next_uri)
+        except HTTPError:
+            raise
+        except (OSError, http.client.HTTPException):
+            time.sleep(max(self.poll_interval_s, 0.05))
+            return self._request("GET", next_uri)
 
     def query_info(self, query_id: str) -> dict:
         return self._request("GET", f"{self.uri}/v1/query/{query_id}")

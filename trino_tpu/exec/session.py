@@ -78,6 +78,15 @@ SESSION_PROPERTY_DEFAULTS = {
     # distributed runtime knobs (execution/scheduler tier)
     "split_rows": (250_000, int),
     "task_retries": (2, int),
+    # straggler hedging: a task past max(hedge_min_s, hedge_multiplier *
+    # median drain time of its round) is speculatively re-dispatched to
+    # a survivor; first success wins. multiplier <= 0 disables.
+    "hedge_multiplier": (4.0, float),
+    "hedge_min_s": (2.0, float),
+    # control-plane retry backoff (server/retrypolicy.py: exponential +
+    # decorrelated jitter) between task-retry rounds
+    "retry_backoff_base_s": (0.05, float),
+    "retry_backoff_max_s": (2.0, float),
     # error instead of silent local fallback when the cluster declines a
     # query (the round-4 verdict's "silently local" complaint)
     "require_distributed": (False, _bool),

@@ -137,9 +137,17 @@ class Dispatcher:
         except Exception:     # noqa: BLE001 — malformed SQL fails later
             pass              # with its real parse/analysis error
         last_error: Optional[str] = None
+        # backoff between QUERY-retry attempts (shared RetryPolicy,
+        # decorrelated jitter): failed queries re-admitting immediately
+        # compound whatever overload/flap failed them the first time
+        from .retrypolicy import RetryPolicy
+        retry_waits = RetryPolicy(base_delay_s=0.05, max_delay_s=1.0,
+                                  max_attempts=attempts).delays()
         for attempt in range(attempts):
             if sm.is_done():
                 return
+            if attempt > 0:
+                time.sleep(next(retry_waits, 1.0))
             try:
                 if attempt > 0:
                     tq.retries = attempt
@@ -204,6 +212,7 @@ class CoordinatorState:
                                      retry_policy)
         self.nodes: Dict[str, RegisteredNode] = {}
         self.nodes_lock = threading.Lock()
+        self.failure_detector = None   # set by HeartbeatFailureDetector
         self.started_at = time.time()
         from .scheduler import StageScheduler
         self.scheduler = StageScheduler(self, session)
@@ -221,8 +230,21 @@ class CoordinatorState:
                 self.nodes[node_id] = RegisteredNode(node_id, uri)
             else:
                 node.last_announce = time.time()
-                if node.state == "FAILED":
+                if node.state == "FAILED" and \
+                        self._recovery_allowed(node_id):
                     node.state = "ACTIVE"    # recovered
+
+    def _recovery_allowed(self, node_id: str) -> bool:
+        """A FAILED node may only rejoin on announce when the failure
+        detector's decayed ratio has dropped back under the threshold
+        (or no detector is attached). Without this gate, a node whose
+        task executor is wedged but whose announcer still runs flips
+        straight back to ACTIVE and reabsorbs splits every round."""
+        det = self.failure_detector
+        if det is None:
+            return True
+        st = det.stats.get(node_id)
+        return st is None or st.failure_ratio <= det.threshold
 
     def active_nodes(self) -> List[RegisteredNode]:
         with self.nodes_lock:

@@ -27,13 +27,16 @@ from typing import List, Optional
 
 
 class ExchangeSpool:
-    def __init__(self, root: Optional[str] = None):
+    def __init__(self, root: Optional[str] = None, injector=None):
         # default scope is one coordinator lifetime (fresh directory):
         # the recovery quantum is a retried attempt within it. Pass an
         # explicit root for durability across coordinator restarts.
         self.root = root or tempfile.mkdtemp(prefix="trino_tpu_exchange_")
         os.makedirs(self.root, exist_ok=True)
         self._lock = threading.Lock()
+        self.injector = injector          # chaos hook (SPOOL_READ/WRITE)
+        self.checksum_rejects = 0         # corrupt spool entries dropped
+        self.write_skips = 0              # best-effort puts that failed
 
     @staticmethod
     def work_key(fragment_blob: str, splits) -> str:
@@ -52,7 +55,17 @@ class ExchangeSpool:
         return os.path.join(self.root, f"{key}.spool")
 
     def get(self, key: str) -> Optional[List[bytes]]:
+        """Read spooled pages; a miss OR any integrity failure returns
+        None so the scheduler re-dispatches the work — the spool is a
+        recovery accelerator, never a correctness dependency. Every page
+        frame is CRC32C-verified here (the reference verifies exchange
+        source handles the same way); a corrupt container is deleted so
+        the next attempt re-creates it from a live task."""
+        from .failureinjector import InjectedFailure
+        from .pageserde import PageChecksumError, verify_page
         try:
+            if self.injector is not None:
+                self.injector.maybe_fail("SPOOL_READ", key)
             with open(self._path(key), "rb") as f:
                 blob = f.read()
             if blob[:4] != self._MAGIC:
@@ -65,22 +78,44 @@ class ExchangeSpool:
                 off += 8
                 pages.append(blob[off:off + ln])
                 off += ln
+            for p in pages:
+                verify_page(p)
             return pages
-        except (OSError, ValueError, struct.error):
+        except PageChecksumError:
+            self.checksum_rejects += 1
+            try:
+                os.unlink(self._path(key))
+            except OSError:
+                pass
+            return None
+        except (OSError, ValueError, struct.error, InjectedFailure):
             return None
 
     def put(self, key: str, pages: List[bytes]) -> None:
-        # write-then-rename: a crashed writer never leaves a torn file a
-        # later attempt could read (the exactly-one-attempt guarantee)
+        """Persist one work unit's pages. Best-effort: persistence
+        failures (disk full, injected faults) degrade to a spool miss on
+        the next attempt, never a query failure."""
+        from .failureinjector import InjectedFailure
         path = self._path(key)
-        with self._lock:
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(self._MAGIC + struct.pack("<I", len(pages)))
-                for p in pages:
-                    f.write(struct.pack("<Q", len(p)))
-                    f.write(p)
-            os.replace(tmp, path)
+        try:
+            if self.injector is not None:
+                self.injector.maybe_fail("SPOOL_WRITE", key)
+                # payload corruption injected here is caught by get()'s
+                # per-page CRC32C check — the write itself succeeds
+                pages = [self.injector.corrupt_page("SPOOL_WRITE", key, p)
+                         for p in pages]
+            with self._lock:
+                tmp = path + ".tmp"
+                # write-then-rename: a crashed writer never leaves a torn
+                # file a later attempt could read (exactly-one-attempt)
+                with open(tmp, "wb") as f:
+                    f.write(self._MAGIC + struct.pack("<I", len(pages)))
+                    for p in pages:
+                        f.write(struct.pack("<Q", len(p)))
+                        f.write(p)
+                os.replace(tmp, path)
+        except (OSError, InjectedFailure):
+            self.write_skips += 1
 
     def clear(self) -> None:
         for f in os.listdir(self.root):

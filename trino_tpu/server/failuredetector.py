@@ -45,8 +45,22 @@ class HeartbeatFailureDetector:
         self.threshold = threshold
         self.timeout_s = timeout_s
         self.stats: Dict[str, NodeStats] = {}
+        self.injector = None          # chaos hook (HEARTBEAT_PING)
         self._stop = threading.Event()
         self._thread = None
+        # registered on the coordinator state so the scheduler's
+        # task-path failures feed the same decayed stats (a node whose
+        # executor is wedged but whose /v1/status answers must not flip
+        # straight back to ACTIVE) and announce() can consult the
+        # hysteresis before resurrecting a FAILED node
+        state.failure_detector = self
+
+    def record_failure(self, node_id: str) -> None:
+        """Fold a non-heartbeat failure observation (task create/drain
+        error seen by the scheduler) into the node's decayed ratio. One
+        sample pushes a healthy node past the default threshold, so it
+        must then sustain several clean pings before rejoining."""
+        self.stats.setdefault(node_id, NodeStats()).record(False)
 
     def start(self) -> "HeartbeatFailureDetector":
         self._thread = threading.Thread(target=self._loop,
@@ -61,6 +75,11 @@ class HeartbeatFailureDetector:
             st = self.stats.setdefault(node.node_id, NodeStats())
             ok = False
             try:
+                if self.injector is not None:
+                    # chaos: RAISE/DROP -> failed probe sample; DELAY ->
+                    # slow status endpoint (sleeps, then pings normally)
+                    self.injector.maybe_fail("HEARTBEAT_PING",
+                                             node.node_id)
                 with urlopen(f"{node.uri}/v1/status",
                              timeout=self.timeout_s) as resp:
                     ok = resp.status == 200

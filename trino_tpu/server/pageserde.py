@@ -8,10 +8,20 @@ hopeless for SF100 shuffles — this module is the binary replacement.
 
 Frame layout (little-endian):
 
-    magic  b"TPG1"
+    magic  b"TPG2"
+    crc    u32     CRC32C (Castagnoli) of everything after this field
     flags  u8      bit0: body zstd-compressed, bit1: zlib-compressed
     rawlen u64     uncompressed body length
     body   bytes   (compressed per flags)
+
+The checksum covers flags + rawlen + body, so a bit flip anywhere past
+the magic — in transit, in a spool file, in a worker's output buffer —
+is detected at decode/verify time and surfaces as PageChecksumError,
+which the exchange layers convert into a retryable task failure instead
+of silently wrong results (the reference's
+CompressingEncryptingPageSerializer checksum word plays the same role).
+Legacy b"TPG1" frames (round-5, no checksum) still decode — rolling
+upgrade, same policy as the base64-dict fallback in tasks.py.
 
 Body:
 
@@ -38,9 +48,35 @@ from typing import List, Tuple
 
 import numpy as np
 
-MAGIC = b"TPG1"
+MAGIC = b"TPG2"
+MAGIC_V1 = b"TPG1"        # legacy checksum-free frames (round 5)
 _F_ZSTD = 1
 _F_ZLIB = 2
+
+
+class PageChecksumError(ValueError):
+    """Frame failed its CRC32C integrity check (or is truncated/garbled).
+    Retryable: the holder of the frame re-fetches or re-runs the work."""
+
+
+try:
+    import google_crc32c as _gcrc
+
+    def _crc32c(*chunks) -> int:
+        c = 0
+        for ch in chunks:
+            c = _gcrc.extend(c, bytes(ch))
+        return c
+except Exception:                    # pragma: no cover — lib absent
+    # zlib's CRC-32 (0x04C11DB7) as a stand-in: same 32-bit guarantees
+    # (all 1-2 bit errors, bursts <= 32), just not the Castagnoli
+    # polynomial. Frames never cross processes with mismatched builds
+    # (one container image), so the choice only needs to be consistent.
+    def _crc32c(*chunks) -> int:
+        c = 0
+        for ch in chunks:
+            c = zlib.crc32(ch, c)
+        return c & 0xFFFFFFFF
 
 try:
     import zstandard as _zstd
@@ -106,14 +142,40 @@ def encode_page(arrays: List[np.ndarray],
             comp = zlib.compress(body, 1)
             if len(comp) < len(body):
                 body, flags = comp, _F_ZLIB
-    return MAGIC + struct.pack("<BQ", flags, len(body)) + body
+    meta = struct.pack("<BQ", flags, len(body))
+    return MAGIC + struct.pack("<I", _crc32c(meta, body)) + meta + body
+
+
+def verify_page(buf: bytes) -> None:
+    """Integrity-check a frame without decompressing or decoding it.
+
+    Raises PageChecksumError on CRC mismatch, truncation, or an
+    unrecognizable magic (a flipped magic byte is corruption too).
+    Legacy TPG1 frames carry no checksum and pass unverified."""
+    if buf[:4] == MAGIC_V1:
+        return
+    if buf[:4] != MAGIC:
+        raise PageChecksumError("bad page frame magic")
+    if len(buf) < 17:
+        raise PageChecksumError("truncated page frame header")
+    (crc,) = struct.unpack_from("<I", buf, 4)
+    (_, blen) = struct.unpack_from("<BQ", buf, 8)
+    if len(buf) < 17 + blen:
+        raise PageChecksumError("truncated page frame body")
+    if _crc32c(buf[8:17 + blen]) != crc:
+        raise PageChecksumError("page frame CRC32C mismatch")
 
 
 def decode_page(buf: bytes) -> Tuple[List[np.ndarray], List[np.ndarray]]:
-    if buf[:4] != MAGIC:
+    if buf[:4] == MAGIC:
+        verify_page(buf)
+        flags, rawlen = struct.unpack_from("<BQ", buf, 8)
+        body = buf[17:17 + rawlen]
+    elif buf[:4] == MAGIC_V1:
+        flags, rawlen = struct.unpack_from("<BQ", buf, 4)
+        body = buf[13:13 + rawlen]
+    else:
         raise ValueError("bad page frame magic")
-    flags, rawlen = struct.unpack_from("<BQ", buf, 4)
-    body = buf[13:13 + rawlen]
     if flags & _F_ZSTD:
         zd = _zd()
         if zd is None:

@@ -20,9 +20,10 @@ table, so any worker can recompute it identically.
 from __future__ import annotations
 
 import json
+import statistics
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set, Tuple
 from urllib.error import HTTPError, URLError
 from urllib.request import Request, urlopen
 
@@ -34,11 +35,20 @@ from ..planner.fragmenter import Fragment, fragment_plan
 from ..planner.optimizer import prune_plan
 from ..sql import ast_nodes as A
 from ..sql.parser import parse
+from .failureinjector import InjectedFailure
+from .pageserde import PageChecksumError, verify_page
+from .retrypolicy import RetryPolicy
 from .tasks import Split, decode_columns, encode_fragment
 
 
 class TaskFailedError(RuntimeError):
     pass
+
+
+class PageIntegrityError(TaskFailedError):
+    """A drained page failed its CRC32C check: corruption detected on the
+    wire/buffer and converted into a retryable task failure (the split
+    re-runs on a survivor) instead of silently wrong results."""
 
 
 def _merge_sorted_runs(sort_node, pages):
@@ -94,13 +104,34 @@ def _merge_sorted_runs(sort_node, pages):
     return [a[order] for a in arrays], [v[order] for v in valids]
 
 
+class _HedgedUnit:
+    """One work unit (a node's split group) in a drain round. A unit may
+    carry several concurrent attempts once hedged; `pages` is set exactly
+    once by the first successful attempt (first-success-wins dedup)."""
+
+    __slots__ = ("first_node", "splits", "key", "pages", "live", "hedged",
+                 "nodes_used", "failed_nodes", "started", "tasks")
+
+    def __init__(self, first_node: str, splits: List[Split], key: str):
+        self.first_node = first_node
+        self.splits = splits
+        self.key = key
+        self.pages: Optional[List[bytes]] = None
+        self.live = 0                  # attempts currently in flight
+        self.hedged = False
+        self.nodes_used: Set[str] = set()
+        self.failed_nodes: Set[str] = set()
+        self.started = time.monotonic()
+        self.tasks: List["RemoteTask"] = []
+
+
 class RemoteTask:
     """Coordinator's proxy of one worker task (HttpRemoteTask.java:135)."""
 
     def __init__(self, node, task_id: str, fragment_blob: str,
                  splits: List[Split], http_timeout_s: float = 30.0,
                  partition: Optional[dict] = None,
-                 sources: Optional[dict] = None):
+                 sources: Optional[dict] = None, injector=None):
         self.node = node
         self.task_id = task_id
         self.fragment_blob = fragment_blob
@@ -108,6 +139,7 @@ class RemoteTask:
         self.http_timeout_s = http_timeout_s
         self.partition = partition
         self.sources = sources
+        self.injector = injector          # chaos hook (EXCHANGE_DRAIN)
         self.pages: List[dict] = []
         self.done = False
 
@@ -157,17 +189,35 @@ class RemoteTask:
             time.sleep(0.02)
         raise TaskFailedError(f"task {self.task_id} timed out")
 
+    def _verified(self, frame: bytes) -> bytes:
+        """Chaos corruption hook + CRC32C integrity gate for one drained
+        frame. A checksum failure is a *retryable* task failure: the
+        work re-runs on a survivor rather than merging garbled columns."""
+        if self.injector is not None:
+            frame = self.injector.corrupt_page("EXCHANGE_DRAIN",
+                                               self.task_id, frame)
+        try:
+            verify_page(frame)
+        except PageChecksumError as e:
+            raise PageIntegrityError(
+                f"task {self.task_id} on {self.node.node_id}: {e}") from e
+        return frame
+
     def drain(self, deadline: float) -> List[bytes]:
         """Pull result pages token by token until the buffer completes
         (HttpPageBufferClient.sendGetResults:355's loop). Pages cross
         the wire as binary zstd/zlib frames (pageserde.py), the JSON
-        envelope only carries terminal/empty states."""
+        envelope only carries terminal/empty states. Every frame is
+        CRC32C-verified before it is accepted."""
         token = 0
         while time.time() < deadline:
+            if self.injector is not None:
+                # chaos: drop/delay/raise at the results-fetch boundary
+                self.injector.maybe_fail("EXCHANGE_DRAIN", self.task_id)
             out = self._request(self._url(f"/results/{token}"),
                                 accept="application/x-trino-pages")
             if isinstance(out, bytes):
-                self.pages.append(out)
+                self.pages.append(self._verified(out))
                 token += 1
                 continue
             if out.get("page") is not None:
@@ -175,6 +225,8 @@ class RemoteTask:
                 if isinstance(page, dict) and "b64" in page:
                     import base64
                     page = base64.b64decode(page["b64"])
+                if isinstance(page, (bytes, bytearray)):
+                    page = self._verified(bytes(page))
                 self.pages.append(page)
                 token += 1
                 continue
@@ -215,10 +267,24 @@ class StageScheduler:
             if max_task_retries is not None \
             else props.get("task_retries", 2)
         self.task_timeout_s = task_timeout_s
+        # straggler hedging: a task past max(hedge_min_s, multiplier *
+        # median drain time of its round) gets a speculative duplicate on
+        # a survivor; first success wins (spool work-key dedup + the
+        # all-or-nothing drain make the race safe). multiplier <= 0
+        # disables.
+        self.hedge_multiplier = float(props.get("hedge_multiplier", 4.0))
+        self.hedge_min_s = float(props.get("hedge_min_s", 2.0))
+        # backoff between task-retry rounds (shared RetryPolicy shape)
+        self.retry_backoff_base_s = float(
+            props.get("retry_backoff_base_s", 0.05))
+        self.retry_backoff_max_s = float(
+            props.get("retry_backoff_max_s", 2.0))
         self._seq = 0
         self._lock = threading.Lock()
         self.stats: Dict[str, int] = {"queries": 0, "tasks": 0,
-                                      "task_retries": 0, "spool_hits": 0}
+                                      "task_retries": 0, "spool_hits": 0,
+                                      "hedged_tasks": 0,
+                                      "checksum_failures": 0}
         # durable exchange (FTE): drained task outputs persist keyed by
         # work identity; later attempts reuse instead of re-running
         from .exchange_spool import ExchangeSpool
@@ -262,6 +328,9 @@ class StageScheduler:
         merges in the FINAL stage."""
         t0 = time.monotonic()
         self.fallback_reason = None
+        # one injector governs every coordinator-side chaos point,
+        # including the spool's read/write hooks
+        self.spool.injector = self.failure_injector
         workers = self.state.active_nodes()
         if not workers:
             self.fallback_reason = "no active workers"
@@ -434,9 +503,15 @@ class StageScheduler:
         pages: List[dict] = []
         pending = {nid: sp for nid, sp in assignment.items() if sp}
         retries = 0
+        # backoff between retry rounds (decorrelated jitter): an
+        # immediately-retried round lands on the same overloaded or
+        # flapping survivors it just failed on
+        backoff = RetryPolicy(self.retry_backoff_base_s,
+                              self.retry_backoff_max_s,
+                              max_attempts=self.max_task_retries + 2
+                              ).delays()
         while pending:
-            tasks: List[RemoteTask] = []
-            failed: Dict[str, List[Split]] = {}
+            units: List[_HedgedUnit] = []
             for nid, sp in list(pending.items()):
                 # durable-exchange hit: a prior attempt already produced
                 # this work's output — consume the spool, skip dispatch
@@ -445,32 +520,11 @@ class StageScheduler:
                 if spooled is not None:
                     pages.extend(spooled)
                     self.stats["spool_hits"] += 1
-                    del pending[nid]
                     continue
-                with self._lock:
-                    self._seq += 1
-                    tid = f"t{self._seq}"
-                task = RemoteTask(by_id[nid], tid, blob, sp)
-                try:
-                    task.start()
-                    tasks.append(task)
-                    self.stats["tasks"] += 1
-                except (URLError, HTTPError, OSError) as e:
-                    self._mark_failed(nid, e)
-                    failed[nid] = sp
-            deadline = time.time() + self.task_timeout_s
-            for task in tasks:
-                try:
-                    drained = task.drain(deadline)
-                    pages.extend(drained)
-                    if use_spool:
-                        self.spool.put(self.spool.work_key(
-                            blob, task.splits), drained)
-                except (TaskFailedError, URLError, HTTPError, OSError) as e:
-                    self._mark_failed(task.node.node_id, e)
-                    failed[task.node.node_id] = task.splits
-                    task.cancel()
-            if not failed:
+                units.append(_HedgedUnit(nid, sp, key))
+            failed_splits, failed_nodes = self._drain_units(
+                units, by_id, blob, use_spool, pages)
+            if not failed_splits:
                 break
             # task retry: reassign failed nodes' splits to survivors
             # (EventDrivenFaultTolerantQueryScheduler's per-task retry)
@@ -479,25 +533,144 @@ class StageScheduler:
             if retries > self.max_task_retries:
                 raise TaskFailedError(
                     "task retries exhausted: " +
-                    ", ".join(sorted(failed)))
+                    ", ".join(sorted(failed_nodes)))
+            time.sleep(next(backoff, self.retry_backoff_max_s))
             survivors = [w for w in self.state.active_nodes()
-                         if w.node_id not in failed]
+                         if w.node_id not in failed_nodes]
             if not survivors:
                 raise TaskFailedError("no active workers left")
             workers = survivors
             by_id = {w.node_id: w for w in workers}
             redo: Dict[str, List[Split]] = {w.node_id: [] for w in workers}
-            flat = [s for sp in failed.values() for s in sp]
-            for i, s in enumerate(flat):
+            for i, s in enumerate(failed_splits):
                 redo[workers[i % len(workers)].node_id].append(s)
             pending = {nid: sp for nid, sp in redo.items() if sp}
         return pages
+
+    def _drain_units(self, units: List["_HedgedUnit"], by_id, blob: str,
+                     use_spool: bool, pages: List[bytes]
+                     ) -> Tuple[List[Split], Set[str]]:
+        """Dispatch and drain one round of work units CONCURRENTLY with
+        straggler hedging. Successful units' pages append to `pages`
+        (and spool, when eligible); returns (failed splits, failed node
+        ids) for the caller's retry round.
+
+        Hedging: once enough units complete to establish a median drain
+        time, any unit still running past max(hedge_min_s, multiplier *
+        median) gets a second, speculative attempt on a node it has not
+        tried. The first successful attempt wins — a unit's attempts all
+        compute the same deterministic split set, drains are
+        all-or-nothing, and only the winning attempt's pages are kept
+        (the spool's work-key dedup gives later query attempts the same
+        guarantee) — so hedging can duplicate WORK but never RESULTS."""
+        if not units:
+            return [], set()
+        deadline = time.time() + self.task_timeout_s
+        lock = threading.Lock()
+        durations: List[float] = []
+
+        def attempt(unit: "_HedgedUnit", node) -> None:
+            t0 = time.monotonic()
+            with self._lock:
+                self._seq += 1
+                tid = f"t{self._seq}"
+            task = RemoteTask(node, tid, blob, unit.splits,
+                              injector=self.failure_injector)
+            with lock:
+                unit.tasks.append(task)
+            losers: List[RemoteTask] = []
+            try:
+                task.start()
+                self.stats["tasks"] += 1
+                drained = task.drain(deadline)
+            except (TaskFailedError, InjectedFailure, URLError,
+                    HTTPError, OSError) as e:
+                if isinstance(e, PageIntegrityError):
+                    self.stats["checksum_failures"] += 1
+                task.cancel()
+                self._mark_failed(node.node_id, e)
+                with lock:
+                    unit.failed_nodes.add(node.node_id)
+                    unit.live -= 1
+            else:
+                with lock:
+                    unit.live -= 1
+                    if unit.pages is None:     # first success wins
+                        unit.pages = drained
+                        durations.append(time.monotonic() - t0)
+                        losers = [t for t in unit.tasks if t is not task]
+                # abort outstanding hedge twins outside the lock — their
+                # output is dropped either way
+                for t in losers:
+                    t.cancel()
+
+        def launch(unit: "_HedgedUnit", node) -> None:
+            with lock:
+                unit.live += 1
+                unit.nodes_used.add(node.node_id)
+            t = threading.Thread(target=attempt, args=(unit, node),
+                                 name=f"drain-{node.node_id}", daemon=True)
+            t.start()
+
+        for u in units:
+            launch(u, by_id[u.first_node])
+
+        while time.time() < deadline + 5.0:
+            with lock:
+                unresolved = [u for u in units
+                              if u.pages is None and u.live > 0]
+                if not unresolved:
+                    break
+                med = statistics.median(durations) if durations else None
+            if med is not None and self.hedge_multiplier > 0:
+                threshold = max(self.hedge_min_s,
+                                self.hedge_multiplier * med)
+                now = time.monotonic()
+                for u in unresolved:
+                    candidate = None
+                    with lock:
+                        if u.hedged or u.pages is not None or \
+                                now - u.started < threshold:
+                            continue
+                        for w in self.state.active_nodes():
+                            if w.node_id not in u.nodes_used:
+                                candidate = w
+                                break
+                        if candidate is None:
+                            continue
+                        u.hedged = True
+                    self.stats["hedged_tasks"] += 1
+                    launch(u, candidate)
+            time.sleep(0.02)
+
+        failed_splits: List[Split] = []
+        failed_nodes: Set[str] = set()
+        with lock:
+            resolved = [(u, u.pages) for u in units]
+        for u, got in resolved:
+            if got is not None:
+                pages.extend(got)
+                if use_spool:
+                    self.spool.put(u.key, got)
+            else:
+                failed_splits.extend(u.splits)
+                failed_nodes.update(u.failed_nodes or {u.first_node})
+        return failed_splits, failed_nodes
 
     def _mark_failed(self, node_id: str, err: Exception) -> None:
         with self.state.nodes_lock:
             n = self.state.nodes.get(node_id)
             if n is not None:
                 n.state = "FAILED"
+        # record the task-path failure into the heartbeat detector's
+        # decayed stats too: without this, the node's very next
+        # successful ping (or re-announce) flips it straight back to
+        # ACTIVE even while its task executor is wedged — now the same
+        # hysteresis that governs ping failures applies (it must sustain
+        # several clean pings before rejoining the schedulable set)
+        det = getattr(self.state, "failure_detector", None)
+        if det is not None:
+            det.record_failure(node_id)
 
     # -- final stage -------------------------------------------------------
 
@@ -617,7 +790,8 @@ class StageScheduler:
                     tid = f"t{self._seq}"
                 task = RemoteTask(w, tid, blob, sp,
                                   partition={"keys": list(keys),
-                                             "count": P})
+                                             "count": P},
+                                  injector=self.failure_injector)
                 task.start()
                 self.stats["tasks"] += 1
                 tasks.append(task)
@@ -644,7 +818,8 @@ class StageScheduler:
                 self._seq += 1
                 tid = f"t{self._seq}"
             task = RemoteTask(workers[p % len(workers)], tid, blob_c, [],
-                              sources=sources)
+                              sources=sources,
+                              injector=self.failure_injector)
             task.start()
             self.stats["tasks"] += 1
             c_tasks.append(task)

@@ -231,6 +231,11 @@ class TaskManager:
     def create_or_update(self, task_id: str, fragment_blob: str,
                          splits: List[Split], partition: dict = None,
                          sources: dict = None) -> WorkerTask:
+        if self.injector is not None:
+            # chaos: fail/delay/drop task intake (the worker dies or
+            # hangs between accept and ack — TaskResource's createOrUpdate
+            # boundary); the coordinator sees a failed POST and reassigns
+            self.injector.maybe_fail("WORKER_TASK_CREATE", task_id)
         with self._lock:
             task = self.tasks.get(task_id)
             if task is None:
@@ -282,6 +287,7 @@ class TaskManager:
         try:
             if self.injector is not None:
                 self.injector.maybe_fail("TASK", task.task_id)
+                self.injector.maybe_fail("WORKER_TASK_RUN", task.task_id)
             if task.sources is not None:
                 self._run_exchange_consumer(task)
                 return
@@ -306,9 +312,18 @@ class TaskManager:
                     # many): else every split re-executes every build join
                     for sub in _static_subtrees(root, driver_scan):
                         ex._subst[id(sub)] = ex.run(sub)
-                    for split in task.splits:
+                    for si, split in enumerate(task.splits):
                         if task.state == "CANCELED":
                             return
+                        if self.injector is not None:
+                            # chaos mid-split: CRASH kills the executor
+                            # with work half-done (partial pages already
+                            # buffered — the coordinator's all-or-nothing
+                            # drain discards them), DELAY makes this
+                            # worker a straggler (hedge-mitigation target)
+                            self.injector.maybe_fail(
+                                "WORKER_TASK_RUN",
+                                f"{task.task_id}:{si}")
                         data = self.catalog.get_table(
                             split.catalog, split.schema_name, split.table)
                         arrays = [np.asarray(data.columns[i])
@@ -377,6 +392,11 @@ class TaskManager:
                 body = resp.read()
                 if resp.headers.get("Content-Type", "").startswith(
                         "application/x-trino-pages"):
+                    # worker<->worker frames get the same CRC32C gate as
+                    # the coordinator drain; PageChecksumError fails THIS
+                    # task, which the coordinator sees and retries
+                    from .pageserde import verify_page
+                    verify_page(bytes(body))
                     pages.append(bytes(body))
                     token += 1
                     continue

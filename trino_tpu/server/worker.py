@@ -146,12 +146,19 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 return
             n = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(n).decode())
+            from .failureinjector import InjectedFailure
             from .tasks import Split
             splits = [Split(**s) for s in body.get("splits", [])]
-            task = self.worker.task_manager.create_or_update(
-                parts[2], body["fragment"], splits,
-                partition=body.get("partition"),
-                sources=body.get("sources"))
+            try:
+                task = self.worker.task_manager.create_or_update(
+                    parts[2], body["fragment"], splits,
+                    partition=body.get("partition"),
+                    sources=body.get("sources"))
+            except InjectedFailure as e:
+                # chaos at task intake (crash/drop/raise all surface to
+                # the coordinator as a failed POST -> split reassignment)
+                self._send(500, {"error": str(e)})
+                return
             self._send(200, self.worker.task_manager.status_json(task))
             return
         self._send(404, {"error": f"no route {path}"})
@@ -213,12 +220,25 @@ class WorkerServer:
         self._threads = [t1, t2]
         return self
 
-    def announce_once(self) -> None:
-        body = json.dumps({"nodeId": self.node_id, "uri": self.uri}).encode()
-        req = Request(f"{self.coordinator_uri}/v1/announce", data=body,
-                      headers={"Content-Type": "application/json"})
-        with urlopen(req, timeout=5):
-            pass
+    def announce_once(self, attempts: int = 5) -> None:
+        """Announce to the coordinator, retrying transient failures with
+        backoff + decorrelated jitter — a worker that boots before the
+        coordinator (or across a coordinator restart) must not fail its
+        announcement permanently on one refused connection."""
+        from .retrypolicy import RetryPolicy
+
+        def post():
+            body = json.dumps({"nodeId": self.node_id,
+                               "uri": self.uri}).encode()
+            req = Request(f"{self.coordinator_uri}/v1/announce", data=body,
+                          headers={"Content-Type": "application/json"})
+            with urlopen(req, timeout=5):
+                pass
+
+        RetryPolicy(base_delay_s=0.1, max_delay_s=1.0,
+                    max_attempts=max(1, attempts)).call(
+            post, retry_on=(OSError,),
+            sleep=lambda d: self._stop.wait(d))
 
     def _announce_loop(self) -> None:
         while not self._stop.is_set():
