@@ -622,6 +622,39 @@ def run_config(session, sql, runs=RUNS, prewarm=PREWARM):
     return result, cold_ms, statistics.median(times)
 
 
+def op_stats(session, reg_before=None):
+    """Per-config operator attribution for the BENCH payloads: the
+    executor's adaptive-path counters (nonzero only) plus per-operator
+    dispatch wall-ms deltas from the metrics registry — so the perf
+    trajectory names operators, not just end-to-end walls."""
+    import dataclasses
+    from trino_tpu.metrics import REGISTRY
+    st = {k: v for k, v in
+          dataclasses.asdict(session.executor.stats).items() if v}
+    out = {"exec": st}
+    if reg_before is not None:
+        after = REGISTRY.snapshot()
+        wall = {}
+        for key, v in after.items():
+            if key[0] == "trino_tpu_operator_wall_ms_total":
+                d = v - reg_before.get(key, 0)
+                if d > 0:
+                    wall[key[1]] = round(d, 1)
+        out["operator_wall_ms"] = wall
+        key = ("trino_tpu_task_output_bytes_total",)
+        out["bytes_shuffled"] = int(after.get(key, 0) -
+                                    reg_before.get(key, 0))
+        key = ("trino_tpu_operator_rows_total", "scan")
+        out["rows_scanned"] = int(after.get(key, 0) -
+                                  reg_before.get(key, 0))
+    return out
+
+
+def reg_snapshot():
+    from trino_tpu.metrics import REGISTRY
+    return REGISTRY.snapshot()
+
+
 def budget_left(frac):
     return (time.monotonic() - T0) < BUDGET_S * frac
 
@@ -687,6 +720,7 @@ def main():
         s100.executor.spill_chunk_rows = chunk
         cpu_q5, cpu_q5_ms, _ = cached_baseline(
             f"q5_sf{scale:g}", lambda: numpy_q5(tables100))
+        reg0 = reg_snapshot()
         res, cold, steady = run_config(s100, Q5, runs=1, prewarm=1)
         got = [(r[0], round(float(r[1]), 2)) for r in res.rows]
         want = [(n, round(v, 2)) for n, v in cpu_q5]
@@ -702,6 +736,7 @@ def main():
             "chunked": True, "verified": True,
             "fact_cache_chunks": st.fact_cache_chunks,
             "chunk_lut_joins": st.chunk_lut_joins,
+            "operator_stats": op_stats(s100, reg0),
             "note": "steady slices device-resident narrowed columns; "
                     "cold pays one narrowed ingest over the tunnel"}
         emit()
@@ -716,6 +751,7 @@ def main():
         gen1_s = time.monotonic() - t0
         cpu_q6, cpu_q6_ms, _ = cached_baseline("q6_sf1",
                                                lambda: numpy_q6(tables))
+        reg0 = reg_snapshot()
         res, cold, steady = run_config(session, Q6)
         got = float(res.rows[0][0])
         assert abs(got - cpu_q6 / 1e4) < 1e-2, (got, cpu_q6 / 1e4)
@@ -723,7 +759,8 @@ def main():
             "tpu_cold_ms": round(cold, 1),
             "tpu_steady_ms": round(steady, 1),
             "cpu_ms": round(cpu_q6_ms, 1), "gen_s": round(gen1_s, 1),
-            "speedup": round(cpu_q6_ms / steady, 2), "verified": True}
+            "speedup": round(cpu_q6_ms / steady, 2), "verified": True,
+            "operator_stats": op_stats(session, reg0)}
         emit()
 
     # ---- config 3: q3 SF10 end-to-end -------------------------------
@@ -737,6 +774,7 @@ def main():
         gen10_s = time.monotonic() - t0
         cpu_q3, cpu_q3_ms, _ = cached_baseline(
             "q3_sf10", lambda: numpy_q3(tables10))
+        reg0 = reg_snapshot()
         res, cold, steady = run_config(session10, Q3)
         got = [(int(r[0]), round(float(r[1]), 2)) for r in res.rows]
         want = [(k, round(v, 2)) for k, v in cpu_q3]
@@ -745,7 +783,8 @@ def main():
             "tpu_cold_ms": round(cold, 1),
             "tpu_steady_ms": round(steady, 1),
             "cpu_ms": round(cpu_q3_ms, 1), "gen_s": round(gen10_s, 1),
-            "speedup": round(cpu_q3_ms / steady, 2), "verified": True}
+            "speedup": round(cpu_q3_ms / steady, 2), "verified": True,
+            "operator_stats": op_stats(session10, reg0)}
         emit()
         del session10, tables10
 

@@ -1,0 +1,90 @@
+"""Observability-surface lints (tier-1 CI guards).
+
+Two invariants the metrics/tracing layer depends on, enforced as tests so
+they hold as the server grows:
+
+1. Every `/v1/...` HTTP route must flow through the declarative ROUTES
+   table (server/routes.py) — that is what guarantees each route has a
+   pre-initialized `trino_tpu_http_requests_total{server,route}` counter.
+   A handler with inline path literals would dodge the metrics surface,
+   so the do_* dispatch methods are checked to be table-driven only.
+
+2. Every pytest marker used under tests/ must be declared in pytest.ini
+   (an undeclared marker silently deselects nothing and rots).
+"""
+
+import configparser
+import inspect
+import os
+import re
+
+from trino_tpu.metrics import HTTP_REQUESTS
+from trino_tpu.server import coordinator, worker
+from trino_tpu.server.routes import route_label
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(TESTS_DIR)
+
+SERVERS = (
+    (coordinator, coordinator._Handler),
+    (worker, worker._WorkerHandler),
+)
+
+
+def test_every_route_has_a_preinitialized_counter():
+    """A cold server's /v1/metrics must already list every route at 0 —
+    new routes added to ROUTES get this for free via register_routes."""
+    for module, _handler in SERVERS:
+        for method, pattern, *_ in module.ROUTES:
+            label = route_label(method, pattern)
+            assert HTTP_REQUESTS.has_sample(
+                server=module.SERVER_NAME, route=label), \
+                f"{module.__name__}: route {label} has no counter sample"
+
+
+def test_route_handlers_exist_and_are_complete():
+    for module, handler in SERVERS:
+        for method, pattern, fn_name, _auth in module.ROUTES:
+            assert callable(getattr(handler, fn_name, None)), \
+                f"{module.__name__}: ROUTES references missing " \
+                f"{fn_name}"
+            assert method in ("GET", "POST", "DELETE", "PUT")
+
+
+def test_no_inline_route_dispatch_outside_the_table():
+    """do_GET/do_POST/... must stay pure table dispatchers: any inline
+    '/v1' literal or parts[...] comparison in them means a route was
+    added OUTSIDE the ROUTES table — invisible to the request counters.
+    That is exactly the regression this lint exists to catch."""
+    for module, handler in SERVERS:
+        for do in ("do_GET", "do_POST", "do_DELETE", "do_PUT"):
+            fn = getattr(handler, do, None)
+            if fn is None:
+                continue
+            src = inspect.getsource(fn)
+            assert "/v1" not in src, \
+                f"{module.__name__}.{do} hardcodes a /v1 path — " \
+                f"add the route to ROUTES instead"
+            assert "parts[" not in src, \
+                f"{module.__name__}.{do} matches path segments " \
+                f"inline — add the route to ROUTES instead"
+
+
+def test_markers_used_are_declared_in_pytest_ini():
+    ini = configparser.ConfigParser()
+    ini.read(os.path.join(REPO_ROOT, "pytest.ini"))
+    declared = {line.strip().split(":")[0]
+                for line in ini["pytest"]["markers"].splitlines()
+                if line.strip()}
+    builtin = {"parametrize", "skip", "skipif", "xfail", "usefixtures",
+               "filterwarnings"}
+    used = set()
+    pat = re.compile(r"pytest\.mark\.([a-zA-Z_][a-zA-Z0-9_]*)")
+    for fname in os.listdir(TESTS_DIR):
+        if not fname.endswith(".py"):
+            continue
+        with open(os.path.join(TESTS_DIR, fname)) as f:
+            used.update(pat.findall(f.read()))
+    undeclared = used - declared - builtin
+    assert not undeclared, \
+        f"markers used but not declared in pytest.ini: {undeclared}"

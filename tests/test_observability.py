@@ -1,12 +1,18 @@
-"""Tracing spans, event listeners, and verifier tests."""
+"""Tracing spans, metrics, event listeners, and verifier tests."""
+
+import logging
+import re
+import time
 
 import pytest
 
 from trino_tpu.client.client import Client
-from trino_tpu.events import EventListener
+from trino_tpu.events import EventListener, EventListenerManager
 from trino_tpu.exec.session import Session
 from trino_tpu.server.coordinator import CoordinatorServer
-from trino_tpu.utils.tracing import Tracer
+from trino_tpu.server.worker import WorkerServer
+from trino_tpu.utils.tracing import (NOOP, Tracer, format_traceparent,
+                                     parse_traceparent)
 from trino_tpu.verifier import Verifier
 
 
@@ -53,6 +59,306 @@ def test_event_listener_dispatch():
         assert any(e.state == "FAILED" for e in rec.completed)
     finally:
         coord.stop()
+
+
+def test_event_listener_failures_logged_once(caplog):
+    class Bad(EventListener):
+        def query_created(self, ev):
+            raise RuntimeError("boom")
+
+    class FakeTQ:
+        query_id, session_user, sql = "q1", "u", "SELECT 1"
+
+    mgr = EventListenerManager()
+    mgr.register(Bad())
+    with caplog.at_level(logging.ERROR, logger="trino_tpu.events"):
+        mgr.query_created(FakeTQ())
+        mgr.query_created(FakeTQ())       # second failure is suppressed
+    recs = [r for r in caplog.records if "event listener" in r.message]
+    assert len(recs) == 1
+    assert "Bad" in recs[0].getMessage()
+
+
+# ---------------------------------------------------------------------------
+# tracer: span ids, parent links, W3C propagation
+# ---------------------------------------------------------------------------
+
+def test_span_parentage_links_by_id_not_name():
+    t = Tracer()
+    with t.span("query") as root:
+        with t.span("task"):
+            pass
+        with t.span("task"):              # same NAME, different span
+            pass
+    spans = t.export()
+    tasks = [s for s in spans if s["name"] == "task"]
+    assert len(tasks) == 2
+    assert tasks[0]["spanId"] != tasks[1]["spanId"]
+    # both link to the root by SPAN ID (a name link would be ambiguous)
+    assert all(s["parentSpanId"] == root.span_id for s in tasks)
+    q = next(s for s in spans if s["name"] == "query")
+    assert q["parentSpanId"] is None
+    assert all(s["traceId"] == t.trace_id for s in spans)
+
+
+def test_traceparent_roundtrip_and_remote_parentage():
+    t = Tracer()
+    with t.span("dispatch") as d:
+        tp = t.traceparent()
+    assert tp == format_traceparent(t.trace_id, d.span_id)
+    assert parse_traceparent(tp) == (t.trace_id, d.span_id)
+    # a remote tracer adopting the header roots its spans under the
+    # dispatching span and keeps the trace id
+    remote = Tracer.from_traceparent(tp, service="worker:w0")
+    assert remote.trace_id == t.trace_id
+    with remote.span("worker-task"):
+        pass
+    (w,) = remote.export()
+    assert w["parentSpanId"] == d.span_id
+    assert w["service"] == "worker:w0"
+    # malformed headers degrade to a fresh trace, never an error
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent(None) is None
+    assert Tracer.from_traceparent("garbage").remote_parent is None
+
+
+def test_noop_tracer_emits_no_traceparent():
+    assert NOOP.traceparent() is None
+    with NOOP.span("x") as s:
+        assert s is None
+    assert NOOP.export() == []
+
+
+def test_adopted_remote_spans_merge_into_export():
+    t = Tracer()
+    t.adopt([{"name": "remote", "spanId": "aa", "traceId": t.trace_id}])
+    assert any(s["name"] == "remote" for s in t.export())
+    t.clear()
+    assert t.export() == []
+
+
+# ---------------------------------------------------------------------------
+# metrics registry: Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9.eE+-]+(Inf)?$")
+
+
+def _assert_prometheus_text(text):
+    """Every non-comment line must be a well-formed sample."""
+    names = set()
+    for line in text.strip().split("\n"):
+        if line.startswith("# HELP") or line.startswith("# TYPE"):
+            continue
+        assert _SAMPLE_RE.match(line), f"bad sample line: {line!r}"
+        names.add(line.split("{")[0].split(" ")[0])
+    return names
+
+
+def test_metrics_registry_renders_prometheus_text():
+    from trino_tpu.metrics import MetricsRegistry
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "help text")
+    g = reg.gauge("t_gauge", "g", labelnames=("node",))
+    h = reg.histogram("t_seconds", "h")
+    c.inc()
+    c.inc(2)
+    g.set(7, node="w0")
+    h.observe(0.3)
+    text = reg.render()
+    assert "# TYPE t_total counter" in text
+    assert "t_total 3" in text
+    assert 't_gauge{node="w0"} 7' in text
+    assert 't_seconds_bucket{le="+Inf"} 1' in text
+    assert "t_seconds_count 1" in text
+    _assert_prometheus_text(text)
+    # idempotent re-registration returns the same metric
+    assert reg.counter("t_total") is c
+    with pytest.raises(ValueError):
+        reg.gauge("t_total")
+    # unobserved unlabeled counters still render at 0
+    reg.counter("t_cold_total", "never incremented")
+    assert "t_cold_total 0" in reg.render()
+
+
+# ---------------------------------------------------------------------------
+# cluster: trace propagation + /v1/metrics + distributed EXPLAIN ANALYZE
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster():
+    session = Session(default_schema="tiny")
+    coord = CoordinatorServer(session).start()
+    coord.state.scheduler.split_rows = 8192
+    workers = [WorkerServer(f"obs-w{i}", coord.uri,
+                            announce_interval_s=0.1,
+                            catalog=session.catalog).start()
+               for i in range(2)]
+    deadline = time.time() + 5
+    while len(coord.state.active_nodes()) < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    yield coord, workers, session
+    coord.stop()
+    for w in workers:
+        w.stop()
+
+
+DIST_SQL = ("SELECT l_returnflag, count(*) AS c FROM lineitem "
+            "GROUP BY l_returnflag ORDER BY l_returnflag")
+
+
+def test_cluster_trace_stitched_across_workers(cluster):
+    coord, workers, session = cluster
+    # cold spool: a durable-exchange hit would satisfy the query
+    # without dispatching tasks (no TaskStats to roll up)
+    coord.state.scheduler.spool.clear()
+    client = Client(coord.uri, user="obs")
+    client.execute("SET SESSION enable_tracing = true")
+    try:
+        r = client.execute(DIST_SQL)
+        info = client.query_info(r.query_id)
+        assert info["distributed"], info["fallbackReason"]
+        trace = client._request(
+            "GET", f"{coord.uri}/v1/query/{r.query_id}/trace")
+        spans = trace["spans"]
+        names = {s["name"] for s in spans}
+        # coordinator-side spans AND worker-side spans in ONE trace
+        assert {"query", "source-stage", "worker-task"} <= names
+        assert len({s["traceId"] for s in spans}) == 1
+        assert trace["traceId"] == spans[0]["traceId"]
+        # every non-root span's parent is a span in the same trace
+        ids = {s["spanId"] for s in spans}
+        for s in spans:
+            if s["parentSpanId"] is not None:
+                assert s["parentSpanId"] in ids, s
+        # worker spans attribute their service
+        services = {s.get("service") for s in spans}
+        assert any(sv and sv.startswith("worker:") for sv in services)
+        # task rollup reached the completion surface
+        st = info["stageStats"]
+        assert st["tasks"] >= 2 and st["bytesShuffled"] > 0
+    finally:
+        client.execute("SET SESSION enable_tracing = false")
+
+
+def test_client_traceparent_continues_callers_trace(cluster):
+    """A client that sends its own W3C context gets the query trace
+    rooted under ITS span (same trace id, coordinator query span
+    parented on the caller's span id)."""
+    coord, workers, session = cluster
+    caller = Tracer(service="caller")
+    with caller.span("app-request") as app:
+        client = Client(coord.uri, user="obs",
+                        traceparent=caller.traceparent())
+        client.execute("SET SESSION enable_tracing = true")
+        try:
+            r = client.execute(DIST_SQL)
+        finally:
+            client.execute("SET SESSION enable_tracing = false")
+    trace = client._request(
+        "GET", f"{coord.uri}/v1/query/{r.query_id}/trace")
+    assert trace["traceId"] == caller.trace_id
+    q = next(s for s in trace["spans"] if s["name"] == "query")
+    assert q["parentSpanId"] == app.span_id
+
+
+def test_cluster_trace_empty_when_tracing_disabled(cluster):
+    coord, workers, session = cluster
+    client = Client(coord.uri, user="obs")
+    r = client.execute(DIST_SQL)
+    trace = client._request(
+        "GET", f"{coord.uri}/v1/query/{r.query_id}/trace")
+    assert trace["spans"] == []
+    # and the session-level tracer collected nothing either
+    assert session.tracer.export() == []
+
+
+def test_metrics_endpoints_serve_prometheus(cluster):
+    coord, workers, session = cluster
+    from urllib.request import urlopen
+    from trino_tpu.metrics import QUERIES
+    finished0 = QUERIES.value(state="FINISHED")
+    client = Client(coord.uri, user="obs")
+    client.execute(DIST_SQL)
+    for uri in (coord.uri, workers[0].uri):
+        with urlopen(f"{uri}/v1/metrics", timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        names = _assert_prometheus_text(text)
+        # the acceptance surface: operator rows/bytes, scheduler
+        # hedge/retry counters, CRC failures — present even at 0
+        assert "trino_tpu_operator_rows_total" in names
+        assert "trino_tpu_task_output_bytes_total" in names
+        assert "trino_tpu_sched_task_retries_total" in names
+        assert "trino_tpu_sched_hedges_total" in names
+        assert "trino_tpu_pageserde_crc_failures_total" in names
+        assert "trino_tpu_http_requests_total" in names
+    # counters moved for the known query
+    assert QUERIES.value(state="FINISHED") >= finished0 + 1
+    from trino_tpu.metrics import OPERATOR_ROWS, TASK_OUTPUT_BYTES
+    assert OPERATOR_ROWS.value(operator="scan") > 0
+    assert TASK_OUTPUT_BYTES.value() > 0
+
+
+def test_explain_analyze_distributed_shows_stage_rows(cluster):
+    coord, workers, session = cluster
+    # cold spool: a durable-exchange hit would satisfy the query
+    # without dispatching tasks (no TaskStats to roll up)
+    coord.state.scheduler.spool.clear()
+    client = Client(coord.uri, user="obs")
+    r = client.execute("EXPLAIN ANALYZE " + DIST_SQL)
+    assert client.query_info(r.query_id)["distributed"]
+    text = "\n".join(row[0] for row in r.rows)
+    assert "Distributed execution" in text
+    m = re.search(r"Stage source: tasks=(\d+), splits=(\d+), "
+                  r"rows=(\d+)", text)
+    assert m, text
+    assert int(m.group(1)) >= 2 and int(m.group(3)) > 0
+    # per-operator rollup (worker profiling forced by EXPLAIN ANALYZE)
+    assert re.search(r"operator \w+: rows=\d+, wall=", text), text
+
+
+def test_completed_event_carries_distributed_rollup(cluster):
+    coord, workers, session = cluster
+    # cold spool: a durable-exchange hit would satisfy the query
+    # without dispatching tasks (no TaskStats to roll up)
+    coord.state.scheduler.spool.clear()
+
+    class Recorder2(EventListener):
+        def __init__(self):
+            self.completed = []
+
+        def query_completed(self, ev):
+            self.completed.append(ev)
+
+    rec = Recorder2()
+    coord.state.dispatcher.event_listeners.register(rec)
+    client = Client(coord.uri, user="obs")
+    r = client.execute(DIST_SQL)
+    ev = next(e for e in rec.completed if e.query_id == r.query_id)
+    assert ev.state == "FINISHED"
+    assert ev.tasks >= 2
+    assert ev.bytes_shuffled > 0
+    assert ev.stages >= 2
+
+
+def test_system_runtime_tasks_and_operator_stats(cluster):
+    coord, workers, session = cluster
+    # cold spool: a durable-exchange hit would satisfy the query
+    # without dispatching tasks (no TaskStats to roll up)
+    coord.state.scheduler.spool.clear()
+    client = Client(coord.uri, user="obs")
+    client.execute(DIST_SQL)
+    r = client.execute("SELECT node_id, rows, bytes FROM "
+                      "system.runtime.tasks")
+    assert len(r.rows) >= 2
+    assert any(int(row[2]) > 0 for row in r.rows)
+    # operator_stats fills from profiled runs (EXPLAIN ANALYZE above or
+    # traced queries); at minimum the table is queryable
+    r2 = client.execute("SELECT operator, rows FROM "
+                       "system.runtime.operator_stats")
+    assert r2.state == "FINISHED"
 
 
 def test_verifier_detects_match_and_mismatch():

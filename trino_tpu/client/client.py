@@ -35,13 +35,19 @@ class ClientResult:
 class Client:
     def __init__(self, uri: str, user: str = "anonymous",
                  poll_interval_s: float = 0.05, timeout_s: float = 300.0,
-                 spooled: bool = False, password: Optional[str] = None):
+                 spooled: bool = False, password: Optional[str] = None,
+                 traceparent: Optional[str] = None):
         self.uri = uri.rstrip("/")
         self.user = user
         self.password = password   # X-Trino-Password credential
         self.poll_interval_s = poll_interval_s
         self.timeout_s = timeout_s
         self.spooled = spooled     # opt into the spooled result protocol
+        # W3C trace context: carried on every request (statement POST,
+        # nextUri polls, spooled segment get/ack) so an enable_tracing
+        # query's trace continues the CALLER's trace instead of rooting
+        # a fresh one (utils/tracing.py parses it coordinator-side)
+        self.traceparent = traceparent
 
     def _request(self, method: str, url: str,
                  body: Optional[bytes] = None) -> dict:
@@ -51,6 +57,8 @@ class Client:
             headers["X-Trino-Password"] = self.password
         if self.spooled:
             headers["X-Trino-Spooled"] = "true"
+        if self.traceparent is not None:
+            headers["traceparent"] = self.traceparent
         req = Request(url, data=body, method=method, headers=headers)
         with urlopen(req, timeout=30) as resp:
             payload = resp.read()

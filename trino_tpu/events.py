@@ -4,14 +4,20 @@ Reference: spi/eventlistener (QueryCreatedEvent / QueryCompletedEvent /
 SplitCompletedEvent) dispatched by EventListenerManager
 (eventlistener/EventListenerManager.java:56) to plugins (http, kafka,
 mysql, openlineage). Here: the same contract as a Python protocol; the
-coordinator dispatches on query creation and completion.
+coordinator dispatches on query creation and completion. Completion events
+carry the distributed execution rollup (stages/tasks/bytes shuffled/faults
+survived) so a listener can build billing or SLO pipelines without
+scraping /v1/query.
 """
 
 from __future__ import annotations
 
+import logging
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional
+
+log = logging.getLogger("trino_tpu.events")
 
 
 @dataclass(frozen=True)
@@ -33,6 +39,12 @@ class QueryCompletedEvent:
     rows: int
     retries: int
     end_time: float
+    # distributed-execution rollup (0 when the query ran coordinator-local)
+    stages: int = 0
+    tasks: int = 0
+    bytes_shuffled: int = 0
+    faults_survived: int = 0      # task retries + checksum rejections
+    hedges_fired: int = 0
 
 
 class EventListener:
@@ -49,26 +61,40 @@ class EventListener:
 class EventListenerManager:
     def __init__(self):
         self._listeners: List[EventListener] = []
+        self._logged: set = set()
 
     def register(self, listener: EventListener) -> None:
         self._listeners.append(listener)
 
+    def _dispatch(self, hook: str, ev) -> None:
+        for li in self._listeners:
+            try:
+                getattr(li, hook)(ev)
+            except Exception:   # listener failures never kill queries —
+                # but a silently broken listener is undiagnosable, so log
+                # the first failure of each (listener, hook) pair
+                key = (id(li), hook)
+                if key not in self._logged:
+                    self._logged.add(key)
+                    log.exception(
+                        "event listener %s failed in %s "
+                        "(further failures suppressed)",
+                        type(li).__name__, hook)
+
     def query_created(self, tq) -> None:
         ev = QueryCreatedEvent(tq.query_id, tq.session_user, tq.sql,
                                time.time())
-        for li in self._listeners:
-            try:
-                li.query_created(ev)
-            except Exception:          # listener failures never kill queries
-                pass
+        self._dispatch("query_created", ev)
 
     def query_completed(self, tq) -> None:
+        st = getattr(tq, "stage_stats", None) or {}
         ev = QueryCompletedEvent(
             tq.query_id, tq.session_user, tq.sql, tq.state,
             tq.state_machine.error, tq.elapsed_s, tq.rows_returned,
-            tq.retries, time.time())
-        for li in self._listeners:
-            try:
-                li.query_completed(ev)
-            except Exception:
-                pass
+            tq.retries, time.time(),
+            stages=int(st.get("stages", 0)),
+            tasks=len(st.get("tasks", ())),
+            bytes_shuffled=int(st.get("bytes_shuffled", 0)),
+            faults_survived=int(st.get("faults_survived", 0)),
+            hedges_fired=int(st.get("hedged_tasks", 0)))
+        self._dispatch("query_completed", ev)

@@ -511,6 +511,8 @@ def _verify_record_adaptation(executor, skey, adapt, chunk_stats) -> bool:
     for i in range(n_joins):
         measured.extend((int(vals[2 + i]), int(vals[2 + n_joins + i])))
     if esc_h > 0 or over_h > 0:
+        if over_h > 0:
+            executor.stats.compaction_overflows += 1
         # stale guesses: drop the record so the rerun runs PLAIN and
         # re-measures (an adapted rerun from these numbers could loop —
         # escaped rows depress the live measurement)
@@ -709,6 +711,7 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
                     gather_mode=gmode)
                 if mine is not None:
                     jitted = jax.jit(mine[0])
+                    executor.stats.jit_compiles += 1
                     if ckey is not None:
                         if len(executor._fused_cache) >= 8:
                             executor._fused_cache.pop(
@@ -800,6 +803,7 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
             # this run's data: results would be wrong — rerun with the
             # plain program (the stale measurement was just invalidated,
             # so the retry does not re-adapt)
+            executor.stats.escaped_window_reruns += 1
             _prof("adaptation violated; plain rerun")
             return execute_chunked(executor, root)
     _prof("chunk loop dispatched; merging")

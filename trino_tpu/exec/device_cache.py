@@ -295,10 +295,13 @@ class FactTableCache:
         import os as _os
         import sys as _sys
         import time as _time
+        from ..metrics import DEVICE_CACHE_HITS, DEVICE_CACHE_MISSES
         prof_on = profile_enabled()
         hit = self.get(key)
         if hit is not None:
+            DEVICE_CACHE_HITS.inc()
             return hit
+        DEVICE_CACHE_MISSES.inc()
         t0 = _time.monotonic()
         warm_transfer_path()
         if prof_on:

@@ -56,10 +56,21 @@ class ExecStats:
     fused_chunk_pipelines: int = 0   # whole-chunk-path single programs
     pallas_gather_calls: int = 0     # probe sites dispatched with the
                                      # tiled-gather kernel enabled
+    jit_compiles: int = 0            # new jitted programs built (fused
+                                     # chunk pipelines compiled fresh)
+    escaped_window_reruns: int = 0   # adapted fused runs whose window /
+                                     # capacity guesses were violated
+    compaction_overflows: int = 0    # in-program compaction capacity hit
 
 
 class QueryDeadlineError(RuntimeError):
     """query_max_run_time_s exceeded (QUERY_MAX_RUN_TIME's role)."""
+
+
+# serializes ExecStats->metrics snapshot diffs across task threads
+import threading as _threading  # noqa: E402
+
+_FLUSH_LOCK = _threading.Lock()
 
 
 def _subtree_scans(node: "L.PlanNode"):
@@ -174,6 +185,29 @@ class Executor:
         # costs nothing and removes any doubt after DML
         self._decision_cache.clear()
 
+    def flush_metrics(self) -> None:
+        """Mirror ExecStats deltas since the last flush into the process
+        metrics registry (trino_tpu_exec_events_total{event=...}).
+        ExecStats stays the cheap cumulative in-object view (bench and
+        tests read it directly); the registry gets increments so
+        /v1/metrics scrapes see the same counters fleet-wide. Guarded by
+        its own lock (NOT the executor lock — flushing must never block
+        behind a running query)."""
+        import dataclasses
+
+        from ..metrics import EXEC_EVENTS, OPERATOR_ROWS
+        with _FLUSH_LOCK:
+            cur = dataclasses.asdict(self.stats)
+            prev = getattr(self, "_stats_flushed", {})
+            for k, v in cur.items():
+                d = v - prev.get(k, 0)
+                if d:
+                    EXEC_EVENTS.inc(d, event=k)
+            d = cur["rows_scanned"] - prev.get("rows_scanned", 0)
+            if d:
+                OPERATOR_ROWS.inc(d, operator="scan")
+            self._stats_flushed = cur
+
     def execute(self, root: L.OutputNode) -> Batch:
         assert isinstance(root, L.OutputNode)
         # release reservations surviving from the previous query (the root
@@ -228,8 +262,20 @@ class Executor:
             # operator/OperatorStats.java:37)
             rows = int(jnp.sum(out.live))
             self.node_stats[id(node)] = (time.monotonic() - t0, rows)
+            from ..metrics import OPERATOR_ROWS
+            OPERATOR_ROWS.inc(rows, operator=type(node).__name__)
         else:
+            # always-on operator metrics: host dispatch wall only (device
+            # work stays async — a per-node sync here would serialize the
+            # whole pipeline, which is exactly what profile mode pays for)
+            import time as _time
+            t0 = _time.monotonic()
             out = self.dispatch(node)
+            from ..metrics import OPERATOR_DISPATCHES, OPERATOR_WALL_MS
+            op = type(node).__name__
+            OPERATOR_DISPATCHES.inc(operator=op)
+            OPERATOR_WALL_MS.inc((_time.monotonic() - t0) * 1000,
+                                 operator=op)
         # memory accounting: reserve this node's output, release the
         # children's (their batches die once the parent has consumed them)
         # — the operator->query context pyramid collapsed to plan nodes

@@ -93,6 +93,10 @@ SESSION_PROPERTY_DEFAULTS = {
     # build sides estimated above this stream chunk-wise through the
     # dense LUT with host-side payload gathers (spill tier v2; 0 = off)
     "stream_build_min_kb": (0, int),
+    # distributed tracing (utils/tracing.py): when on, every query runs
+    # under a propagating tracer — coordinator + worker spans stitch into
+    # one trace served at GET /v1/query/{id}/trace
+    "enable_tracing": (False, _bool),
 }
 
 
@@ -168,6 +172,7 @@ class Session:
         with self.tracer.span("decode", rows=len(arrays[0])
                               if arrays else 0):
             rows = self.decode_rows(rel, arrays, valids)
+        self.executor.flush_metrics()
         return QueryResult(names, rows, time.monotonic() - t0,
                            self.executor.stats)
 
@@ -259,6 +264,9 @@ class Session:
         elif stmt.name == "enable_pallas_gather":
             self.executor.enable_pallas_gather = \
                 self.properties[stmt.name]
+        elif stmt.name == "enable_tracing":
+            from ..utils.tracing import NOOP, Tracer
+            self.tracer = Tracer() if self.properties[stmt.name] else NOOP
         return QueryResult(["result"], [("SET SESSION",)],
                            time.monotonic() - t0)
 

@@ -1,0 +1,308 @@
+"""Prometheus-style metrics registry: counters, gauges, histograms.
+
+Reference: Trino exposes its operator/task/query counters through JMX and
+the /v1/status + OpenMetrics endpoints (io.airlift.stats counters wired by
+ServerMainModule; the openmetrics plugin renders them in Prometheus text
+exposition format). Here: one dependency-free registry shared by every
+layer — executors, pageserde, scheduler, spool, HTTP servers — rendered as
+Prometheus text on `GET /v1/metrics` of both coordinator and worker.
+
+Design constraints:
+- hot-path cost is one dict lookup + one float add under a lock (the
+  executor increments per plan node, the serde per frame) — no metric may
+  force a device sync or an allocation beyond the label-key tuple;
+- metrics that acceptance checks scrape (operator rows, scheduler
+  retries/hedges, CRC failures) are PRE-INITIALIZED at import so a fresh
+  server renders them at 0 instead of omitting them;
+- registration is idempotent: re-importing or re-declaring a metric with
+  the same name returns the existing instance (kind mismatch raises).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, Iterable, Tuple
+
+
+def _escape(v: object) -> str:
+    return str(v).replace("\\", r"\\").replace("\n", r"\n").replace(
+        '"', r'\"')
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Tuple[str, ...],
+                 lock: threading.Lock):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = lock
+        # label-value tuple -> float; unlabeled metrics live under ()
+        self._values: "OrderedDict[tuple, float]" = OrderedDict()
+        if not self.labelnames:
+            self._values[()] = 0.0
+
+    def _key(self, labels: Dict[str, object]) -> tuple:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} expects labels {self.labelnames}, "
+                f"got {tuple(labels)}")
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def init_labels(self, **labels) -> None:
+        """Pre-create a zero-valued sample so the label combination
+        renders before its first increment (scrape-surface stability)."""
+        key = self._key(labels)
+        with self._lock:
+            self._values.setdefault(key, 0.0)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def has_sample(self, **labels) -> bool:
+        with self._lock:
+            return self._key(labels) in self._values
+
+    def _sample_line(self, key: tuple, value: float,
+                     suffix: str = "", extra: tuple = ()) -> str:
+        pairs = list(zip(self.labelnames, key)) + list(extra)
+        labels = ",".join(f'{n}="{_escape(v)}"' for n, v in pairs)
+        body = f"{{{labels}}}" if labels else ""
+        if value == int(value):
+            return f"{self.name}{suffix}{body} {int(value)}"
+        return f"{self.name}{suffix}{body} {value}"
+
+    def render(self) -> Iterable[str]:
+        with self._lock:
+            items = list(self._values.items())
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} {self.kind}"
+        for key, value in items:
+            yield self._sample_line(key, value)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (classic Prometheus layout):
+    name_bucket{le=...}, name_sum, name_count per label set."""
+
+    kind = "histogram"
+    DEFAULT_BUCKETS = (0.005, 0.025, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0)
+
+    def __init__(self, name, help, labelnames, lock, buckets=None):
+        super().__init__(name, help, labelnames, lock)
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._values.pop((), None)       # histograms use structured slots
+        self._hists: Dict[tuple, list] = {}
+        if not self.labelnames:
+            self._hists[()] = [0] * (len(self.buckets) + 2)
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = [0] * (len(self.buckets) + 2)
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    h[i] += 1
+            h[-2] += 1                   # count
+            h[-1] += value               # sum
+
+    def value(self, **labels) -> float:  # count, for test symmetry
+        with self._lock:
+            h = self._hists.get(self._key(labels))
+            return h[-2] if h else 0.0
+
+    def has_sample(self, **labels) -> bool:
+        with self._lock:
+            return self._key(labels) in self._hists
+
+    def render(self) -> Iterable[str]:
+        with self._lock:
+            items = [(k, list(h)) for k, h in self._hists.items()]
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} {self.kind}"
+        for key, h in items:
+            for i, b in enumerate(self.buckets):
+                yield self._sample_line(key, h[i], suffix="_bucket",
+                                        extra=(("le", b),))
+            yield self._sample_line(key, h[-2], suffix="_bucket",
+                                    extra=(("le", "+Inf"),))
+            yield self._sample_line(key, h[-1], suffix="_sum")
+            yield self._sample_line(key, h[-2], suffix="_count")
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: "OrderedDict[str, _Metric]" = OrderedDict()
+
+    def _register(self, cls, name, help, labelnames, **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if type(m) is not cls:
+                    raise ValueError(
+                        f"metric {name} already registered as {m.kind}")
+                return m
+            m = cls(name, help, tuple(labelnames),
+                    threading.Lock(), **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="", labelnames=()) -> Counter:
+        return self._register(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()) -> Gauge:
+        return self._register(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(),
+                  buckets=None) -> Histogram:
+        return self._register(Histogram, name, help, labelnames,
+                              buckets=buckets)
+
+    def get(self, name) -> _Metric:
+        with self._lock:
+            return self._metrics[name]
+
+    def render(self) -> str:
+        """Full registry in Prometheus text exposition format."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[tuple, float]:
+        """{(name, label-values...): value} — bench/test delta helper."""
+        out = {}
+        with self._lock:
+            metrics = list(self._metrics.items())
+        for name, m in metrics:
+            if isinstance(m, Histogram):
+                with m._lock:
+                    for k, h in m._hists.items():
+                        out[(name,) + k] = h[-2]
+            else:
+                with m._lock:
+                    for k, v in m._values.items():
+                        out[(name,) + k] = v
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the process-global registry plus the engine's metric families. In a real
+# multi-host deployment each process (coordinator or worker) has its own;
+# the in-process test cluster shares one, which is also what the shared
+# jitted-kernel executor implies.
+# ---------------------------------------------------------------------------
+
+REGISTRY = MetricsRegistry()
+
+# HTTP surface (both servers route through their ROUTES table)
+HTTP_REQUESTS = REGISTRY.counter(
+    "trino_tpu_http_requests_total",
+    "HTTP requests served, by server role and route",
+    ("server", "route"))
+
+# query lifecycle (coordinator dispatcher)
+QUERIES = REGISTRY.counter(
+    "trino_tpu_queries_total", "Queries reaching a terminal state",
+    ("state",))
+QUERY_SECONDS = REGISTRY.histogram(
+    "trino_tpu_query_seconds", "End-to-end query wall time (seconds)")
+
+# executor operators (exec/executor.py — per plan-node dispatch)
+OPERATOR_DISPATCHES = REGISTRY.counter(
+    "trino_tpu_operator_dispatch_total",
+    "Plan-node kernel dispatches, by operator", ("operator",))
+OPERATOR_WALL_MS = REGISTRY.counter(
+    "trino_tpu_operator_wall_ms_total",
+    "Host wall-clock spent dispatching each operator (ms; async device "
+    "work overlaps unless profiling)", ("operator",))
+OPERATOR_ROWS = REGISTRY.counter(
+    "trino_tpu_operator_rows_total",
+    "Rows flowing through instrumented operators", ("operator",))
+EXEC_EVENTS = REGISTRY.counter(
+    "trino_tpu_exec_events_total",
+    "Executor adaptive-path events mirrored from ExecStats", ("event",))
+
+# worker task output (server/tasks.py)
+TASK_OUTPUT_ROWS = REGISTRY.counter(
+    "trino_tpu_task_output_rows_total",
+    "Rows emitted into worker task output buffers")
+TASK_OUTPUT_BYTES = REGISTRY.counter(
+    "trino_tpu_task_output_bytes_total",
+    "Encoded page-frame bytes emitted into worker task output buffers")
+
+# device-resident fact cache (exec/device_cache.py)
+DEVICE_CACHE_HITS = REGISTRY.counter(
+    "trino_tpu_device_cache_hits_total",
+    "Fact-table device cache hits")
+DEVICE_CACHE_MISSES = REGISTRY.counter(
+    "trino_tpu_device_cache_misses_total",
+    "Fact-table device cache misses (narrow + ingest paid)")
+
+# scheduler (server/scheduler.py)
+SCHED_TASKS = REGISTRY.counter(
+    "trino_tpu_sched_tasks_total", "Remote tasks dispatched to workers")
+SCHED_TASK_RETRIES = REGISTRY.counter(
+    "trino_tpu_sched_task_retries_total",
+    "Task-retry rounds (failed splits reassigned to survivors)")
+SCHED_HEDGES = REGISTRY.counter(
+    "trino_tpu_sched_hedges_total",
+    "Speculative straggler re-dispatches fired")
+SCHED_HEDGE_WINS = REGISTRY.counter(
+    "trino_tpu_sched_hedge_wins_total",
+    "Hedged attempts that beat the original task")
+
+# page serde integrity (server/pageserde.py)
+PAGE_CRC_FAILURES = REGISTRY.counter(
+    "trino_tpu_pageserde_crc_failures_total",
+    "Page frames rejected by the CRC32C integrity gate")
+
+# control-plane retries (server/retrypolicy.py)
+RETRY_ATTEMPTS = REGISTRY.counter(
+    "trino_tpu_retry_attempts_total",
+    "RetryPolicy re-attempts after a retryable failure", ("component",))
+
+# durable exchange spool (server/exchange_spool.py)
+SPOOL_HITS = REGISTRY.counter(
+    "trino_tpu_spool_hits_total",
+    "Exchange-spool reads satisfied from a prior attempt's output")
+SPOOL_MISSES = REGISTRY.counter(
+    "trino_tpu_spool_misses_total",
+    "Exchange-spool reads that missed (work dispatched live)")
+
+# the labeled families acceptance scrapes: seed the hot label values so
+# a cold server's /v1/metrics already carries them at 0
+for _op in ("scan", "output"):
+    OPERATOR_ROWS.init_labels(operator=_op)
+RETRY_ATTEMPTS.init_labels(component="announce")

@@ -11,6 +11,12 @@ failure detector (failuredetector/HeartbeatFailureDetector.java:344).
 
 stdlib http.server only — the protocol layer is host-side control plane;
 the TPU data plane stays inside the jitted stage programs.
+
+Observability: routes live in the ROUTES table (server/routes.py) so every
+request lands in trino_tpu_http_requests_total; /v1/metrics serves the
+process registry in Prometheus text; `enable_tracing` sessions run each
+query under a propagating tracer whose stitched trace (coordinator +
+worker spans) is served at GET /v1/query/{id}/trace.
 """
 
 from __future__ import annotations
@@ -20,14 +26,47 @@ import threading
 import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
+from contextlib import nullcontext
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
-from urllib.parse import urlparse
 
 from ..exec.session import Session
+from .routes import STAR, dispatch, register_routes
 from .statemachine import QueryStateMachine, QueryTracker, TrackedQuery
 
 PAGE_ROWS = 1000          # rows per protocol page (target-result-size analog)
+
+SERVER_NAME = "coordinator"
+
+# (METHOD, pattern, handler method, needs_auth) — see server/routes.py.
+# /v1/info, /v1/status and /v1/metrics stay open (liveness + scrape
+# surface, no query data); everything that exposes query text/results
+# authenticates.
+ROUTES = (
+    ("POST", ("v1", "statement"), "_post_statement", True),
+    ("POST", ("v1", "announce"), "_post_announce", False),
+    ("GET", ("v1", "info"), "_get_info", False),
+    ("GET", ("v1", "status"), "_get_status", False),
+    ("GET", ("v1", "metrics"), "_get_metrics", False),
+    ("GET", ("v1", "spooled", "segments", STAR), "_get_segment", True),
+    ("GET", ("v1", "resourceGroup"), "_get_resource_group", True),
+    ("GET", ("v1", "node"), "_get_nodes", True),
+    ("GET", ("v1", "query"), "_get_queries", True),
+    ("GET", ("v1", "query", STAR), "_get_query", True),
+    ("GET", ("v1", "query", STAR, "trace"), "_get_query_trace", True),
+    ("GET", ("v1", "statement", "executing", STAR), "_get_executing",
+     True),
+    ("GET", ("v1", "statement", "executing", STAR, STAR),
+     "_get_executing", True),
+    ("DELETE", ("v1", "spooled", "segments", STAR), "_delete_segment",
+     True),
+    ("DELETE", ("v1", "statement", "executing", STAR),
+     "_delete_executing", True),
+    ("DELETE", ("v1", "statement", "executing", STAR, STAR),
+     "_delete_executing", True),
+)
+
+register_routes(SERVER_NAME, ROUTES)
 
 
 class QueryDeclinedError(RuntimeError):
@@ -93,14 +132,22 @@ class Dispatcher:
         self.authenticator = None            # None = open cluster
         self.access_control = AllowAllAccessControl()
 
-    def submit(self, sql: str, user: str) -> TrackedQuery:
+    def submit(self, sql: str, user: str,
+               traceparent: Optional[str] = None) -> TrackedQuery:
         qid = self.tracker.next_query_id()
-        tq = TrackedQuery(qid, sql, user, QueryStateMachine(qid))
+        tq = TrackedQuery(qid, sql, user, QueryStateMachine(qid),
+                          traceparent=traceparent)
         self.tracker.register(tq)
         self.event_listeners.query_created(tq)
-        tq.state_machine.add_listener(
-            lambda state: self.event_listeners.query_completed(tq)
-            if state in ("FINISHED", "FAILED", "CANCELED") else None)
+
+        def on_terminal(state):
+            if state in ("FINISHED", "FAILED", "CANCELED"):
+                from ..metrics import QUERIES, QUERY_SECONDS
+                QUERIES.inc(state=state)
+                QUERY_SECONDS.observe(tq.elapsed_s)
+                self.event_listeners.query_completed(tq)
+
+        tq.state_machine.add_listener(on_terminal)
         from .resourcegroups import QueryQueueFullError
         try:
             self.resource_groups.submit(
@@ -136,6 +183,15 @@ class Dispatcher:
             return
         except Exception:     # noqa: BLE001 — malformed SQL fails later
             pass              # with its real parse/analysis error
+        # per-query tracer (enable_tracing sessions): adopts the client's
+        # traceparent when present so the query trace continues the
+        # caller's trace; exported to tq.trace at the end either way
+        tracer = None
+        if self.session.properties.get("enable_tracing"):
+            from ..utils.tracing import Tracer
+            tracer = Tracer.from_traceparent(tq.traceparent,
+                                             service="coordinator")
+            tq.tracer = tracer
         last_error: Optional[str] = None
         # backoff between QUERY-retry attempts (shared RetryPolicy,
         # decorrelated jitter): failed queries re-admitting immediately
@@ -143,64 +199,91 @@ class Dispatcher:
         from .retrypolicy import RetryPolicy
         retry_waits = RetryPolicy(base_delay_s=0.05, max_delay_s=1.0,
                                   max_attempts=attempts).delays()
-        for attempt in range(attempts):
-            if sm.is_done():
-                return
-            if attempt > 0:
-                time.sleep(next(retry_waits, 1.0))
-            try:
+        try:
+            for attempt in range(attempts):
+                if sm.is_done():
+                    return
                 if attempt > 0:
-                    tq.retries = attempt
-                if self.failure_injector is not None:
-                    self.failure_injector.maybe_fail("DISPATCH", tq.sql)
-                with self.exec_lock:
-                    if sm.is_done():
-                        return
-                    sm.transition("RUNNING")
+                    from ..metrics import RETRY_ATTEMPTS
+                    RETRY_ATTEMPTS.inc(component="dispatch")
+                    time.sleep(next(retry_waits, 1.0))
+                try:
+                    if attempt > 0:
+                        tq.retries = attempt
                     if self.failure_injector is not None:
-                        self.failure_injector.maybe_fail("EXECUTION",
+                        self.failure_injector.maybe_fail("DISPATCH",
                                                          tq.sql)
-                    t0 = time.monotonic()
-                    result = None
-                    if self.scheduler is not None:
-                        # cluster path: fragment + dispatch to workers;
-                        # None = not eligible / no workers (coordinator
-                        # executes locally, Trino's coordinator-only path)
-                        from .scheduler import TaskFailedError
+                    with self.exec_lock:
+                        if sm.is_done():
+                            return
+                        sm.transition("RUNNING")
+                        if self.failure_injector is not None:
+                            self.failure_injector.maybe_fail("EXECUTION",
+                                                             tq.sql)
+                        saved_tracer = self.session.tracer
+                        if tracer is not None:
+                            self.session.tracer = tracer
                         try:
-                            result = self.scheduler.execute(tq.sql)
-                            tq.fallback_reason = \
-                                self.scheduler.fallback_reason \
-                                if result is None else None
-                        except TaskFailedError as te:
-                            result = None   # degrade to local execution
-                            tq.fallback_reason = f"task failure: {te}"
-                        tq.distributed = result is not None
-                    if result is None and getattr(
-                            self.session, "properties", {}).get(
-                            "require_distributed") and \
-                            tq.fallback_reason != \
-                            "coordinator-only statement":
-                        # SET SESSION/SHOW and friends never distribute
-                        # by design — erroring on them would brick the
-                        # very statement that turns the property off
-                        raise QueryDeclinedError(
-                            "require_distributed: cluster declined the "
-                            f"query ({tq.fallback_reason})")
-                    if result is None:
-                        result = self.session.execute(tq.sql)
-                    tq.elapsed_s = time.monotonic() - t0
-                tq.result = result
-                tq.rows_returned = len(result.rows)
-                sm.transition("FINISHING")
-                sm.transition("FINISHED")
-                return
-            except Exception as e:        # noqa: BLE001 — retry boundary
-                last_error = f"{type(e).__name__}: {e}"
-                tq.plan_text = traceback.format_exc()
-                if not _is_retryable(e):
-                    break
-        sm.fail(last_error or "query failed")
+                            with (tracer.span("query",
+                                              queryId=tq.query_id,
+                                              user=tq.session_user,
+                                              attempt=attempt)
+                                  if tracer is not None
+                                  else nullcontext()):
+                                self._execute_attempt(tq)
+                        finally:
+                            self.session.tracer = saved_tracer
+                    sm.transition("FINISHING")
+                    sm.transition("FINISHED")
+                    return
+                except Exception as e:  # noqa: BLE001 — retry boundary
+                    last_error = f"{type(e).__name__}: {e}"
+                    tq.plan_text = traceback.format_exc()
+                    if not _is_retryable(e):
+                        break
+            sm.fail(last_error or "query failed")
+        finally:
+            if tracer is not None:
+                tq.trace = tracer.export()
+
+    def _execute_attempt(self, tq: TrackedQuery) -> None:
+        """One execution attempt under the exec lock: cluster path first,
+        local fallback second (Trino's coordinator-only path)."""
+        t0 = time.monotonic()
+        result = None
+        if self.scheduler is not None:
+            # cluster path: fragment + dispatch to workers; None = not
+            # eligible / no workers (coordinator executes locally)
+            from .scheduler import TaskFailedError
+            try:
+                result = self.scheduler.execute(tq.sql,
+                                                query_id=tq.query_id)
+                tq.fallback_reason = self.scheduler.fallback_reason \
+                    if result is None else None
+            except TaskFailedError as te:
+                result = None   # degrade to local execution
+                tq.fallback_reason = f"task failure: {te}"
+            tq.distributed = result is not None
+            if tq.distributed:
+                # per-query stage/task rollup for events +
+                # system.runtime tables + /v1/query info
+                tq.stage_stats = getattr(self.scheduler,
+                                         "last_query", None)
+        if result is None and getattr(
+                self.session, "properties", {}).get(
+                "require_distributed") and \
+                tq.fallback_reason != "coordinator-only statement":
+            # SET SESSION/SHOW and friends never distribute by design —
+            # erroring on them would brick the very statement that turns
+            # the property off
+            raise QueryDeclinedError(
+                "require_distributed: cluster declined the "
+                f"query ({tq.fallback_reason})")
+        if result is None:
+            result = self.session.execute(tq.sql)
+        tq.elapsed_s = time.monotonic() - t0
+        tq.result = result
+        tq.rows_returned = len(result.rows)
 
 
 class CoordinatorState:
@@ -219,7 +302,8 @@ class CoordinatorState:
         self.dispatcher.scheduler = self.scheduler
         from .spooling import SpoolingManager
         self.spooling = SpoolingManager()
-        # system.runtime.{queries,nodes} backed by this coordinator's state
+        # system.runtime.{queries,nodes,tasks,operator_stats} backed by
+        # this coordinator's state
         from .system_connector import SystemConnector
         session.catalog.register("system", SystemConnector(self))
 
@@ -285,6 +369,18 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, code: int, text: str,
+                   content_type: str = "text/plain; version=0.0.4") -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _not_found(self, path: str) -> None:
+        self._send(404, {"error": {"message": f"no route {path}"}})
+
     def _base(self) -> str:
         host = self.headers.get("Host", "localhost")
         return f"http://{host}"
@@ -341,8 +437,6 @@ class _Handler(BaseHTTPRequestHandler):
                                   f"{tq.query_id}/{token + 1}")
         return payload
 
-    # -- routes -----------------------------------------------------------
-
     def _authenticate(self):
         """Returns the authenticated user, or None after sending 401.
         Open clusters (no authenticator) pass the header user through."""
@@ -370,112 +464,133 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
             return None
 
+    # -- dispatch ----------------------------------------------------------
+
     def do_POST(self):
-        path = urlparse(self.path).path
-        if path == "/v1/statement":
-            user = self._authenticate()
-            if user is None:
-                return
-            sql = self._read_body()
-            if not sql.strip():
-                self._send(400, {"error": {"message": "empty statement"}})
-                return
-            tq = self.state.dispatcher.submit(sql, user)
-            self._send(200, self._query_payload(tq, 0))
-            return
-        if path == "/v1/announce":
-            body = json.loads(self._read_body() or "{}")
-            self.state.announce(body.get("nodeId", "unknown"),
-                                body.get("uri", ""))
-            self._send(202, {"ok": True})
-            return
-        self._send(404, {"error": {"message": f"no route {path}"}})
+        dispatch(self, "POST", ROUTES, SERVER_NAME)
 
     def do_GET(self):
-        path = urlparse(self.path).path
-        parts = [p for p in path.split("/") if p]
-        if path == "/v1/info":
-            self._send(200, {
-                "nodeVersion": {"version": "trino-tpu-0.1"},
-                "coordinator": True, "starting": False,
-                "uptime": time.time() - self.state.started_at})
-            return
-        if path == "/v1/status":
-            # liveness for load balancers / the failure detector: open
-            # even on a secured cluster (no query data exposed)
-            self._send(200, {"nodeId": "coordinator", "state": "ACTIVE"})
-            return
-        # every other GET exposes query texts/results: authenticate
-        # (liveness /v1/info and /v1/status stay open)
-        if self._authenticate() is None:
-            return
-        if len(parts) == 4 and parts[:3] == ["v1", "spooled", "segments"]:
-            data = self.state.spooling.read(parts[3])
-            if data is None:
-                self._send(404, {"error": {"message": "unknown segment"}})
-                return
-            self._send(200, {"data": data})
-            return
-        if path == "/v1/resourceGroup":
-            self._send(200, self.state.dispatcher.resource_groups.info())
-            return
-        if path == "/v1/node":
-            nodes = [{"nodeId": n.node_id, "uri": n.uri, "state": n.state}
-                     for n in self.state.nodes.values()]
-            self._send(200, nodes)
-            return
-        if len(parts) == 2 and parts[0] == "v1" and parts[1] == "query":
-            out = []
-            for tq in self.state.tracker.all():
-                out.append({"queryId": tq.query_id, "state": tq.state,
-                            "query": tq.sql, "user": tq.session_user})
-            self._send(200, out)
-            return
-        if len(parts) == 3 and parts[0] == "v1" and parts[1] == "query":
-            tq = self.state.tracker.get(parts[2])
-            if tq is None:
-                self._send(404, {"error": {"message": "unknown query"}})
-                return
-            sm = tq.state_machine
-            self._send(200, {
-                "queryId": tq.query_id, "state": tq.state, "query": tq.sql,
-                "user": tq.session_user, "error": sm.error,
-                "elapsedSeconds": tq.elapsed_s,
-                "rows": tq.rows_returned, "retries": tq.retries,
-                "distributed": tq.distributed,
-                "fallbackReason": tq.fallback_reason})
-            return
-        if len(parts) >= 4 and parts[:3] == ["v1", "statement", "executing"]:
-            qid, token = parts[3], int(parts[4]) if len(parts) > 4 else 0
-            tq = self.state.tracker.get(qid)
-            if tq is None:
-                self._send(404, {"error": {"message": "unknown query"}})
-                return
-            # long-poll lite: give the dispatcher a moment before answering
-            # (ExecutingStatementResource waits up to ~1s the same way)
-            deadline = time.time() + 0.5
-            while not tq.state_machine.is_done() and time.time() < deadline:
-                time.sleep(0.01)
-            self._send(200, self._query_payload(tq, token))
-            return
-        self._send(404, {"error": {"message": f"no route {path}"}})
+        dispatch(self, "GET", ROUTES, SERVER_NAME)
 
     def do_DELETE(self):
-        path = urlparse(self.path).path
-        parts = [p for p in path.split("/") if p]
-        if self._authenticate() is None:    # cancel/ack need credentials
+        dispatch(self, "DELETE", ROUTES, SERVER_NAME)
+
+    # -- routes -----------------------------------------------------------
+
+    def _post_statement(self, parts, user):
+        sql = self._read_body()
+        if not sql.strip():
+            self._send(400, {"error": {"message": "empty statement"}})
             return
-        if len(parts) == 4 and parts[:3] == ["v1", "spooled", "segments"]:
-            self.state.spooling.ack(parts[3])
-            self._send(204, {})
+        tq = self.state.dispatcher.submit(
+            sql, user, traceparent=self.headers.get("traceparent"))
+        self._send(200, self._query_payload(tq, 0))
+
+    def _post_announce(self, parts, user):
+        body = json.loads(self._read_body() or "{}")
+        self.state.announce(body.get("nodeId", "unknown"),
+                            body.get("uri", ""))
+        self._send(202, {"ok": True})
+
+    def _get_info(self, parts, user):
+        self._send(200, {
+            "nodeVersion": {"version": "trino-tpu-0.1"},
+            "coordinator": True, "starting": False,
+            "uptime": time.time() - self.state.started_at})
+
+    def _get_status(self, parts, user):
+        # liveness for load balancers / the failure detector: open
+        # even on a secured cluster (no query data exposed)
+        self._send(200, {"nodeId": "coordinator", "state": "ACTIVE"})
+
+    def _get_metrics(self, parts, user):
+        from ..metrics import REGISTRY
+        self._send_text(200, REGISTRY.render())
+
+    def _get_segment(self, parts, user):
+        data = self.state.spooling.read(parts[3])
+        if data is None:
+            self._send(404, {"error": {"message": "unknown segment"}})
             return
-        if len(parts) >= 4 and parts[:3] == ["v1", "statement", "executing"]:
-            tq = self.state.tracker.get(parts[3])
-            if tq is not None:
-                tq.state_machine.cancel()
-            self._send(204, {})
+        self._send(200, {"data": data})
+
+    def _get_resource_group(self, parts, user):
+        self._send(200, self.state.dispatcher.resource_groups.info())
+
+    def _get_nodes(self, parts, user):
+        nodes = [{"nodeId": n.node_id, "uri": n.uri, "state": n.state}
+                 for n in self.state.nodes.values()]
+        self._send(200, nodes)
+
+    def _get_queries(self, parts, user):
+        out = []
+        for tq in self.state.tracker.all():
+            out.append({"queryId": tq.query_id, "state": tq.state,
+                        "query": tq.sql, "user": tq.session_user})
+        self._send(200, out)
+
+    def _get_query(self, parts, user):
+        tq = self.state.tracker.get(parts[2])
+        if tq is None:
+            self._send(404, {"error": {"message": "unknown query"}})
             return
-        self._send(404, {"error": {"message": f"no route {path}"}})
+        sm = tq.state_machine
+        st = tq.stage_stats or {}
+        self._send(200, {
+            "queryId": tq.query_id, "state": tq.state, "query": tq.sql,
+            "user": tq.session_user, "error": sm.error,
+            "elapsedSeconds": tq.elapsed_s,
+            "rows": tq.rows_returned, "retries": tq.retries,
+            "distributed": tq.distributed,
+            "fallbackReason": tq.fallback_reason,
+            "stageStats": {
+                "stages": st.get("stages", 0),
+                "tasks": len(st.get("tasks", ())),
+                "bytesShuffled": st.get("bytes_shuffled", 0),
+                "taskRetries": st.get("task_retries", 0),
+                "hedgedTasks": st.get("hedged_tasks", 0),
+                "hedgeWins": st.get("hedge_wins", 0),
+                "faultsSurvived": st.get("faults_survived", 0)}})
+
+    def _get_query_trace(self, parts, user):
+        """Stitched query trace (coordinator + adopted worker spans) as
+        OTLP-like JSON — the reference exports the same shape over OTLP."""
+        tq = self.state.tracker.get(parts[2])
+        if tq is None:
+            self._send(404, {"error": {"message": "unknown query"}})
+            return
+        spans = tq.trace
+        if spans is None and tq.tracer is not None:
+            spans = tq.tracer.export()    # still executing: live view
+        tracer = tq.tracer
+        self._send(200, {
+            "queryId": tq.query_id,
+            "traceId": tracer.trace_id if tracer is not None else None,
+            "spans": spans or []})
+
+    def _get_executing(self, parts, user):
+        qid = parts[3]
+        token = int(parts[4]) if len(parts) > 4 else 0
+        tq = self.state.tracker.get(qid)
+        if tq is None:
+            self._send(404, {"error": {"message": "unknown query"}})
+            return
+        # long-poll lite: give the dispatcher a moment before answering
+        # (ExecutingStatementResource waits up to ~1s the same way)
+        deadline = time.time() + 0.5
+        while not tq.state_machine.is_done() and time.time() < deadline:
+            time.sleep(0.01)
+        self._send(200, self._query_payload(tq, token))
+
+    def _delete_segment(self, parts, user):
+        self.state.spooling.ack(parts[3])
+        self._send(204, {})
+
+    def _delete_executing(self, parts, user):
+        tq = self.state.tracker.get(parts[3])
+        if tq is not None:
+            tq.state_machine.cancel()
+        self._send(204, {})
 
 
 class CoordinatorServer:

@@ -61,6 +61,7 @@ class ExchangeSpool:
         frame is CRC32C-verified here (the reference verifies exchange
         source handles the same way); a corrupt container is deleted so
         the next attempt re-creates it from a live task."""
+        from ..metrics import SPOOL_HITS, SPOOL_MISSES
         from .failureinjector import InjectedFailure
         from .pageserde import PageChecksumError, verify_page
         try:
@@ -69,6 +70,7 @@ class ExchangeSpool:
             with open(self._path(key), "rb") as f:
                 blob = f.read()
             if blob[:4] != self._MAGIC:
+                SPOOL_MISSES.inc()
                 return None
             (npages,) = struct.unpack_from("<I", blob, 4)
             off = 8
@@ -80,15 +82,18 @@ class ExchangeSpool:
                 off += ln
             for p in pages:
                 verify_page(p)
+            SPOOL_HITS.inc()
             return pages
         except PageChecksumError:
             self.checksum_rejects += 1
+            SPOOL_MISSES.inc()
             try:
                 os.unlink(self._path(key))
             except OSError:
                 pass
             return None
         except (OSError, ValueError, struct.error, InjectedFailure):
+            SPOOL_MISSES.inc()
             return None
 
     def put(self, key: str, pages: List[bytes]) -> None:

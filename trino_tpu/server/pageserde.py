@@ -56,7 +56,17 @@ _F_ZLIB = 2
 
 class PageChecksumError(ValueError):
     """Frame failed its CRC32C integrity check (or is truncated/garbled).
-    Retryable: the holder of the frame re-fetches or re-runs the work."""
+    Retryable: the holder of the frame re-fetches or re-runs the work.
+
+    Every raise is counted in the process metrics registry
+    (trino_tpu_pageserde_crc_failures_total) so corruption seen at any
+    verify site — coordinator drain, spool read, worker<->worker pull —
+    is visible on /v1/metrics without log spelunking."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        from ..metrics import PAGE_CRC_FAILURES
+        PAGE_CRC_FAILURES.inc()
 
 
 try:

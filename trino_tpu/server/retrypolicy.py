@@ -37,6 +37,10 @@ class RetryPolicy:
     max_attempts: int = 5
     deadline_s: float = float("inf")
     seed: Optional[int] = None
+    # metrics label: which control-plane loop is retrying (announce,
+    # dispatch, drain, ...) — rendered on /v1/metrics as
+    # trino_tpu_retry_attempts_total{component=...}
+    name: str = "retry"
 
     def delays(self) -> Iterator[float]:
         """Sleep durations between attempts (max_attempts - 1 entries)."""
@@ -68,6 +72,8 @@ class RetryPolicy:
                 if last_try or \
                         time.monotonic() - t0 + delay > self.deadline_s:
                     raise
+                from ..metrics import RETRY_ATTEMPTS
+                RETRY_ATTEMPTS.inc(component=self.name)
                 if on_retry is not None:
                     try:
                         on_retry(attempt, delay, e)

@@ -23,6 +23,7 @@ import json
 import statistics
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 from urllib.error import HTTPError, URLError
 from urllib.request import Request, urlopen
@@ -30,11 +31,14 @@ from urllib.request import Request, urlopen
 import numpy as np
 
 from ..exec.chunked import ChunkAnalysis, analyze, merge_partials
+from ..metrics import (SCHED_HEDGE_WINS, SCHED_HEDGES, SCHED_TASK_RETRIES,
+                       SCHED_TASKS)
 from ..planner import logical as L
 from ..planner.fragmenter import Fragment, fragment_plan
 from ..planner.optimizer import prune_plan
 from ..sql import ast_nodes as A
 from ..sql.parser import parse
+from ..utils.tracing import NOOP
 from .failureinjector import InjectedFailure
 from .pageserde import PageChecksumError, verify_page
 from .retrypolicy import RetryPolicy
@@ -110,7 +114,8 @@ class _HedgedUnit:
     once by the first successful attempt (first-success-wins dedup)."""
 
     __slots__ = ("first_node", "splits", "key", "pages", "live", "hedged",
-                 "nodes_used", "failed_nodes", "started", "tasks")
+                 "nodes_used", "failed_nodes", "started", "tasks",
+                 "winner")
 
     def __init__(self, first_node: str, splits: List[Split], key: str):
         self.first_node = first_node
@@ -123,6 +128,7 @@ class _HedgedUnit:
         self.failed_nodes: Set[str] = set()
         self.started = time.monotonic()
         self.tasks: List["RemoteTask"] = []
+        self.winner: Optional["RemoteTask"] = None
 
 
 class RemoteTask:
@@ -131,7 +137,8 @@ class RemoteTask:
     def __init__(self, node, task_id: str, fragment_blob: str,
                  splits: List[Split], http_timeout_s: float = 30.0,
                  partition: Optional[dict] = None,
-                 sources: Optional[dict] = None, injector=None):
+                 sources: Optional[dict] = None, injector=None,
+                 traceparent: Optional[str] = None):
         self.node = node
         self.task_id = task_id
         self.fragment_blob = fragment_blob
@@ -140,7 +147,9 @@ class RemoteTask:
         self.partition = partition
         self.sources = sources
         self.injector = injector          # chaos hook (EXCHANGE_DRAIN)
+        self.traceparent = traceparent    # W3C context for every hop
         self.pages: List[dict] = []
+        self.bytes_drained = 0            # frame bytes pulled (shuffle)
         self.done = False
 
     def _url(self, suffix: str = "") -> str:
@@ -153,6 +162,8 @@ class RemoteTask:
         headers = {"Content-Type": "application/json"}
         if accept:
             headers["Accept"] = accept
+        if self.traceparent is not None:
+            headers["traceparent"] = self.traceparent
         req = Request(url, data=data, method=method, headers=headers)
         with urlopen(req, timeout=self.http_timeout_s) as resp:
             body = resp.read()
@@ -218,6 +229,7 @@ class RemoteTask:
                                 accept="application/x-trino-pages")
             if isinstance(out, bytes):
                 self.pages.append(self._verified(out))
+                self.bytes_drained += len(out)
                 token += 1
                 continue
             if out.get("page") is not None:
@@ -227,6 +239,7 @@ class RemoteTask:
                     page = base64.b64decode(page["b64"])
                 if isinstance(page, (bytes, bytearray)):
                     page = self._verified(bytes(page))
+                    self.bytes_drained += len(page)
                 self.pages.append(page)
                 token += 1
                 continue
@@ -283,8 +296,18 @@ class StageScheduler:
         self._lock = threading.Lock()
         self.stats: Dict[str, int] = {"queries": 0, "tasks": 0,
                                       "task_retries": 0, "spool_hits": 0,
-                                      "hedged_tasks": 0,
+                                      "hedged_tasks": 0, "hedge_wins": 0,
                                       "checksum_failures": 0}
+        # observability: per-query stage/task rollup (reset each execute;
+        # read by the dispatcher into TrackedQuery.stage_stats), recent
+        # task records for system.runtime.tasks, and per-(query, operator)
+        # aggregates for system.runtime.operator_stats
+        self.last_query: Optional[dict] = None
+        self.task_history: "deque[dict]" = deque(maxlen=256)
+        self.operator_history: "deque[dict]" = deque(maxlen=512)
+        self._current_stage = "source"
+        self._profile_tasks = False     # EXPLAIN ANALYZE: force worker
+                                        # per-operator profiling
         # durable exchange (FTE): drained task outputs persist keyed by
         # work identity; later attempts reuse instead of re-running
         from .exchange_spool import ExchangeSpool
@@ -295,10 +318,81 @@ class StageScheduler:
         # "silently local" complaint)
         self.fallback_reason: Optional[str] = None
 
+    # -- per-query observability rollup -----------------------------------
+
+    def _tracer(self):
+        """The session's tracer (the dispatcher swaps a per-query tracer
+        in while a traced query executes); NOOP otherwise."""
+        return getattr(self.session, "tracer", None) or NOOP
+
+    def _begin_query(self, query_id: Optional[str]) -> None:
+        self._stats_snap = dict(self.stats)
+        self.last_query = {"query_id": query_id, "stages": 0,
+                           "tasks": [], "operators": {},
+                           "bytes_shuffled": 0}
+        self._current_stage = "source"
+
+    def _finalize_rollup(self) -> None:
+        """Compute the per-query deltas of the cumulative counters and
+        publish operator aggregates to the history ring (idempotent —
+        EXPLAIN ANALYZE finalizes early to render, execute()'s finally is
+        then a no-op)."""
+        lq = self.last_query
+        if lq is None or lq.get("_final"):
+            return
+        lq["_final"] = True
+        snap = getattr(self, "_stats_snap", {})
+        for k in ("task_retries", "hedged_tasks", "hedge_wins",
+                  "checksum_failures", "spool_hits"):
+            lq[k] = self.stats.get(k, 0) - snap.get(k, 0)
+        lq["stages"] = self.stats.get("stages", 0) - snap.get("stages", 0)
+        lq["faults_survived"] = lq["task_retries"] + \
+            lq["checksum_failures"]
+        with self._lock:
+            for op, d in lq["operators"].items():
+                self.operator_history.append(
+                    {"query_id": lq.get("query_id") or "",
+                     "operator": op, "rows": d["rows"],
+                     "wall_ms": d["wall_ms"], "calls": d["calls"]})
+
+    def _record_task(self, task: "RemoteTask") -> None:
+        """Fetch a finished task's terminal status — TaskStats + spans —
+        and fold it into the per-query rollup, the system.runtime.tasks
+        ring, and the stitched trace (the merge step of the reference's
+        operator -> task -> stage -> query stats pyramid)."""
+        try:
+            st = task._request(task._url())
+        except Exception:  # noqa: BLE001 — stats fetch is best-effort
+            return
+        stats = st.get("stats") or {}
+        rec = {"query_id": (self.last_query or {}).get("query_id") or "",
+               "task_id": task.task_id, "node": task.node.node_id,
+               "stage": self._current_stage,
+               "state": st.get("state", ""),
+               "splits": int(stats.get("splitsDone", 0)),
+               "rows": int(stats.get("rowsOut", 0)),
+               "bytes": int(stats.get("bytesOut", 0)),
+               "wall_ms": float(stats.get("wallMs", 0.0))}
+        with self._lock:
+            self.task_history.append(rec)
+            lq = self.last_query
+            if lq is not None:
+                lq["tasks"].append(rec)
+                lq["bytes_shuffled"] += task.bytes_drained
+                for op, d in (stats.get("operators") or {}).items():
+                    acc = lq["operators"].setdefault(
+                        op, {"rows": 0, "wall_ms": 0.0, "calls": 0})
+                    acc["rows"] += int(d.get("rows", 0))
+                    acc["wall_ms"] += float(d.get("wallMs", 0.0))
+                    acc["calls"] += int(d.get("calls", 0))
+        self._tracer().adopt(st.get("spans") or [])
+
     # -- eligibility + planning -------------------------------------------
 
     def plan(self, sql: str):
-        stmt = parse(sql)
+        return self._plan_stmt(parse(sql))
+
+    def _plan_stmt(self, stmt):
         if not isinstance(stmt, A.Query):
             self.fallback_reason = "coordinator-only statement"
             return None
@@ -315,9 +409,10 @@ class StageScheduler:
             return None
         return rel, root
 
-    def execute(self, sql: str):
+    def execute(self, sql: str, query_id: Optional[str] = None):
         """Distributed execution; returns QueryResult or None (fall back
-        to local).
+        to local). EXPLAIN ANALYZE of an eligible query executes it
+        distributed and renders the merged per-stage/per-operator stats.
 
         Phased multi-stage execution (PipelinedQueryScheduler.java:164 +
         PhasedExecutionSchedule): the fragmenter cuts heavy join build
@@ -326,7 +421,19 @@ class StageScheduler:
         materialized output broadcast into its consumers; the probe spine
         then runs as the split-streamed SOURCE stage and the coordinator
         merges in the FINAL stage."""
+        stmt = parse(sql)
+        self._begin_query(query_id)
+        try:
+            if isinstance(stmt, A.Explain) and stmt.analyze and \
+                    isinstance(stmt.query, A.Query):
+                return self._execute_explain_analyze(stmt, sql)
+            return self._execute_stmt(stmt, sql)
+        finally:
+            self._finalize_rollup()
+
+    def _execute_stmt(self, stmt, sql: str):
         t0 = time.monotonic()
+        tracer = self._tracer()
         self.fallback_reason = None
         # one injector governs every coordinator-side chaos point,
         # including the spool's read/write hooks
@@ -335,7 +442,8 @@ class StageScheduler:
         if not workers:
             self.fallback_reason = "no active workers"
             return None
-        planned = self.plan(sql)
+        with tracer.span("plan-distributed"):
+            planned = self._plan_stmt(stmt)
         if planned is None:
             return None
         rel, root = planned
@@ -347,8 +455,11 @@ class StageScheduler:
         if props.get("join_distribution_type") == "partitioned":
             desc = self._analyze_partitioned(root)
             if desc is not None:
-                result = self._execute_partitioned(rel, root, workers,
-                                                   desc)
+                self._current_stage = "partitioned"
+                with tracer.span("partitioned-exchange",
+                                 workers=len(workers)):
+                    result = self._execute_partitioned(rel, root, workers,
+                                                       desc)
                 result.elapsed_s = time.monotonic() - t0
                 self.stats["queries"] += 1
                 return result
@@ -371,9 +482,12 @@ class StageScheduler:
         materialized: Dict[int, L.ValuesNode] = {}
         for f in frags[:-1]:
             plan_f = self._bind_remotes(f.root, materialized)
-            materialized[f.id] = self._run_build_stage(plan_f)
+            self._current_stage = f"build-{f.id}"
+            with tracer.span("build-stage", fragment=f.id):
+                materialized[f.id] = self._run_build_stage(plan_f)
             if self.failure_injector is not None:
                 self.failure_injector.maybe_fail("STAGE_BOUNDARY", sql)
+        self._current_stage = "source"
         root = self._bind_remotes(frags[-1].root, materialized)
 
         analysis = analyze(root, self.session.catalog, self.split_rows,
@@ -393,10 +507,57 @@ class StageScheduler:
             # between-stage failure point: source outputs are already
             # spooled, so the QUERY retry resumes from them
             self.failure_injector.maybe_fail("STAGE_BOUNDARY", sql)
-        result = self._run_final_stage(rel, root, analysis, partial_pages)
+        with tracer.span("final-stage", pages=len(partial_pages)):
+            result = self._run_final_stage(rel, root, analysis,
+                                           partial_pages)
         result.elapsed_s = time.monotonic() - t0
         self.stats["queries"] += 1
         return result
+
+    def _execute_explain_analyze(self, stmt, sql: str):
+        """EXPLAIN ANALYZE over the cluster: run the inner query
+        distributed (with worker-side per-operator profiling forced),
+        then render the logical plan followed by the merged per-stage and
+        per-operator rollup — the distributed half EXPLAIN ANALYZE
+        previously lacked (it profiled only coordinator-local runs)."""
+        from ..exec.session import QueryResult
+        from ..planner.logical import explain_text
+        t0 = time.monotonic()
+        self._profile_tasks = True
+        try:
+            result = self._execute_stmt(stmt.query, sql)
+        finally:
+            self._profile_tasks = False
+        if result is None:
+            return None      # not eligible: local EXPLAIN ANALYZE runs
+        self._finalize_rollup()
+        lq = self.last_query
+        rel = self.session.planner().plan_query(stmt.query)
+        lines = explain_text(prune_plan(rel.node)).split("\n")
+        stages: Dict[str, list] = {}
+        for t in lq["tasks"]:
+            s = stages.setdefault(t["stage"], [0, 0, 0, 0.0])
+            s[0] += 1
+            s[1] += t["splits"]
+            s[2] += t["rows"]
+            s[3] = max(s[3], t["wall_ms"])
+        lines += ["", f"Distributed execution: {lq['stages']} stages, "
+                      f"{len(lq['tasks'])} tasks, "
+                      f"{lq['bytes_shuffled']} bytes shuffled, "
+                      f"{lq['task_retries']} task retries, "
+                      f"{lq['hedged_tasks']} hedged"]
+        for name in sorted(stages):
+            n, splits, rows, wall = stages[name]
+            lines.append(f"Stage {name}: tasks={n}, splits={splits}, "
+                         f"rows={rows}, max task wall={wall:.1f}ms")
+        for op in sorted(lq["operators"]):
+            d = lq["operators"][op]
+            lines.append(f"  operator {op}: rows={d['rows']}, "
+                         f"wall={d['wall_ms']:.1f}ms, "
+                         f"calls={d['calls']}")
+        return QueryResult(["query plan"],
+                           [(line,) for line in lines],
+                           time.monotonic() - t0)
 
     # -- build stages ------------------------------------------------------
 
@@ -487,8 +648,13 @@ class StageScheduler:
             is not None else (analysis.merge_sort
                               if analysis.merge_sort is not None
                               else root.child)
-        blob = encode_fragment({"root": fragment_root,
-                                "driver": analysis.driver})
+        frag = {"root": fragment_root, "driver": analysis.driver}
+        if self._profile_tasks:
+            # EXPLAIN ANALYZE: workers profile per-operator device time
+            # (also keys the spool differently, so profiled runs never
+            # reuse unprofiled spooled output)
+            frag["profile"] = True
+        blob = encode_fragment(frag)
         # the work key hashes (fragment, splits) but not data contents:
         # only deterministic generator sources may reuse spooled outputs
         # (a memory-connector table can change between attempts)
@@ -510,6 +676,16 @@ class StageScheduler:
                               self.retry_backoff_max_s,
                               max_attempts=self.max_task_retries + 2
                               ).delays()
+        with self._tracer().span("source-stage", splits=len(splits),
+                                 workers=len(workers)):
+            pages = self._drain_rounds(pending, by_id, blob, use_spool,
+                                       backoff)
+        return pages
+
+    def _drain_rounds(self, pending, by_id, blob, use_spool,
+                      backoff) -> List[bytes]:
+        pages: List[bytes] = []
+        retries = 0
         while pending:
             units: List[_HedgedUnit] = []
             for nid, sp in list(pending.items()):
@@ -530,6 +706,7 @@ class StageScheduler:
             # (EventDrivenFaultTolerantQueryScheduler's per-task retry)
             retries += 1
             self.stats["task_retries"] += 1
+            SCHED_TASK_RETRIES.inc()
             if retries > self.max_task_retries:
                 raise TaskFailedError(
                     "task retries exhausted: " +
@@ -568,6 +745,9 @@ class StageScheduler:
         deadline = time.time() + self.task_timeout_s
         lock = threading.Lock()
         durations: List[float] = []
+        # capture the trace context ON THIS THREAD (the source-stage span
+        # is open here; drain threads have empty span stacks)
+        traceparent = self._tracer().traceparent()
 
         def attempt(unit: "_HedgedUnit", node) -> None:
             t0 = time.monotonic()
@@ -575,13 +755,15 @@ class StageScheduler:
                 self._seq += 1
                 tid = f"t{self._seq}"
             task = RemoteTask(node, tid, blob, unit.splits,
-                              injector=self.failure_injector)
+                              injector=self.failure_injector,
+                              traceparent=traceparent)
             with lock:
                 unit.tasks.append(task)
             losers: List[RemoteTask] = []
             try:
                 task.start()
                 self.stats["tasks"] += 1
+                SCHED_TASKS.inc()
                 drained = task.drain(deadline)
             except (TaskFailedError, InjectedFailure, URLError,
                     HTTPError, OSError) as e:
@@ -597,8 +779,13 @@ class StageScheduler:
                     unit.live -= 1
                     if unit.pages is None:     # first success wins
                         unit.pages = drained
+                        unit.winner = task
                         durations.append(time.monotonic() - t0)
                         losers = [t for t in unit.tasks if t is not task]
+                        if unit.hedged and task is not unit.tasks[0]:
+                            # the speculative attempt beat the original
+                            self.stats["hedge_wins"] += 1
+                            SCHED_HEDGE_WINS.inc()
                 # abort outstanding hedge twins outside the lock — their
                 # output is dropped either way
                 for t in losers:
@@ -640,18 +827,25 @@ class StageScheduler:
                             continue
                         u.hedged = True
                     self.stats["hedged_tasks"] += 1
+                    SCHED_HEDGES.inc()
                     launch(u, candidate)
             time.sleep(0.02)
 
         failed_splits: List[Split] = []
         failed_nodes: Set[str] = set()
         with lock:
-            resolved = [(u, u.pages) for u in units]
-        for u, got in resolved:
+            resolved = [(u, u.pages, u.winner) for u in units]
+        for u, got, winner in resolved:
             if got is not None:
                 pages.extend(got)
                 if use_spool:
                     self.spool.put(u.key, got)
+                if winner is not None:
+                    # TaskStats + worker spans ride the terminal status —
+                    # fetched HERE (main thread, before the stage
+                    # returns) so the rollup is complete by the time the
+                    # dispatcher publishes the completion event
+                    self._record_task(winner)
             else:
                 failed_splits.extend(u.splits)
                 failed_nodes.update(u.failed_nodes or {u.first_node})
@@ -770,6 +964,7 @@ class StageScheduler:
         join, merge_agg, probe_driver, build_driver = desc
         P = len(workers)
         t_deadline = time.time() + self.task_timeout_s
+        traceparent = self._tracer().traceparent()
 
         def stage_tasks(side_root, driver, keys):
             blob = encode_fragment({"root": side_root, "driver": driver})
@@ -791,9 +986,11 @@ class StageScheduler:
                 task = RemoteTask(w, tid, blob, sp,
                                   partition={"keys": list(keys),
                                              "count": P},
-                                  injector=self.failure_injector)
+                                  injector=self.failure_injector,
+                                  traceparent=traceparent)
                 task.start()
                 self.stats["tasks"] += 1
+                SCHED_TASKS.inc()
                 tasks.append(task)
             return tasks
 
@@ -819,9 +1016,11 @@ class StageScheduler:
                 tid = f"t{self._seq}"
             task = RemoteTask(workers[p % len(workers)], tid, blob_c, [],
                               sources=sources,
-                              injector=self.failure_injector)
+                              injector=self.failure_injector,
+                              traceparent=traceparent)
             task.start()
             self.stats["tasks"] += 1
+            SCHED_TASKS.inc()
             c_tasks.append(task)
 
         pages: List[bytes] = []
@@ -834,6 +1033,8 @@ class StageScheduler:
             for t in a_tasks + b_tasks + c_tasks:
                 t.cancel()
             raise
+        for t in a_tasks + b_tasks + c_tasks:
+            self._record_task(t)
         self.stats["stages"] = self.stats.get("stages", 0) + 4
         self.stats["partitioned_joins"] = \
             self.stats.get("partitioned_joins", 0) + 1

@@ -101,6 +101,14 @@ class TrackedQuery:
     # coordinator): surfaced in /v1/query info so `SET SESSION
     # distributed = true` degrading to local is never silent
     fallback_reason: Optional[str] = None
+    # observability: W3C trace context from the client's POST, the
+    # per-query tracer (live while executing), the stitched trace
+    # exported at completion (GET /v1/query/{id}/trace), and the
+    # scheduler's per-query stage/task rollup (events + system tables)
+    traceparent: Optional[str] = None
+    tracer: Optional[object] = None       # utils.tracing.Tracer
+    trace: Optional[list] = None          # exported span dicts
+    stage_stats: Optional[dict] = None
 
     @property
     def state(self) -> str:

@@ -2,7 +2,11 @@
 
 Reference: the `system` catalog (connector/system/ in trino-main — 86
 files) exposing system.runtime.queries / .nodes backed by live engine
-state. Registered by the coordinator with its tracker + node inventory.
+state, plus the task and operator-stats views EXPLAIN ANALYZE and the
+web UI read. Registered by the coordinator with its tracker + node
+inventory + stage scheduler, so `SELECT * FROM system.runtime.tasks`
+shows the recent remote-task rollup (TaskStats merged back from workers)
+and `system.runtime.operator_stats` the per-(query, operator) aggregates.
 """
 
 from __future__ import annotations
@@ -26,7 +30,7 @@ class SystemConnector:
 
     def table_names(self, schema: str):
         if schema == "runtime":
-            return ["queries", "nodes"]
+            return ["queries", "nodes", "tasks", "operator_stats"]
         return []
 
     def get_table(self, schema: str, table: str) -> TableData:
@@ -36,7 +40,15 @@ class SystemConnector:
             return self._queries_table()
         if table == "nodes":
             return self._nodes_table()
+        if table == "tasks":
+            return self._tasks_table()
+        if table == "operator_stats":
+            return self._operator_stats_table()
         raise KeyError(f"system table {table!r} not found")
+
+    def _scheduler(self):
+        return getattr(self.state, "scheduler", None) if self.state \
+            else None
 
     def _queries_table(self) -> TableData:
         queries = self.state.tracker.all() if self.state else []
@@ -65,3 +77,46 @@ class SystemConnector:
             [("node_id", [n.node_id for n in nodes]),
              ("http_uri", [n.uri for n in nodes]),
              ("state", [n.state for n in nodes])])
+
+    def _tasks_table(self) -> TableData:
+        """Recent remote tasks with their merged TaskStats (the
+        system.runtime.tasks view of the reference)."""
+        sched = self._scheduler()
+        recs = list(sched.task_history) if sched is not None else []
+        base = _strings_table(
+            "tasks",
+            [("query_id", [r["query_id"] for r in recs]),
+             ("task_id", [r["task_id"] for r in recs]),
+             ("node_id", [r["node"] for r in recs]),
+             ("stage", [r["stage"] for r in recs]),
+             ("state", [r["state"] for r in recs])])
+        splits = np.array([r["splits"] for r in recs], dtype=np.int64)
+        rows = np.array([r["rows"] for r in recs], dtype=np.int64)
+        byts = np.array([r["bytes"] for r in recs], dtype=np.int64)
+        wall = np.array([r["wall_ms"] for r in recs], dtype=np.float64)
+        return TableData(
+            "tasks",
+            Schema(base.schema.fields +
+                   (Field("splits", BIGINT), Field("rows", BIGINT),
+                    Field("bytes", BIGINT), Field("wall_ms", DOUBLE))),
+            base.columns + [splits, rows, byts, wall])
+
+    def _operator_stats_table(self) -> TableData:
+        """Per-(query, operator) rollup from worker TaskStats — the
+        operator half of the OperatorStats pyramid, queryable like the
+        reference's optimizer_rule_stats/operator views."""
+        sched = self._scheduler()
+        recs = list(sched.operator_history) if sched is not None else []
+        base = _strings_table(
+            "operator_stats",
+            [("query_id", [r["query_id"] for r in recs]),
+             ("operator", [r["operator"] for r in recs])])
+        rows = np.array([r["rows"] for r in recs], dtype=np.int64)
+        wall = np.array([r["wall_ms"] for r in recs], dtype=np.float64)
+        calls = np.array([r["calls"] for r in recs], dtype=np.int64)
+        return TableData(
+            "operator_stats",
+            Schema(base.schema.fields +
+                   (Field("rows", BIGINT), Field("wall_ms", DOUBLE),
+                    Field("calls", BIGINT))),
+            base.columns + [rows, wall, calls])

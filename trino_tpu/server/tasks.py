@@ -21,11 +21,15 @@ from __future__ import annotations
 
 import base64
 import threading
+import time
 import traceback
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 import numpy as np
+
+from ..metrics import TASK_OUTPUT_BYTES, TASK_OUTPUT_ROWS
+from ..utils.tracing import NOOP, Tracer
 
 
 # --------------------------------------------------------------------------
@@ -94,6 +98,13 @@ def encode_fragment(root) -> str:
 def decode_fragment(blob: str):
     from . import serde
     return serde.loads(blob)
+
+
+def _subtree_nodes_all(root):
+    """Every node of a fragment subtree (id -> operator-name mapping for
+    per-operator TaskStats)."""
+    from ..planner.fragmenter import _subtree_nodes
+    return _subtree_nodes(root)
 
 
 def _static_subtrees(root, driver) -> list:
@@ -200,6 +211,14 @@ class WorkerTask:
     acked: Dict[int, int] = field(default_factory=dict)
     splits_done: int = 0
     lock: threading.Lock = field(default_factory=threading.Lock)
+    # observability: W3C trace context adopted from the coordinator's
+    # POST, per-task output accounting (TaskStats), and the worker-side
+    # spans shipped back with the terminal status for trace stitching
+    traceparent: Optional[str] = None
+    rows_out: int = 0
+    bytes_out: int = 0
+    stats: Dict[str, object] = field(default_factory=dict)
+    spans: List[dict] = field(default_factory=list)
 
     @property
     def pages(self) -> List[bytes]:       # legacy single-buffer view
@@ -216,8 +235,9 @@ class TaskManager:
     returns immediately (the reference's updateTask is async the same
     way)."""
 
-    def __init__(self, catalog, injector=None):
+    def __init__(self, catalog, injector=None, node_id: str = "worker"):
         self.catalog = catalog
+        self.node_id = node_id            # span service attribution
         self.tasks: Dict[str, WorkerTask] = {}
         self._lock = threading.Lock()
         self.injector = injector          # FailureInjector hook
@@ -230,7 +250,8 @@ class TaskManager:
 
     def create_or_update(self, task_id: str, fragment_blob: str,
                          splits: List[Split], partition: dict = None,
-                         sources: dict = None) -> WorkerTask:
+                         sources: dict = None,
+                         traceparent: str = None) -> WorkerTask:
         if self.injector is not None:
             # chaos: fail/delay/drop task intake (the worker dies or
             # hangs between accept and ack — TaskResource's createOrUpdate
@@ -240,7 +261,8 @@ class TaskManager:
             task = self.tasks.get(task_id)
             if task is None:
                 task = WorkerTask(task_id, fragment_blob, splits,
-                                  partition=partition, sources=sources)
+                                  partition=partition, sources=sources,
+                                  traceparent=traceparent)
                 self.tasks[task_id] = task
                 t = threading.Thread(target=self._run, args=(task,),
                                      name=f"task-{task_id}", daemon=True)
@@ -260,11 +282,18 @@ class TaskManager:
 
     def _emit(self, task: WorkerTask, arrs, vals) -> None:
         """Stage one result batch into the task's output buffers,
-        hash-partitioned when the task has a partition spec."""
+        hash-partitioned when the task has a partition spec. Rows/bytes
+        are counted on the host arrays (already materialized — no device
+        sync) into the task's TaskStats and the process metrics."""
+        rows = len(arrs[0]) if arrs else 0
         if task.partition is None:
             page = encode_columns(arrs, vals)
             with task.lock:
                 task.pages.append(page)
+                task.rows_out += rows
+                task.bytes_out += len(page)
+            TASK_OUTPUT_ROWS.inc(rows)
+            TASK_OUTPUT_BYTES.inc(len(page))
             return
         keys, count = task.partition["keys"], task.partition["count"]
         part = partition_assignment(arrs, vals, keys, count)
@@ -276,6 +305,51 @@ class TaskManager:
                                   [v[m] for v in vals])
             with task.lock:
                 task.buffers.setdefault(p, []).append(page)
+                task.rows_out += int(m.sum())
+                task.bytes_out += len(page)
+            TASK_OUTPUT_ROWS.inc(int(m.sum()))
+            TASK_OUTPUT_BYTES.inc(len(page))
+
+    def _tracer_for(self, task: WorkerTask) -> Tracer:
+        """Worker-side tracer adopting the coordinator's trace context —
+        spans stitch under the coordinator span that POSTed the task. No
+        traceparent (tracing off for the query) = zero-overhead NOOP."""
+        if task.traceparent is None:
+            return NOOP
+        return Tracer.from_traceparent(task.traceparent,
+                                       service=f"worker:{self.node_id}")
+
+    @staticmethod
+    def _fold_node_stats(ex, names: Dict[int, str],
+                         op_agg: Dict[str, list]) -> None:
+        """Aggregate one profiled run's per-node stats into per-operator
+        totals [wall_ms, rows, calls] and reset for the next split."""
+        for nid, (wall_s, rows) in ex.node_stats.items():
+            acc = op_agg.setdefault(names.get(nid, "?"), [0.0, 0, 0])
+            acc[0] += wall_s * 1000
+            acc[1] += rows
+            acc[2] += 1
+        ex.node_stats = {}
+
+    def _finalize_stats(self, task: WorkerTask, tracer: Tracer,
+                        t_start: float, op_agg: Dict[str, list]) -> None:
+        """Roll this task's TaskStats (rows/bytes/wall/operators) and its
+        exported spans into the task record the coordinator fetches with
+        the terminal status (OperatorStats pyramid: operator -> task).
+        On success paths this runs BEFORE the FINISHED transition so a
+        consumer that sees the terminal state always sees final stats."""
+        ops = {op: {"wallMs": round(v[0], 3), "rows": int(v[1]),
+                    "calls": int(v[2])} for op, v in op_agg.items()}
+        with task.lock:
+            task.stats = {"rowsOut": task.rows_out,
+                          "bytesOut": task.bytes_out,
+                          "wallMs": round(
+                              (time.monotonic() - t_start) * 1000, 3),
+                          "splitsDone": task.splits_done,
+                          "operators": ops}
+            if tracer.enabled:
+                task.spans = tracer.export()
+        self._executor.flush_metrics()
 
     def _run(self, task: WorkerTask) -> None:
         from ..batch import batch_from_numpy, batch_to_numpy, pad_capacity
@@ -284,17 +358,34 @@ class TaskManager:
                 return
             task.state = "RUNNING"
         self.tasks_run += 1
+        tracer = self._tracer_for(task)
+        t_start = time.monotonic()
+        op_agg: Dict[str, list] = {}
         try:
             if self.injector is not None:
                 self.injector.maybe_fail("TASK", task.task_id)
                 self.injector.maybe_fail("WORKER_TASK_RUN", task.task_id)
             if task.sources is not None:
-                self._run_exchange_consumer(task)
+                with tracer.span("worker-task", taskId=task.task_id,
+                                 node=self.node_id, kind="exchange"):
+                    self._run_exchange_consumer(task, tracer, op_agg)
+                # final stats/spans land BEFORE the terminal state so a
+                # status fetch racing the transition never sees partials
+                self._finalize_stats(task, tracer, t_start, op_agg)
+                with task.lock:
+                    if task.state == "RUNNING":
+                        task.state = "FINISHED"
                 return
             fragment = decode_fragment(task.fragment_blob)
             root, driver_scan = fragment["root"], fragment["driver"]
             cap = pad_capacity(max(s.count for s in task.splits)) \
                 if task.splits else 1024
+            # per-operator profiling: on for traced tasks AND for
+            # fragments flagged by the coordinator (EXPLAIN ANALYZE) —
+            # pays a per-node device sync for true operator times
+            profiling = tracer.enabled or bool(fragment.get("profile"))
+            names = {id(n): type(n).__name__ for n in
+                     _subtree_nodes_all(root)} if profiling else {}
             # The executor (and its _subst/pool state) is shared by every
             # task on this worker, so the whole pin-builds + splits loop
             # holds _exec_lock: build state pinned across splits must not
@@ -302,16 +393,27 @@ class TaskManager:
             # serialized by the chip anyway (Trino's analog: one lookup
             # source per build, drivers share it under memory context
             # locking).
-            with self._exec_lock:
+            with self._exec_lock, \
+                    tracer.span("worker-task", taskId=task.task_id,
+                                node=self.node_id,
+                                splits=len(task.splits)):
                 ex = self._executor
                 ex._subst.clear()
                 ex._subst_opaque.clear()
+                saved_profile = ex.profile
+                saved_node_stats = ex.node_stats
+                if profiling:
+                    ex.profile = True
+                    ex.node_stats = {}
                 try:
                     # pin maximal driver-free subtrees ONCE per task (join
                     # build sides, HashBuilderOperator's build-once-probe-
                     # many): else every split re-executes every build join
-                    for sub in _static_subtrees(root, driver_scan):
-                        ex._subst[id(sub)] = ex.run(sub)
+                    with tracer.span("pin-builds"):
+                        for sub in _static_subtrees(root, driver_scan):
+                            ex._subst[id(sub)] = ex.run(sub)
+                    if profiling:
+                        self._fold_node_stats(ex, names, op_agg)
                     for si, split in enumerate(task.splits):
                         if task.state == "CANCELED":
                             return
@@ -341,7 +443,9 @@ class TaskManager:
                         ex._subst[id(driver_scan)] = chunk
                         ex._subst_opaque.add(id(driver_scan))
                         try:
-                            out = ex.run(root)
+                            with tracer.span("split", index=si,
+                                             rows=split.count):
+                                out = ex.run(root)
                         finally:
                             ex._subst.pop(id(driver_scan), None)
                             ex._subst_opaque.discard(id(driver_scan))
@@ -349,16 +453,21 @@ class TaskManager:
                             # keep their reservations until task end
                             ex.release_path_reservations(
                                 root, keep=ex._subst)
+                        if profiling:
+                            self._fold_node_stats(ex, names, op_agg)
                         arrs, vals = batch_to_numpy(out)
                         self._emit(task, arrs, vals)
                         with task.lock:
                             task.splits_done += 1
                 finally:
+                    ex.profile = saved_profile
+                    ex.node_stats = saved_node_stats
                     ex._subst.clear()
                     ex._subst_opaque.clear()
                     for b in ex._node_bytes.values():
                         ex.pool.free(b)
                     ex._node_bytes.clear()
+            self._finalize_stats(task, tracer, t_start, op_agg)
             with task.lock:
                 # a cancel landing during the last split must not be
                 # overwritten by FINISHED
@@ -369,17 +478,29 @@ class TaskManager:
             with task.lock:
                 if task.state != "CANCELED":
                     task.state = "FAILED"
+        finally:
+            # failure/cancel paths (and early returns) still record what
+            # completed; success paths already finalized pre-transition
+            if not task.stats:
+                self._finalize_stats(task, tracer, t_start, op_agg)
 
     # -- exchange consumer: worker<->worker partitioned shuffle ------------
 
     def _pull_buffer(self, uri: str, task_id: str, buffer: int,
-                     deadline: float, task: WorkerTask) -> List[bytes]:
+                     deadline: float, task: WorkerTask,
+                     tracer: Tracer = NOOP) -> List[bytes]:
         """Pull one upstream buffer to completion (the worker-side twin
         of the coordinator's RemoteTask.drain — HttpPageBufferClient's
-        loop, running worker-to-worker)."""
+        loop, running worker-to-worker). The consumer's trace context
+        rides the pull requests so cross-worker data-plane hops appear
+        in the stitched query trace."""
         import json as _json
         import time as _time
         from urllib.request import Request, urlopen
+        headers = {"Accept": "application/x-trino-pages"}
+        tp = tracer.traceparent()
+        if tp is not None:
+            headers["traceparent"] = tp
         pages: List[bytes] = []
         token = 0
         while _time.time() < deadline:
@@ -387,7 +508,7 @@ class TaskManager:
                 raise RuntimeError("task canceled during exchange pull")
             req = Request(
                 f"{uri}/v1/task/{task_id}/results/{buffer}/{token}",
-                headers={"Accept": "application/x-trino-pages"})
+                headers=headers)
             with urlopen(req, timeout=30.0) as resp:
                 body = resp.read()
                 if resp.headers.get("Content-Type", "").startswith(
@@ -409,7 +530,9 @@ class TaskManager:
             _time.sleep(0.02)
         raise RuntimeError(f"exchange pull from {task_id} timed out")
 
-    def _run_exchange_consumer(self, task: WorkerTask) -> None:
+    def _run_exchange_consumer(self, task: WorkerTask,
+                               tracer: Tracer = NOOP,
+                               op_agg: Dict[str, list] = None) -> None:
         """Execute a fragment whose leaves are RemoteSourceNodes: pull
         each source's partition from the upstream tasks, bind the
         concatenated batches, run once, emit (re-partitioned when the
@@ -434,20 +557,30 @@ class TaskManager:
             fid = int(fid_str)
             pages = []
             for s in srcs:
-                pages.extend(self._pull_buffer(
-                    s["uri"], s["taskId"], int(s.get("buffer", 0)),
-                    deadline, task))
+                with tracer.span("exchange-pull", uri=s["uri"],
+                                 upstreamTask=s["taskId"],
+                                 buffer=int(s.get("buffer", 0))):
+                    pages.extend(self._pull_buffer(
+                        s["uri"], s["taskId"], int(s.get("buffer", 0)),
+                        deadline, task, tracer))
             nodes = by_fid.get(fid)
             arrs, vals = concat_pages(
                 pages, nodes[0].output if nodes else ())
             batches[fid] = batch_from_numpy(arrs, valids=vals)
 
         from ..batch import batch_to_numpy
+        names = {id(n): type(n).__name__
+                 for n in _subtree_nodes_all(root)} if tracer.enabled else {}
         with self._exec_lock:
             ex = self._executor
             ex._subst.clear()
             ex._subst_opaque.clear()
             saved_merge = ex.enable_merge_join
+            saved_profile = ex.profile
+            saved_node_stats = ex.node_stats
+            if tracer.enabled:
+                ex.profile = True
+                ex.node_stats = {}
             # partition sizes differ per consumer task, so the merge-sort
             # kernel's multi-operand XLA sort would recompile per shape —
             # and that compile is pathological (minutes even at tiny
@@ -459,24 +592,38 @@ class TaskManager:
                     for n in nodes:
                         ex._subst[id(n)] = batches[fid]
                         ex._subst_opaque.add(id(n))
-                out = ex.run(root)
+                with tracer.span("consume-run"):
+                    out = ex.run(root)
+                if tracer.enabled and op_agg is not None:
+                    self._fold_node_stats(ex, names, op_agg)
                 arrs, vals = batch_to_numpy(out)
             finally:
                 ex.enable_merge_join = saved_merge
+                ex.profile = saved_profile
+                ex.node_stats = saved_node_stats
                 ex._subst.clear()
                 ex._subst_opaque.clear()
                 for b in ex._node_bytes.values():
                     ex.pool.free(b)
                 ex._node_bytes.clear()
         self._emit(task, arrs, vals)
-        with task.lock:
-            if task.state == "RUNNING":
-                task.state = "FINISHED"
+        # terminal state is set by _run AFTER stats finalize — a status
+        # fetch racing completion must never see FINISHED + partial stats
 
     def status_json(self, task: WorkerTask) -> dict:
         with task.lock:      # buffers/acked mutate on the task thread
-            return {"taskId": task.task_id, "state": task.state,
-                    "error": task.error.splitlines()[0]
-                    if task.error else "",
-                    "splitsDone": task.splits_done,
-                    "pages": task.total_pages()}
+            done = task.state in ("FINISHED", "FAILED", "CANCELED")
+            stats = dict(task.stats) if task.stats else {
+                "rowsOut": task.rows_out, "bytesOut": task.bytes_out,
+                "splitsDone": task.splits_done}
+            out = {"taskId": task.task_id, "state": task.state,
+                   "error": task.error.splitlines()[0]
+                   if task.error else "",
+                   "splitsDone": task.splits_done,
+                   "pages": task.total_pages(),
+                   "stats": stats}
+            if done and task.spans:
+                # spans ship only with terminal status (one fetch per
+                # task, not per poll)
+                out["spans"] = list(task.spans)
+            return out

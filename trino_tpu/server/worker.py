@@ -9,6 +9,12 @@ host process; across hosts each worker process owns its host's chips and
 the coordinator drives them over this control plane. The data plane between
 co-located workers is ICI collectives inside the jitted stage programs, so
 /v1/task here accepts work descriptors rather than serialized pages.
+
+Routes live in the module-level ROUTES table (server/routes.py): every
+request is counted in the process metrics registry, and /v1/metrics serves
+the registry in Prometheus text format. Task POSTs carry the coordinator's
+W3C `traceparent`, which the task manager adopts so worker spans stitch
+into the query trace.
 """
 
 from __future__ import annotations
@@ -18,8 +24,27 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import urlparse
 from urllib.request import Request, urlopen
+
+from .routes import STAR, dispatch, register_routes
+
+SERVER_NAME = "worker"
+
+# (METHOD, pattern, handler method, needs_auth) — see server/routes.py.
+ROUTES = (
+    ("GET", ("v1", "status"), "_get_status", False),
+    ("GET", ("v1", "info"), "_get_info", False),
+    ("GET", ("v1", "metrics"), "_get_metrics", False),
+    ("GET", ("v1", "task", STAR), "_get_task", False),
+    ("GET", ("v1", "task", STAR, "results", STAR), "_get_results", False),
+    ("GET", ("v1", "task", STAR, "results", STAR, STAR), "_get_results",
+     False),
+    ("POST", ("v1", "task", STAR), "_post_task", False),
+    ("DELETE", ("v1", "task", STAR), "_delete_task", False),
+    ("PUT", ("v1", "info", "state"), "_put_state", False),
+)
+
+register_routes(SERVER_NAME, ROUTES)
 
 
 class _WorkerHandler(BaseHTTPRequestHandler):
@@ -37,6 +62,15 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, code: int, text: str,
+                   content_type: str = "text/plain; version=0.0.4") -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _send_page(self, frame: bytes, headers: dict) -> None:
         """Binary data-plane response: the page frame raw in the body,
         pull-protocol metadata in headers (PagesSerde over HTTP — the
@@ -50,84 +84,40 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(frame)
 
-    def do_GET(self):
-        path = urlparse(self.path).path
-        parts = [p for p in path.split("/") if p]
-        if path == "/v1/status":
-            if self.worker.fail_status:      # fault injection hook
-                self._send(500, {"error": "injected failure"})
-                return
-            self._send(200, {"nodeId": self.worker.node_id,
-                             "state": self.worker.state,
-                             "uptime": time.time() - self.worker.started_at})
-            return
-        if path == "/v1/info":
-            self._send(200, {"nodeVersion": {"version": "trino-tpu-0.1"},
-                             "coordinator": False})
-            return
-        # GET /v1/task/{id} — TaskStatus long-poll target
-        # (server/remotetask/ContinuousTaskStatusFetcher's endpoint)
-        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
-            task = self._task_or_404(parts[2])
-            if task is not None:
-                self._send(200, self.worker.task_manager.status_json(task))
-            return
-        # GET /v1/task/{id}/results/{token}            — buffer 0
-        # GET /v1/task/{id}/results/{buffer}/{token}   — partitioned
-        # (server/TaskResource.java:332; buffers are the partitioned
-        # output of the worker<->worker exchange)
-        if len(parts) in (5, 6) and parts[:2] == ["v1", "task"] and \
-                parts[3] == "results":
-            task = self._task_or_404(parts[2])
-            if task is None:
-                return
-            if self.worker.fail_results:     # fault injection hook
-                self._send(500, {"error": "injected results failure"})
-                return
-            buffer = int(parts[4]) if len(parts) == 6 else 0
-            token = int(parts[-1])
-            binary = "x-trino-pages" in self.headers.get("Accept", "")
-            # only bookkeeping under the lock: P concurrent consumer
-            # pulls + the producer's _emit all contend on it, so socket
-            # writes must happen after release
-            frame = None
-            envelope = None
-            with task.lock:
-                pages = task.buffers.setdefault(buffer, [])
-                acked = task.acked.get(buffer, 0)
-                # Advancing to `token` acknowledges every page below it
-                # (TaskResource.java:372's implicit-ack contract) — drop
-                # drained pages so a long-lived worker's memory stays flat;
-                # same-token retries after a fetch failure still succeed.
-                while acked < token and pages:
-                    pages.pop(0)
-                    acked += 1
-                task.acked[buffer] = acked
-                idx = token - acked
-                total = acked + len(pages)
-                if 0 <= idx < len(pages):
-                    frame = pages[idx]
-                else:
-                    done = task.state in ("FINISHED", "FAILED",
-                                          "CANCELED")
-                    envelope = {"token": token,
-                                "complete": done and token >= total,
-                                "state": task.state,
-                                "error": task.error, "page": None}
-            if frame is not None:
-                if binary:
-                    self._send_page(frame, {"X-Trino-Token": token,
-                                            "X-Trino-Complete": "false"})
-                else:
-                    import base64
-                    self._send(200, {
-                        "token": token, "complete": False,
-                        "page": {"b64": base64.b64encode(
-                            frame).decode()}})
-            else:
-                self._send(200, envelope)
-            return
+    def _not_found(self, path: str) -> None:
         self._send(404, {"error": f"no route {path}"})
+
+    # -- dispatch ----------------------------------------------------------
+
+    def do_GET(self):
+        dispatch(self, "GET", ROUTES, SERVER_NAME)
+
+    def do_POST(self):
+        dispatch(self, "POST", ROUTES, SERVER_NAME)
+
+    def do_DELETE(self):
+        dispatch(self, "DELETE", ROUTES, SERVER_NAME)
+
+    def do_PUT(self):
+        dispatch(self, "PUT", ROUTES, SERVER_NAME)
+
+    # -- routes -----------------------------------------------------------
+
+    def _get_status(self, parts, user):
+        if self.worker.fail_status:          # fault injection hook
+            self._send(500, {"error": "injected failure"})
+            return
+        self._send(200, {"nodeId": self.worker.node_id,
+                         "state": self.worker.state,
+                         "uptime": time.time() - self.worker.started_at})
+
+    def _get_info(self, parts, user):
+        self._send(200, {"nodeVersion": {"version": "trino-tpu-0.1"},
+                         "coordinator": False})
+
+    def _get_metrics(self, parts, user):
+        from ..metrics import REGISTRY
+        self._send_text(200, REGISTRY.render())
 
     def _task_or_404(self, task_id: str):
         task = self.worker.task_manager.get(task_id)
@@ -135,54 +125,102 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"unknown task {task_id}"})
         return task
 
-    def do_POST(self):
-        path = urlparse(self.path).path
-        parts = [p for p in path.split("/") if p]
-        # POST /v1/task/{id} — create/update with fragment + splits
-        # (server/TaskResource.java:146 createOrUpdateTask)
-        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
-            if self.worker.fail_tasks:       # fault injection hook
-                self._send(500, {"error": "injected task failure"})
-                return
-            n = int(self.headers.get("Content-Length", 0))
-            body = json.loads(self.rfile.read(n).decode())
-            from .failureinjector import InjectedFailure
-            from .tasks import Split
-            splits = [Split(**s) for s in body.get("splits", [])]
-            try:
-                task = self.worker.task_manager.create_or_update(
-                    parts[2], body["fragment"], splits,
-                    partition=body.get("partition"),
-                    sources=body.get("sources"))
-            except InjectedFailure as e:
-                # chaos at task intake (crash/drop/raise all surface to
-                # the coordinator as a failed POST -> split reassignment)
-                self._send(500, {"error": str(e)})
-                return
+    # GET /v1/task/{id} — TaskStatus long-poll target
+    # (server/remotetask/ContinuousTaskStatusFetcher's endpoint)
+    def _get_task(self, parts, user):
+        task = self._task_or_404(parts[2])
+        if task is not None:
             self._send(200, self.worker.task_manager.status_json(task))
-            return
-        self._send(404, {"error": f"no route {path}"})
 
-    def do_DELETE(self):
-        path = urlparse(self.path).path
-        parts = [p for p in path.split("/") if p]
-        # DELETE /v1/task/{id} — cancel/abort (TaskResource.java:319's
-        # fail route collapsed with delete)
-        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
-            self.worker.task_manager.cancel(parts[2])
-            self._send(204, {})
+    # GET /v1/task/{id}/results/{token}            — buffer 0
+    # GET /v1/task/{id}/results/{buffer}/{token}   — partitioned
+    # (server/TaskResource.java:332; buffers are the partitioned
+    # output of the worker<->worker exchange)
+    def _get_results(self, parts, user):
+        task = self._task_or_404(parts[2])
+        if task is None:
             return
-        self._send(404, {"error": f"no route {path}"})
+        if self.worker.fail_results:         # fault injection hook
+            self._send(500, {"error": "injected results failure"})
+            return
+        buffer = int(parts[4]) if len(parts) == 6 else 0
+        token = int(parts[-1])
+        binary = "x-trino-pages" in self.headers.get("Accept", "")
+        # only bookkeeping under the lock: P concurrent consumer
+        # pulls + the producer's _emit all contend on it, so socket
+        # writes must happen after release
+        frame = None
+        envelope = None
+        with task.lock:
+            pages = task.buffers.setdefault(buffer, [])
+            acked = task.acked.get(buffer, 0)
+            # Advancing to `token` acknowledges every page below it
+            # (TaskResource.java:372's implicit-ack contract) — drop
+            # drained pages so a long-lived worker's memory stays flat;
+            # same-token retries after a fetch failure still succeed.
+            while acked < token and pages:
+                pages.pop(0)
+                acked += 1
+            task.acked[buffer] = acked
+            idx = token - acked
+            total = acked + len(pages)
+            if 0 <= idx < len(pages):
+                frame = pages[idx]
+            else:
+                done = task.state in ("FINISHED", "FAILED",
+                                      "CANCELED")
+                envelope = {"token": token,
+                            "complete": done and token >= total,
+                            "state": task.state,
+                            "error": task.error, "page": None}
+        if frame is not None:
+            if binary:
+                self._send_page(frame, {"X-Trino-Token": token,
+                                        "X-Trino-Complete": "false"})
+            else:
+                import base64
+                self._send(200, {
+                    "token": token, "complete": False,
+                    "page": {"b64": base64.b64encode(
+                        frame).decode()}})
+        else:
+            self._send(200, envelope)
 
-    def do_PUT(self):
-        path = urlparse(self.path).path
-        if path == "/v1/info/state":         # graceful shutdown / drain
-            n = int(self.headers.get("Content-Length", 0))
-            state = json.loads(self.rfile.read(n).decode())
-            self.worker.state = state
-            self._send(200, {"state": self.worker.state})
+    # POST /v1/task/{id} — create/update with fragment + splits
+    # (server/TaskResource.java:146 createOrUpdateTask)
+    def _post_task(self, parts, user):
+        if self.worker.fail_tasks:           # fault injection hook
+            self._send(500, {"error": "injected task failure"})
             return
-        self._send(404, {"error": f"no route {path}"})
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n).decode())
+        from .failureinjector import InjectedFailure
+        from .tasks import Split
+        splits = [Split(**s) for s in body.get("splits", [])]
+        try:
+            task = self.worker.task_manager.create_or_update(
+                parts[2], body["fragment"], splits,
+                partition=body.get("partition"),
+                sources=body.get("sources"),
+                traceparent=self.headers.get("traceparent"))
+        except InjectedFailure as e:
+            # chaos at task intake (crash/drop/raise all surface to
+            # the coordinator as a failed POST -> split reassignment)
+            self._send(500, {"error": str(e)})
+            return
+        self._send(200, self.worker.task_manager.status_json(task))
+
+    # DELETE /v1/task/{id} — cancel/abort (TaskResource.java:319's
+    # fail route collapsed with delete)
+    def _delete_task(self, parts, user):
+        self.worker.task_manager.cancel(parts[2])
+        self._send(204, {})
+
+    def _put_state(self, parts, user):       # graceful shutdown / drain
+        n = int(self.headers.get("Content-Length", 0))
+        state = json.loads(self.rfile.read(n).decode())
+        self.worker.state = state
+        self._send(200, {"state": self.worker.state})
 
 
 class WorkerServer:
@@ -200,7 +238,7 @@ class WorkerServer:
         from ..catalog import default_catalog
         from .tasks import TaskManager
         self.catalog = catalog if catalog is not None else default_catalog()
-        self.task_manager = TaskManager(self.catalog)
+        self.task_manager = TaskManager(self.catalog, node_id=node_id)
         handler = type("BoundWorkerHandler", (_WorkerHandler,),
                        {"worker": self})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -236,7 +274,8 @@ class WorkerServer:
                 pass
 
         RetryPolicy(base_delay_s=0.1, max_delay_s=1.0,
-                    max_attempts=max(1, attempts)).call(
+                    max_attempts=max(1, attempts),
+                    name="announce").call(
             post, retry_on=(OSError,),
             sleep=lambda d: self._stop.wait(d))
 
