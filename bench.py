@@ -604,6 +604,143 @@ def chaos_soak(n_seeds=None, cluster=None, out_path="BENCH_chaos.json"):
     return rec
 
 
+def memory_pressure_soak(n_queries=None, out_path="BENCH_memory.json"):
+    """Memory-pressure soak (round 9 acceptance): >= 20 concurrent
+    queries against a 3-worker cluster with every executor pool clamped
+    to 25% of the measured working set. Requires 0 wrong answers and 0
+    worker crashes — queries must survive by spilling (host-spill
+    radix partitioning, revocable partial state) or fail cleanly with
+    QUERY_EXCEEDED_MEMORY, never by taking a worker down. Emits
+    BENCH_memory.json with spill/backpressure/killer counters."""
+    import threading as _th
+
+    from trino_tpu.client.client import Client, QueryError
+    from trino_tpu.exec.session import Session
+    from trino_tpu.metrics import REGISTRY
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.failuredetector import HeartbeatFailureDetector
+    from trino_tpu.server.worker import WorkerServer
+
+    n = n_queries if n_queries is not None else \
+        int(os.environ.get("TRINO_TPU_MEMSOAK_QUERIES", 24))
+    queries = {
+        "join_agg": ("SELECT o_custkey, count(*) AS c, "
+                     "sum(o_totalprice) AS s FROM orders JOIN customer "
+                     "ON o_custkey = c_custkey WHERE c_acctbal > 0 "
+                     "GROUP BY o_custkey ORDER BY s DESC, o_custkey "
+                     "LIMIT 50"),
+        "wide_agg": ("SELECT l_returnflag, l_linestatus, "
+                     "sum(l_quantity) AS q, count(*) AS c, "
+                     "min(l_discount) AS mn, max(l_tax) AS mx "
+                     "FROM lineitem GROUP BY l_returnflag, l_linestatus "
+                     "ORDER BY l_returnflag, l_linestatus"),
+        "big_group": ("SELECT l_orderkey, sum(l_quantity) AS q "
+                      "FROM lineitem GROUP BY l_orderkey "
+                      "ORDER BY q DESC, l_orderkey LIMIT 20"),
+        "point": "SELECT count(*) FROM nation",
+    }
+    # 1) measure the working set at an unconstrained pool (rows
+    # normalized like the protocol does — Decimal/date render as text)
+    t_start = time.monotonic()
+    session = Session(default_schema="tiny")
+    baselines = {}
+    for name, q in queries.items():
+        baselines[name] = _chaos_rows(session.execute(q).rows)
+    working_set = session.executor.pool.peak
+    limit = max(1 << 20, working_set // 4)
+
+    # 2) cluster with every pool clamped to 25%
+    session.properties["query_max_memory_mb"] = max(1, limit >> 20)
+    session.executor.pool.set_limit(limit)
+    coord = CoordinatorServer(session, max_concurrency=4).start()
+    coord.state.scheduler.split_rows = 8192
+    workers = [WorkerServer(f"mem-w{i}", coord.uri,
+                            announce_interval_s=0.1,
+                            catalog=session.catalog).start()
+               for i in range(3)]
+    for w in workers:
+        w.task_manager._executor.pool.set_limit(limit)
+        w.task_manager.max_buffer_bytes = 1 << 20   # exercise backpressure
+    detector = HeartbeatFailureDetector(coord.state,
+                                        interval_s=0.2).start()
+    coord.state.memory_manager.interval_s = 0.2
+    coord.state.memory_manager.start()
+
+    reg0 = REGISTRY.snapshot()
+    rec = {"metric": "memory_pressure_soak", "queries": 0,
+           "wrong_answers": 0, "failed_queries": 0,
+           "oom_user_errors": 0, "worker_crashes": 0,
+           "concurrent": n, "working_set_bytes": int(working_set),
+           "pool_limit_bytes": int(limit)}
+    lock = _th.Lock()
+
+    def one(i: int) -> None:
+        name = list(queries)[i % len(queries)]
+        client = Client(coord.uri, user=f"soak{i}", timeout_s=180)
+        try:
+            rows = client.execute(queries[name]).rows
+        except QueryError as e:
+            with lock:
+                if e.error_name == "QUERY_EXCEEDED_MEMORY":
+                    rec["oom_user_errors"] += 1      # clean user error
+                else:
+                    rec["failed_queries"] += 1
+            return
+        except Exception:    # noqa: BLE001 — client-side transport
+            with lock:       # failure: count it, never lose the thread
+                rec["failed_queries"] += 1
+            return
+        with lock:
+            rec["queries"] += 1
+            if _chaos_rows(rows) != baselines[name]:
+                rec["wrong_answers"] += 1
+
+    threads = [_th.Thread(target=one, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+
+    # 3) no worker crashed: every worker still answers /v1/status ACTIVE
+    from urllib.request import urlopen
+    for w in workers:
+        try:
+            with urlopen(f"{w.uri}/v1/status", timeout=5) as resp:
+                ok = resp.status == 200
+        except Exception:
+            ok = False
+        if not ok:
+            rec["worker_crashes"] += 1
+
+    after = REGISTRY.snapshot()
+
+    def delta(key):
+        return int(after.get(key, 0) - reg0.get(key, 0))
+
+    rec["spill_bytes"] = delta(("trino_tpu_spill_bytes_total",))
+    rec["spill_partitions"] = delta(("trino_tpu_spill_partitions_total",))
+    rec["revocations"] = delta(("trino_tpu_memory_revocations_total",))
+    rec["backpressure_waits"] = delta(
+        ("trino_tpu_exchange_backpressure_waits_total",))
+    rec["queries_killed_oom"] = delta(
+        ("trino_tpu_queries_killed_oom_total",))
+    rec["elapsed_s"] = round(time.monotonic() - t_start, 1)
+    rec["passed"] = (rec["wrong_answers"] == 0 and
+                     rec["worker_crashes"] == 0 and
+                     rec["failed_queries"] == 0)
+    coord.state.memory_manager.stop()
+    detector.stop()
+    for w in workers:
+        w.stop()
+    coord.stop()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 # ---------------------------------------------------------------------------
 
 def run_config(session, sql, runs=RUNS, prewarm=PREWARM):
@@ -683,6 +820,9 @@ def cached_baseline(key: str, fn):
 def main():
     if "--chaos" in sys.argv:
         chaos_soak()
+        return
+    if "--memory-pressure" in sys.argv:
+        memory_pressure_soak()
         return
     if "--gather-micro" in sys.argv:
         gather_micro()

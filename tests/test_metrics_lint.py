@@ -70,6 +70,37 @@ def test_no_inline_route_dispatch_outside_the_table():
                 f"inline — add the route to ROUTES instead"
 
 
+# acceptance-scraped metric families that MUST render on a cold server
+# (pre-initialized at import — a missing sample reads as "metric never
+# existed" to a scraper, round-9 memory surface included)
+REQUIRED_FAMILIES = (
+    "trino_tpu_memory_reserved_bytes",
+    "trino_tpu_memory_revocable_bytes",
+    "trino_tpu_memory_revocations_total",
+    "trino_tpu_memory_accounting_errors_total",
+    "trino_tpu_spill_bytes_total",
+    "trino_tpu_spill_partitions_total",
+    "trino_tpu_spill_retries_total",
+    "trino_tpu_queries_killed_oom_total",
+    "trino_tpu_exchange_backpressure_waits_total",
+    "trino_tpu_pageserde_crc_failures_total",
+    "trino_tpu_sched_task_retries_total",
+)
+
+
+def test_required_families_render_preinitialized():
+    from trino_tpu.metrics import REGISTRY
+    text = REGISTRY.render()
+    for family in REQUIRED_FAMILIES:
+        assert f"# TYPE {family} " in text, \
+            f"{family} missing from a cold registry render"
+        # at least one sample line (pre-initialized, not just declared)
+        assert any(line.startswith(family) and " " in line
+                   for line in text.splitlines()
+                   if not line.startswith("#")), \
+            f"{family} declared but renders no sample"
+
+
 def test_markers_used_are_declared_in_pytest_ini():
     ini = configparser.ConfigParser()
     ini.read(os.path.join(REPO_ROOT, "pytest.ini"))

@@ -643,7 +643,13 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
     per_chunk_target = plan.merge_agg if plan.merge_agg is not None \
         else root.child
 
-    partials: List[Batch] = []
+    # spillable partial-aggregation state: device partials hold REVOCABLE
+    # reservations; under memory pressure the pool's revocation request
+    # moves them to host pages and the merge step re-aggregates
+    # partition-wise (exec/spill.PartialState)
+    from .spill import PartialState
+    partial_state = PartialState(executor) \
+        if plan.merge_agg is not None else None
     concat_arrays: List[list] = []
     concat_valids: List[list] = []
     # one shared padded capacity => one jit trace for every chunk
@@ -770,12 +776,16 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
             executor.stats.agg_spill_chunks += 1
             if fact is not None:
                 executor.stats.fact_cache_chunks += 1
-            if plan.merge_agg is not None:
-                partials.append(out)
+            if partial_state is not None:
+                partial_state.add(out)
             else:
                 arrs, vals = batch_to_numpy(out)
                 concat_arrays.append(arrs)
                 concat_valids.append(vals)
+    except BaseException:
+        if partial_state is not None:
+            partial_state.close()       # drop revocable reservations
+        raise
     finally:
         executor.exit_chunk_mode()
 
@@ -804,10 +814,11 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
             # plain program (the stale measurement was just invalidated,
             # so the retry does not re-adapt)
             executor.stats.escaped_window_reruns += 1
+            partial_state.close()
             _prof("adaptation violated; plain rerun")
             return execute_chunked(executor, root)
     _prof("chunk loop dispatched; merging")
-    merged = merge_partials(executor, plan.merge_agg, partials)
+    merged = partial_state.merge(plan.merge_agg)
     # structure-faithful (see concat mode above): decisions above the
     # merge point replay from the cross-run cache
     executor._subst[id(plan.merge_agg)] = merged

@@ -61,6 +61,11 @@ class ExecStats:
     escaped_window_reruns: int = 0   # adapted fused runs whose window /
                                      # capacity guesses were violated
     compaction_overflows: int = 0    # in-program compaction capacity hit
+    spilled_joins: int = 0           # joins retried through host-spill
+                                     # radix partitioning (exec/spill.py)
+    spilled_aggregations: int = 0    # aggregations/partial states spilled
+    spilled_sorts: int = 0           # sorts retried on host (TopN under
+                                     # pressure)
 
 
 class QueryDeadlineError(RuntimeError):
@@ -95,9 +100,29 @@ class Executor:
         self.stats = ExecStats()
         self.profile = False           # EXPLAIN ANALYZE per-node timing
         self.node_stats: Dict[int, tuple] = {}   # id(node) -> (wall_s, rows)
-        from .memory import MemoryPool
-        self.pool = MemoryPool(64 << 30)         # query memory limit
+        from .memory import MemoryPool, parse_bytes
+        # per-query memory limit: TRINO_TPU_QUERY_MAX_MEMORY env (bytes,
+        # B/kB/MB/GB suffixes) or the 64 GiB default; the session applies
+        # its query_max_memory_mb property per query via set_limit
+        env_limit = os.environ.get("TRINO_TPU_QUERY_MAX_MEMORY")
+        self.pool = MemoryPool(parse_bytes(env_limit) if env_limit
+                               else (64 << 30))
         self._node_bytes: Dict[int, int] = {}
+        # host-spill survival chain (exec/spill.py): when a join/agg
+        # reservation cannot fit even after revocation, the operator
+        # retries partition-wise through the host/disk tier
+        self.enable_spill = True
+        self.spill_partitions = 8
+        self.spill_force_disk = False     # tests/chaos: all spills to disk
+        self.spiller = None               # lazy HostSpiller
+        self._kill_reason: Optional[str] = None   # LowMemoryKiller's flag
+        self._no_decisions = 0            # >0: bypass the decision cache
+                                          # (partition-wise spill phases)
+        # executor-owned caches hold REVOCABLE reservations: under
+        # pressure the pool asks this callback to spill them (drop; they
+        # re-run or re-ingest on next use)
+        self._revocation_handle = self.pool.register_revocation(
+            self._revoke_caches, tag="executor-caches")
         # chunked-mode substitutions: id(plan node) -> precomputed Batch
         # (streamed scan chunk, pinned build side, or merged partials)
         self._subst: Dict[int, Batch] = {}
@@ -173,6 +198,42 @@ class Executor:
 
     # ------------------------------------------------------------------
 
+    def _revoke_caches(self, target_bytes: int) -> int:
+        """Revocation callback: evict cached build batches (revocable
+        reservations) until the target is met. Evicted builds re-run on
+        next use — correctness never depends on the cache."""
+        freed = 0
+        for key in list(self._build_cache):
+            if freed >= target_bytes:
+                break
+            self._build_cache.pop(key, None)
+            b = self._build_cache_bytes.pop(key, 0)
+            self.pool.free_revocable(b, tag="build-cache")
+            freed += b
+        return freed
+
+    def request_kill(self, reason: str) -> None:
+        """Cluster LowMemoryKiller's hook: the next plan-node boundary
+        raises MemoryKilledError (surfaced as QUERY_EXCEEDED_MEMORY)."""
+        self._kill_reason = reason
+
+    class _NoDecisions:
+        def __init__(self, ex):
+            self.ex = ex
+
+        def __enter__(self):
+            self.ex._no_decisions += 1
+
+        def __exit__(self, *exc):
+            self.ex._no_decisions -= 1
+            return False
+
+    def no_decisions(self) -> "Executor._NoDecisions":
+        """Bypass the cross-run decision cache inside the block — the
+        spill paths run the SAME plan node over per-partition data, so
+        cached counts would poison replay."""
+        return Executor._NoDecisions(self)
+
     def invalidate_scan_cache(self) -> None:
         """Drop cached scans AND their byte accounting together — clearing
         only the OrderedDict leaves ghost sizes that permanently shrink the
@@ -210,6 +271,7 @@ class Executor:
 
     def execute(self, root: L.OutputNode) -> Batch:
         assert isinstance(root, L.OutputNode)
+        self._kill_reason = None
         # release reservations surviving from the previous query (the root
         # batch lives until its results are drained)
         for b in self._node_bytes.values():
@@ -238,11 +300,64 @@ class Executor:
         sub = self._subst.get(id(node))
         if sub is not None:
             return sub
+        if self._kill_reason is not None:
+            from .memory import MemoryKilledError
+            raise MemoryKilledError(self._kill_reason)
         if self.deadline is not None:
             import time as _t
             if _t.monotonic() > self.deadline:
                 raise QueryDeadlineError(
                     "query exceeded query_max_run_time_s")
+        from .memory import ExceededMemoryLimitError, MemoryKilledError, \
+            batch_bytes
+        try:
+            out = self._dispatch_timed(node)
+            b = batch_bytes(out)
+            self.pool.reserve(b)
+        except MemoryKilledError:
+            raise                         # the killer's verdict is final
+        except ExceededMemoryLimitError:
+            # memory-pressure survival: joins/aggregations retry through
+            # the host-spill radix partitioner; anything else fails
+            # cleanly as QUERY_EXCEEDED_MEMORY
+            out = self._spill_retry(node)
+            b = batch_bytes(out)
+            self.pool.reserve(b)
+        # memory accounting: reserve this node's output, release the
+        # children's (their batches die once the parent has consumed them)
+        # — the operator->query context pyramid collapsed to plan nodes
+        self._node_bytes[id(node)] = b
+        for c in L.children(node):
+            if id(c) in self._subst:
+                continue    # pinned (chunked-mode build/merge): lives on
+            self.pool.free(self._node_bytes.pop(id(c), 0))
+        return out
+
+    def _spill_retry(self, node: L.PlanNode) -> Batch:
+        """Retry a memory-failed Join/Aggregate partition-wise through
+        the host-spill tier (exec/spill.py). The innermost failing
+        operator spills first; if its shape is unsupported, the original
+        error propagates so an enclosing operator (or the query
+        boundary) handles it."""
+        if not self.enable_spill or \
+                not isinstance(node, (L.JoinNode, L.AggregateNode,
+                                      L.SortNode)):
+            raise
+        # drop this subtree's partial reservations from the failed
+        # attempt; the spill path re-executes the children bounded
+        self.release_path_reservations(node, keep=self._subst)
+        from .spill import spill_aggregate, spill_join, spill_sort
+        if isinstance(node, L.JoinNode):
+            out = spill_join(self, node)
+        elif isinstance(node, L.AggregateNode):
+            out = spill_aggregate(self, node)
+        else:
+            out = spill_sort(self, node)
+        if out is None:
+            raise
+        return out
+
+    def _dispatch_timed(self, node: L.PlanNode) -> Batch:
         if self.TRACE:
             import sys
             import time as _t
@@ -276,17 +391,6 @@ class Executor:
             OPERATOR_DISPATCHES.inc(operator=op)
             OPERATOR_WALL_MS.inc((_time.monotonic() - t0) * 1000,
                                  operator=op)
-        # memory accounting: reserve this node's output, release the
-        # children's (their batches die once the parent has consumed them)
-        # — the operator->query context pyramid collapsed to plan nodes
-        from .memory import batch_bytes
-        b = batch_bytes(out)
-        self.pool.reserve(b)
-        self._node_bytes[id(node)] = b
-        for c in L.children(node):
-            if id(c) in self._subst:
-                continue    # pinned (chunked-mode build/merge): lives on
-            self.pool.free(self._node_bytes.pop(id(c), 0))
         return out
 
     def build_structure_key(self, node: L.PlanNode) -> Optional[str]:
@@ -375,7 +479,7 @@ class Executor:
         carry data the structure key doesn't describe — split 2 of a
         worker task must not reuse split 1's counts). Structure-faithful
         substitutions (pinned deterministic builds) do NOT bypass."""
-        if self.chunk_mode:
+        if self.chunk_mode or self._no_decisions:
             return False
         if not self._subst_opaque:
             return True
@@ -440,18 +544,30 @@ class Executor:
         if len(self._build_cache) >= 8:      # bounded: drop eldest
             old = next(iter(self._build_cache))
             self._build_cache.pop(old)
-            self.pool.free(self._build_cache_bytes.pop(old, 0))
+            self.pool.free_revocable(
+                self._build_cache_bytes.pop(old, 0), tag="build-cache")
         # transfer the reservation run() made from the per-query ledger
-        # to the cache's: the batch outlives the query, so the pool must
-        # keep counting it until eviction
+        # to the cache's REVOCABLE ledger: the batch outlives the query,
+        # so the pool keeps counting it until eviction — but as spillable
+        # bytes the revocation callback may reclaim under pressure
         from .memory import batch_bytes
         b = self._node_bytes.pop(id(node), None)
-        if b is None:
+        if b is not None:
+            self.pool.free(b)
+        else:
             b = batch_bytes(out)
-            self.pool.reserve(b)
+        self.pool.reserve_revocable(b, tag="build-cache")
         self._build_cache[key] = out
         self._build_cache_bytes[key] = b
         return out
+
+    def release_all_reservations(self) -> None:
+        """Free every per-node reservation (the distributed scheduler's
+        merge path runs plan nodes without execute()'s per-query cleanup
+        — under a small pool those leaked bytes starve later queries)."""
+        for b in self._node_bytes.values():
+            self.pool.free(b)
+        self._node_bytes.clear()
 
     def release_path_reservations(self, node: L.PlanNode, keep) -> None:
         """Free reservations of `node`'s subtree (chunked mode: the

@@ -46,13 +46,29 @@ def _bool(v):
     return str(v).lower() in ("true", "1")
 
 
+def _default_query_max_memory_mb() -> int:
+    """TRINO_TPU_QUERY_MAX_MEMORY (bytes, B/kB/MB/GB suffixes) overrides
+    the 64 GiB per-query default for every session in the process."""
+    import os
+    env = os.environ.get("TRINO_TPU_QUERY_MAX_MEMORY")
+    if env:
+        from .memory import parse_bytes
+        return max(1, parse_bytes(env) >> 20)
+    return 64 << 10
+
+
 SESSION_PROPERTY_DEFAULTS = {
     "distributed": (False, _bool),
     "query_max_rows": (10_000_000, int),
     # per-query memory limit (memory/MemoryPool reserve path)
-    "query_max_memory_mb": (64 << 10, int),
+    "query_max_memory_mb": (_default_query_max_memory_mb(), int),
     # bounded-memory aggregation chunk size, 0 = off (spill analog)
     "spill_chunk_rows": (0, int),
+    # host-spill survival chain (exec/spill.py): joins/aggregations whose
+    # working set exceeds the pool retry partition-wise through host
+    # RAM/disk instead of failing
+    "spill_enabled": (True, _bool),
+    "spill_partitions": (8, int),
     # Pallas MXU one-pass aggregation kernel (ops/pallas_agg.py)
     "mxu_agg": (False, _bool),
     # Pallas tiled-gather probe kernel (ops/pallas_gather.py): auto =
@@ -145,6 +161,9 @@ class Session:
         """Push session properties into the executor for this query
         (SystemSessionProperties -> TaskContext wiring, collapsed)."""
         ex = self.executor
+        ex.pool.set_limit(self.properties["query_max_memory_mb"] << 20)
+        ex.enable_spill = self.properties["spill_enabled"]
+        ex.spill_partitions = self.properties["spill_partitions"]
         ex.enable_dynamic_filtering = self.properties["dynamic_filtering"]
         ex.enable_merge_join = self.properties["merge_join"]
         ex.scan_cache_max_bytes = \
@@ -253,9 +272,9 @@ class Session:
         if stmt.name == "distributed":
             self.set_distributed(self.properties["distributed"])
         elif stmt.name == "query_max_memory_mb":
-            from .memory import MemoryPool
-            self.executor.pool = MemoryPool(
-                self.properties[stmt.name] << 20)
+            # in-place limit change: replacing the pool object would leak
+            # the cached builds' revocable ledger
+            self.executor.pool.set_limit(self.properties[stmt.name] << 20)
         elif stmt.name == "spill_chunk_rows":
             self.executor.spill_chunk_rows = \
                 self.properties[stmt.name] or None

@@ -301,8 +301,39 @@ SPOOL_MISSES = REGISTRY.counter(
     "trino_tpu_spool_misses_total",
     "Exchange-spool reads that missed (work dispatched live)")
 
+# memory arbitration (exec/memory.py, exec/spill.py, server/memorymanager.py)
+MEMORY_RESERVED = REGISTRY.gauge(
+    "trino_tpu_memory_reserved_bytes",
+    "User memory reserved against each pool", ("pool",))
+MEMORY_REVOCABLE = REGISTRY.gauge(
+    "trino_tpu_memory_revocable_bytes",
+    "Revocable (spillable) memory reserved against each pool", ("pool",))
+MEMORY_REVOCATIONS = REGISTRY.counter(
+    "trino_tpu_memory_revocations_total",
+    "Revocation requests driven by memory pressure (spill triggers)")
+MEMORY_ACCOUNTING_ERRORS = REGISTRY.counter(
+    "trino_tpu_memory_accounting_errors_total",
+    "Reservation double-frees / leaks detected by the pool ledger")
+SPILL_BYTES = REGISTRY.counter(
+    "trino_tpu_spill_bytes_total",
+    "Bytes spilled to the host/disk tier by joins and aggregations")
+SPILL_PARTITIONS = REGISTRY.counter(
+    "trino_tpu_spill_partitions_total",
+    "Radix partitions written by the spill layer")
+SPILL_RETRIES = REGISTRY.counter(
+    "trino_tpu_spill_retries_total",
+    "Spill container write/verify failures recovered from host RAM")
+QUERIES_KILLED_OOM = REGISTRY.counter(
+    "trino_tpu_queries_killed_oom_total",
+    "Queries killed by the cluster LowMemoryKiller")
+BACKPRESSURE_WAITS = REGISTRY.counter(
+    "trino_tpu_exchange_backpressure_waits_total",
+    "Producer pauses because a task output buffer hit its byte bound")
+
 # the labeled families acceptance scrapes: seed the hot label values so
 # a cold server's /v1/metrics already carries them at 0
 for _op in ("scan", "output"):
     OPERATOR_ROWS.init_labels(operator=_op)
 RETRY_ATTEMPTS.init_labels(component="announce")
+MEMORY_RESERVED.init_labels(pool="general")
+MEMORY_REVOCABLE.init_labels(pool="general")

@@ -50,6 +50,7 @@ ROUTES = (
     ("GET", ("v1", "metrics"), "_get_metrics", False),
     ("GET", ("v1", "spooled", "segments", STAR), "_get_segment", True),
     ("GET", ("v1", "resourceGroup"), "_get_resource_group", True),
+    ("GET", ("v1", "memory"), "_get_memory", True),
     ("GET", ("v1", "node"), "_get_nodes", True),
     ("GET", ("v1", "query"), "_get_queries", True),
     ("GET", ("v1", "query", STAR), "_get_query", True),
@@ -77,11 +78,14 @@ class QueryDeclinedError(RuntimeError):
 def _is_retryable(e: Exception) -> bool:
     """User errors (bad SQL, missing columns) never retry; runtime/injected
     failures do — the reference draws the same line via error categories
-    (USER_ERROR vs INTERNAL_ERROR/EXTERNAL)."""
+    (USER_ERROR vs INTERNAL_ERROR/EXTERNAL). Memory kills are user
+    errors too: retrying an OOM reproduces it."""
+    from ..exec.memory import ExceededMemoryLimitError
     from ..planner.analyzer import AnalysisError
     from ..sql.tokenizer import SqlSyntaxError
     return not isinstance(e, (AnalysisError, SqlSyntaxError,
-                              AssertionError, QueryDeclinedError))
+                              AssertionError, QueryDeclinedError,
+                              ExceededMemoryLimitError))
 
 
 class RegisteredNode:
@@ -92,6 +96,9 @@ class RegisteredNode:
         self.uri = uri
         self.last_announce = time.time()
         self.state = "ACTIVE"        # ACTIVE | SHUTTING_DOWN | FAILED
+        # last heartbeat-reported memory pool snapshot (cluster
+        # arbitration input; scheduler placement prefers low-memory nodes)
+        self.memory: Optional[dict] = None
 
 
 class Dispatcher:
@@ -193,6 +200,7 @@ class Dispatcher:
                                              service="coordinator")
             tq.tracer = tracer
         last_error: Optional[str] = None
+        last_exc: Optional[Exception] = None
         # backoff between QUERY-retry attempts (shared RetryPolicy,
         # decorrelated jitter): failed queries re-admitting immediately
         # compound whatever overload/flap failed them the first time
@@ -238,10 +246,19 @@ class Dispatcher:
                     return
                 except Exception as e:  # noqa: BLE001 — retry boundary
                     last_error = f"{type(e).__name__}: {e}"
+                    last_exc = e
                     tq.plan_text = traceback.format_exc()
                     if not _is_retryable(e):
                         break
-            sm.fail(last_error or "query failed")
+            # user-error taxonomy: memory kills fail with their own
+            # errorName (QUERY_EXCEEDED_MEMORY) instead of the generic
+            # internal-failure envelope
+            sm.fail(last_error or "query failed",
+                    error_name=getattr(last_exc, "error_name",
+                                       "GENERIC_INTERNAL_ERROR")
+                    if last_error else "GENERIC_INTERNAL_ERROR",
+                    error_code=getattr(last_exc, "error_code", 1)
+                    if last_error else 1)
         finally:
             if tracer is not None:
                 tq.trace = tracer.export()
@@ -250,6 +267,20 @@ class Dispatcher:
         """One execution attempt under the exec lock: cluster path first,
         local fallback second (Trino's coordinator-only path)."""
         t0 = time.monotonic()
+        result = None
+        # tag the pool ledger with the query id so the LowMemoryKiller's
+        # total-reservation-dominant policy can attribute bytes
+        pool = getattr(getattr(self.session, "executor", None),
+                       "pool", None)
+        if pool is not None:
+            pool.set_current_tag(tq.query_id)
+        try:
+            self._execute_attempt_inner(tq, t0)
+        finally:
+            if pool is not None:
+                pool.set_current_tag("")
+
+    def _execute_attempt_inner(self, tq: TrackedQuery, t0: float) -> None:
         result = None
         if self.scheduler is not None:
             # cluster path: fragment + dispatch to workers; None = not
@@ -302,6 +333,11 @@ class CoordinatorState:
         self.dispatcher.scheduler = self.scheduler
         from .spooling import SpoolingManager
         self.spooling = SpoolingManager()
+        # cluster memory arbitration: pooled accounting over worker
+        # heartbeat reports + the low-memory killer; start() its loop (or
+        # tick() on demand) to enforce a cluster limit
+        from .memorymanager import ClusterMemoryManager
+        self.memory_manager = ClusterMemoryManager(self)
         # system.runtime.{queries,nodes,tasks,operator_stats} backed by
         # this coordinator's state
         from .system_connector import SystemConnector
@@ -408,8 +444,8 @@ class _Handler(BaseHTTPRequestHandler):
         sm = tq.state_machine
         if sm.state == "FAILED":
             payload["error"] = {"message": sm.error,
-                                "errorCode": 1,
-                                "errorName": "GENERIC_INTERNAL_ERROR"}
+                                "errorCode": sm.error_code,
+                                "errorName": sm.error_name}
             return payload
         if sm.state == "CANCELED":
             payload["error"] = {"message": "Query was canceled",
@@ -516,6 +552,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _get_resource_group(self, parts, user):
         self._send(200, self.state.dispatcher.resource_groups.info())
+
+    def _get_memory(self, parts, user):
+        # cluster memory view (memory/ClusterMemoryManager's JMX beans,
+        # flattened): coordinator pool + per-worker heartbeat reports
+        self._send(200, self.state.memory_manager.snapshot())
 
     def _get_nodes(self, parts, user):
         nodes = [{"nodeId": n.node_id, "uri": n.uri, "state": n.state}

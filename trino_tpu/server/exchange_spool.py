@@ -122,6 +122,13 @@ class ExchangeSpool:
         except (OSError, InjectedFailure):
             self.write_skips += 1
 
+    def delete(self, key: str) -> None:
+        """Drop one container (spill partitions are consumed once)."""
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
     def clear(self) -> None:
         for f in os.listdir(self.root):
             if f.endswith((".json", ".spool")):

@@ -8,6 +8,7 @@ excluded from scheduling until they recover (:91, :377).
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Dict
@@ -74,6 +75,7 @@ class HeartbeatFailureDetector:
         for node in nodes:
             st = self.stats.setdefault(node.node_id, NodeStats())
             ok = False
+            memory = None
             try:
                 if self.injector is not None:
                     # chaos: RAISE/DROP -> failed probe sample; DELAY ->
@@ -83,6 +85,13 @@ class HeartbeatFailureDetector:
                 with urlopen(f"{node.uri}/v1/status",
                              timeout=self.timeout_s) as resp:
                     ok = resp.status == 200
+                    try:
+                        # heartbeat payload carries the worker's memory
+                        # pool snapshot for cluster arbitration
+                        memory = json.loads(resp.read().decode()
+                                            ).get("memory")
+                    except Exception:    # noqa: BLE001 — old workers
+                        memory = None
             except Exception:
                 ok = False
             st.record(ok)
@@ -90,6 +99,8 @@ class HeartbeatFailureDetector:
                 live = self.state.nodes.get(node.node_id)
                 if live is None:
                     continue
+                if ok and memory is not None:
+                    live.memory = memory
                 if st.failure_ratio > self.threshold:
                     live.state = "FAILED"
                 elif live.state == "FAILED":

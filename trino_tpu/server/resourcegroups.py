@@ -5,12 +5,27 @@ groups form a tree; each group has a hard concurrency limit and a queue
 bound; selectors route queries to groups by user; FIFO within a group.
 Config is pluggable in the reference (file/DB managers,
 plugin/trino-resource-group-managers) — here a plain dataclass tree.
+
+Round-9 growth — memory-aware admission + queue-wait accounting:
+
+- `soft_memory_limit_bytes` (InternalResourceGroup.softMemoryLimitBytes):
+  while a group's observed memory usage exceeds its soft limit, queued
+  queries STAY queued (admission gates on bytes, not just concurrency).
+  The ClusterMemoryManager publishes the cluster's reserved+revocable
+  total each tick via `set_cluster_memory`, which also drains any queues
+  that became runnable as memory dropped.
+- queue-wait accounting: every queued entry records its enqueue time;
+  admission (via `finished` or the memory tick) folds the wait into the
+  group's stats, exposed in info() and system.runtime.resource_groups —
+  the old code admitted queued queries without ever recording how long
+  they waited.
 """
 
 from __future__ import annotations
 
 import re
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -25,6 +40,9 @@ class ResourceGroupConfig:
     name: str
     hard_concurrency_limit: int = 4
     max_queued: int = 100
+    # memory-aware admission: while the group's observed usage exceeds
+    # this, queued queries stay queued (None = no memory gate)
+    soft_memory_limit_bytes: Optional[int] = None
     sub_groups: tuple = ()
 
 
@@ -40,12 +58,16 @@ class ResourceGroup:
         self.config = config
         self.parent = parent
         self.running = 0
+        # (run callable, enqueue monotonic time)
         self.queue: deque = deque()
         self.sub_groups: Dict[str, ResourceGroup] = {
             sub.name: ResourceGroup(sub, self)
             for sub in config.sub_groups}
         self.stats_total_admitted = 0
         self.stats_peak_queued = 0
+        self.stats_total_queue_wait_s = 0.0
+        self.stats_dequeued = 0          # admissions that waited in queue
+        self.memory_usage_bytes = 0      # last published observation
 
     @property
     def path(self) -> str:
@@ -54,10 +76,13 @@ class ResourceGroup:
 
     def can_run(self) -> bool:
         """A query may start when every group up the chain has headroom
-        (the reference's canRunMore walk)."""
+        (the reference's canRunMore walk) — concurrency AND memory."""
         g: Optional[ResourceGroup] = self
         while g is not None:
             if g.running >= g.config.hard_concurrency_limit:
+                return False
+            soft = g.config.soft_memory_limit_bytes
+            if soft is not None and g.memory_usage_bytes > soft:
                 return False
             g = g.parent
         return True
@@ -94,6 +119,16 @@ class ResourceGroupManager:
             g = g.sub_groups[p]
         return g
 
+    def _groups(self):
+        out = []
+
+        def walk(g: ResourceGroup):
+            out.append(g)
+            for sub in g.sub_groups.values():
+                walk(sub)
+        walk(self.root)
+        return out
+
     def select(self, user: str) -> ResourceGroup:
         for sel in self.selectors:
             if re.fullmatch(sel.user_pattern, user):
@@ -109,7 +144,7 @@ class ResourceGroupManager:
                 group.acquire()
                 to_run = run
             elif len(group.queue) < group.config.max_queued:
-                group.queue.append(run)
+                group.queue.append((run, time.monotonic()))
                 group.stats_peak_queued = max(group.stats_peak_queued,
                                               len(group.queue))
                 return group.path
@@ -119,27 +154,68 @@ class ResourceGroupManager:
         to_run()
         return group.path
 
+    def _pop_runnable_locked(self, group: ResourceGroup) \
+            -> Optional[Callable[[], None]]:
+        """Admit the group's next queued query if it can run now,
+        recording its queue wait (the accounting `finished()` used to
+        skip entirely)."""
+        if group.queue and group.can_run():
+            run, t0 = group.queue.popleft()
+            group.acquire()
+            group.stats_total_queue_wait_s += time.monotonic() - t0
+            group.stats_dequeued += 1
+            return run
+        return None
+
     def finished(self, group_path: str) -> Optional[Callable[[], None]]:
         """Release a slot; returns the next queued query to start (the
         caller runs it outside the lock), if any."""
         with self._lock:
             group = self._find(group_path)
             group.release()
-            if group.queue and group.can_run():
-                group.acquire()
-                return group.queue.popleft()
-        return None
+            return self._pop_runnable_locked(group)
+
+    def set_cluster_memory(self, total_bytes: int) \
+            -> List[Callable[[], None]]:
+        """Publish the cluster's observed memory usage to every group
+        and return any queued queries that became admittable (memory
+        dropped below a soft limit). The caller runs them outside the
+        lock. Group-level attribution collapses to the cluster total —
+        one engine session per coordinator means every group observes
+        the same pressure (the reference attributes per-group via
+        per-query contexts; the ledger tags exist for that refinement)."""
+        runnable: List[Callable[[], None]] = []
+        with self._lock:
+            groups = self._groups()
+            for g in groups:
+                g.memory_usage_bytes = total_bytes
+            for g in groups:
+                while True:
+                    run = self._pop_runnable_locked(g)
+                    if run is None:
+                        break
+                    runnable.append(run)
+        return runnable
 
     def info(self) -> List[dict]:
-        out = []
-
-        def walk(g: ResourceGroup):
-            out.append({"group": g.path, "running": g.running,
-                        "queued": len(g.queue),
-                        "hardConcurrencyLimit":
-                            g.config.hard_concurrency_limit,
-                        "totalAdmitted": g.stats_total_admitted})
-            for sub in g.sub_groups.values():
-                walk(sub)
-        walk(self.root)
+        with self._lock:
+            groups = self._groups()
+            out = []
+            for g in groups:
+                waited = g.stats_dequeued
+                out.append({
+                    "group": g.path, "running": g.running,
+                    "queued": len(g.queue),
+                    "hardConcurrencyLimit":
+                        g.config.hard_concurrency_limit,
+                    "totalAdmitted": g.stats_total_admitted,
+                    "softMemoryLimitBytes":
+                        g.config.soft_memory_limit_bytes,
+                    "memoryUsageBytes": g.memory_usage_bytes,
+                    "totalQueueWaitSeconds":
+                        round(g.stats_total_queue_wait_s, 6),
+                    "avgQueueWaitSeconds":
+                        round(g.stats_total_queue_wait_s / waited, 6)
+                        if waited else 0.0,
+                    "peakQueued": g.stats_peak_queued})
         return out

@@ -585,6 +585,9 @@ class StageScheduler:
             ex = self.session.executor
             batch = ex.run(plan)
         arrays, valids = batch_to_numpy(batch)
+        # build output now lives on host inside the ValuesNode: drop the
+        # device-side reservations the stage's plan-node runs took
+        self.session.executor.release_all_reservations()
         return L.ValuesNode(arrays=tuple(arrays), valids=tuple(valids),
                             num_rows=len(arrays[0]) if arrays else 0,
                             fields=(), output=plan.output)
@@ -660,6 +663,14 @@ class StageScheduler:
         # (a memory-connector table can change between attempts)
         use_spool = analysis.driver.catalog in ("tpch", "tpcds")
         splits = self._make_splits(analysis)
+        # memory-aware placement: order workers by heartbeat-reported
+        # reserved bytes so the round-robin lands extra splits on the
+        # least-pressured nodes first (UniformNodeSelector weighted by
+        # the ClusterMemoryManager's per-node view)
+        workers = sorted(
+            workers,
+            key=lambda w: (getattr(w, "memory", None) or {}).get(
+                "reserved", 0))
         # uniform assignment (UniformNodeSelector's round-robin core)
         assignment: Dict[str, List[Split]] = {w.node_id: [] for w in workers}
         by_id = {w.node_id: w for w in workers}
@@ -875,6 +886,10 @@ class StageScheduler:
         batch = self._merge_pages(root, analysis, pages)
         names, arrays, valids = ex.result_to_host(root, batch)
         rows = self.session.decode_rows(rel, arrays, valids)
+        # the merge ran plan nodes outside execute(): release their pool
+        # reservations now that the result is host rows — otherwise a
+        # stream of distributed queries leaks the pool dry
+        ex.release_all_reservations()
         return QueryResult(names, rows, 0.0, ex.stats)
 
     def _empty_like(self, agg: L.AggregateNode):

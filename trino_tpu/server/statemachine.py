@@ -28,6 +28,11 @@ class QueryStateMachine:
         self._lock = threading.Lock()
         self._listeners: List[Callable[[str], None]] = []
         self.error: Optional[str] = None
+        # error taxonomy (the reference's ErrorCode): user errors like
+        # QUERY_EXCEEDED_MEMORY carry their own name/code so clients can
+        # distinguish them from GENERIC_INTERNAL_ERROR
+        self.error_name: str = "GENERIC_INTERNAL_ERROR"
+        self.error_code: int = 1
         self.created_at = time.time()
         self.ended_at: Optional[float] = None
 
@@ -54,11 +59,15 @@ class QueryStateMachine:
             fn(new_state)
         return True
 
-    def fail(self, message: str) -> bool:
+    def fail(self, message: str,
+             error_name: str = "GENERIC_INTERNAL_ERROR",
+             error_code: int = 1) -> bool:
         with self._lock:
             if self._state in TERMINAL:
                 return False
             self.error = message
+            self.error_name = error_name
+            self.error_code = error_code
             self._state = "FAILED"
             self.ended_at = time.time()
             to_fire = list(self._listeners)

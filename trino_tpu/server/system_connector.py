@@ -30,7 +30,8 @@ class SystemConnector:
 
     def table_names(self, schema: str):
         if schema == "runtime":
-            return ["queries", "nodes", "tasks", "operator_stats"]
+            return ["queries", "nodes", "tasks", "operator_stats",
+                    "resource_groups"]
         return []
 
     def get_table(self, schema: str, table: str) -> TableData:
@@ -44,6 +45,8 @@ class SystemConnector:
             return self._tasks_table()
         if table == "operator_stats":
             return self._operator_stats_table()
+        if table == "resource_groups":
+            return self._resource_groups_table()
         raise KeyError(f"system table {table!r} not found")
 
     def _scheduler(self):
@@ -100,6 +103,40 @@ class SystemConnector:
                    (Field("splits", BIGINT), Field("rows", BIGINT),
                     Field("bytes", BIGINT), Field("wall_ms", DOUBLE))),
             base.columns + [splits, rows, byts, wall])
+
+    def _resource_groups_table(self) -> TableData:
+        """Live admission state per group — concurrency, queue depth,
+        queue-wait totals, and the memory-aware admission fields
+        (system.runtime view of resourcegroups.ResourceGroupManager)."""
+        rgm = getattr(getattr(self.state, "dispatcher", None),
+                      "resource_groups", None) if self.state else None
+        recs = rgm.info() if rgm is not None else []
+        base = _strings_table(
+            "resource_groups",
+            [("group_name", [r["group"] for r in recs])])
+        running = np.array([r["running"] for r in recs], dtype=np.int64)
+        queued = np.array([r["queued"] for r in recs], dtype=np.int64)
+        limit = np.array([r["hardConcurrencyLimit"] for r in recs],
+                         dtype=np.int64)
+        admitted = np.array([r["totalAdmitted"] for r in recs],
+                            dtype=np.int64)
+        soft = np.array([r["softMemoryLimitBytes"] or 0 for r in recs],
+                        dtype=np.int64)
+        mem = np.array([r["memoryUsageBytes"] for r in recs],
+                       dtype=np.int64)
+        wait = np.array([r["totalQueueWaitSeconds"] for r in recs],
+                        dtype=np.float64)
+        return TableData(
+            "resource_groups",
+            Schema(base.schema.fields +
+                   (Field("running", BIGINT), Field("queued", BIGINT),
+                    Field("hard_concurrency_limit", BIGINT),
+                    Field("total_admitted", BIGINT),
+                    Field("soft_memory_limit_bytes", BIGINT),
+                    Field("memory_usage_bytes", BIGINT),
+                    Field("total_queue_wait_seconds", DOUBLE))),
+            base.columns + [running, queued, limit, admitted, soft, mem,
+                            wait])
 
     def _operator_stats_table(self) -> TableData:
         """Per-(query, operator) rollup from worker TaskStats — the

@@ -219,6 +219,17 @@ class WorkerTask:
     bytes_out: int = 0
     stats: Dict[str, object] = field(default_factory=dict)
     spans: List[dict] = field(default_factory=list)
+    # exchange backpressure: bytes currently staged across all buffers
+    # (un-acked pages) and how often the producer had to pause for a
+    # slow consumer (OutputBuffer's maxBufferedBytes + isFull blocking)
+    buffered_bytes: int = 0
+    backpressure_waits: int = 0
+
+    def __post_init__(self):
+        # producer/consumer rendezvous sharing the task lock: _emit
+        # waits on it when the buffer is full, the results route
+        # notifies as acks drain pages
+        self.cond = threading.Condition(self.lock)
 
     @property
     def pages(self) -> List[bytes]:       # legacy single-buffer view
@@ -236,12 +247,21 @@ class TaskManager:
     way)."""
 
     def __init__(self, catalog, injector=None, node_id: str = "worker"):
+        import os
         self.catalog = catalog
         self.node_id = node_id            # span service attribution
         self.tasks: Dict[str, WorkerTask] = {}
         self._lock = threading.Lock()
         self.injector = injector          # FailureInjector hook
         self.tasks_run = 0                # observability counter
+        # exchange backpressure: per-task output-buffer byte bound — a
+        # slow consumer pauses the producer instead of ballooning the
+        # worker's memory (PartitionedOutputBuffer's max-buffered-bytes)
+        self.max_buffer_bytes = int(os.environ.get(
+            "TRINO_TPU_TASK_BUFFER_BYTES", 64 << 20))
+        # hard cap on one producer pause so a dead consumer degrades to
+        # an unbounded buffer (memory risk) rather than a hung task
+        self.backpressure_timeout_s = 300.0
         # one Executor per worker: kernels are jitted process-wide anyway;
         # the lock serializes device use within this worker
         from ..exec.executor import Executor
@@ -275,10 +295,49 @@ class TaskManager:
     def cancel(self, task_id: str) -> None:
         task = self.tasks.get(task_id)
         if task is not None:
-            with task.lock:
+            with task.cond:
                 if task.state in ("PENDING", "RUNNING"):
                     task.state = "CANCELED"
+                # wake a producer paused on a full output buffer
+                task.cond.notify_all()
 
+    def memory_info(self) -> dict:
+        """Pool snapshot + staged output bytes, reported on /v1/status so
+        heartbeats carry this worker's memory to the coordinator's
+        ClusterMemoryManager."""
+        snap = self._executor.pool.snapshot()
+        with self._lock:
+            snap["outputBufferBytes"] = sum(
+                t.buffered_bytes for t in self.tasks.values())
+        return snap
+
+    def _stage_page(self, task: WorkerTask, buffer: int, page: bytes,
+                    rows: int) -> None:
+        """Append one page under backpressure: while the task's staged
+        bytes exceed the bound, the producer waits for consumer acks —
+        a slow consumer can no longer balloon this worker's memory. A
+        single page larger than the bound always proceeds (progress
+        guarantee), as does a task leaving RUNNING."""
+        import time as _time
+        deadline = _time.monotonic() + self.backpressure_timeout_s
+        with task.cond:
+            waited = False
+            while task.buffered_bytes + len(page) > self.max_buffer_bytes \
+                    and task.buffered_bytes > 0 \
+                    and task.state == "RUNNING" \
+                    and _time.monotonic() < deadline:
+                if not waited:
+                    waited = True
+                    task.backpressure_waits += 1
+                    from ..metrics import BACKPRESSURE_WAITS
+                    BACKPRESSURE_WAITS.inc()
+                task.cond.wait(0.05)
+            task.buffers.setdefault(buffer, []).append(page)
+            task.buffered_bytes += len(page)
+            task.rows_out += rows
+            task.bytes_out += len(page)
+        TASK_OUTPUT_ROWS.inc(rows)
+        TASK_OUTPUT_BYTES.inc(len(page))
 
     def _emit(self, task: WorkerTask, arrs, vals) -> None:
         """Stage one result batch into the task's output buffers,
@@ -287,13 +346,7 @@ class TaskManager:
         sync) into the task's TaskStats and the process metrics."""
         rows = len(arrs[0]) if arrs else 0
         if task.partition is None:
-            page = encode_columns(arrs, vals)
-            with task.lock:
-                task.pages.append(page)
-                task.rows_out += rows
-                task.bytes_out += len(page)
-            TASK_OUTPUT_ROWS.inc(rows)
-            TASK_OUTPUT_BYTES.inc(len(page))
+            self._stage_page(task, 0, encode_columns(arrs, vals), rows)
             return
         keys, count = task.partition["keys"], task.partition["count"]
         part = partition_assignment(arrs, vals, keys, count)
@@ -303,12 +356,7 @@ class TaskManager:
                 continue
             page = encode_columns([a[m] for a in arrs],
                                   [v[m] for v in vals])
-            with task.lock:
-                task.buffers.setdefault(p, []).append(page)
-                task.rows_out += int(m.sum())
-                task.bytes_out += len(page)
-            TASK_OUTPUT_ROWS.inc(int(m.sum()))
-            TASK_OUTPUT_BYTES.inc(len(page))
+            self._stage_page(task, p, page, int(m.sum()))
 
     def _tracer_for(self, task: WorkerTask) -> Tracer:
         """Worker-side tracer adopting the coordinator's trace context —

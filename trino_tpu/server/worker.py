@@ -109,7 +109,12 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             return
         self._send(200, {"nodeId": self.worker.node_id,
                          "state": self.worker.state,
-                         "uptime": time.time() - self.worker.started_at})
+                         "uptime": time.time() - self.worker.started_at,
+                         # heartbeat memory report: the failure
+                         # detector's pings carry this to the
+                         # coordinator's ClusterMemoryManager
+                         "memory":
+                             self.worker.task_manager.memory_info()})
 
     def _get_info(self, parts, user):
         self._send(200, {"nodeVersion": {"version": "trino-tpu-0.1"},
@@ -151,17 +156,23 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         # writes must happen after release
         frame = None
         envelope = None
-        with task.lock:
+        with task.cond:
             pages = task.buffers.setdefault(buffer, [])
             acked = task.acked.get(buffer, 0)
             # Advancing to `token` acknowledges every page below it
             # (TaskResource.java:372's implicit-ack contract) — drop
             # drained pages so a long-lived worker's memory stays flat;
             # same-token retries after a fetch failure still succeed.
+            drained = 0
             while acked < token and pages:
-                pages.pop(0)
+                drained += len(pages.pop(0))
                 acked += 1
             task.acked[buffer] = acked
+            if drained:
+                # acks free staged bytes: wake a producer paused on a
+                # full output buffer (exchange backpressure)
+                task.buffered_bytes = max(0, task.buffered_bytes - drained)
+                task.cond.notify_all()
             idx = token - acked
             total = acked + len(pages)
             if 0 <= idx < len(pages):
