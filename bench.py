@@ -400,8 +400,11 @@ def gather_micro(table_sizes=None, probe_rows=None, n_tables=3, runs=3,
 
         take = jax.jit(lambda ts, ix: [jnp.take(t, ix, axis=0)
                                        for t in ts])
-        kernel = jax.jit(functools.partial(pg.gather_columns,
-                                           mode=mode))
+        # the pre-jitted kernel entry points route their XLA compiles
+        # through the central recorder (exec/profiler.py), so the
+        # microbench's compile costs land in /v1/jit like every other
+        # jit site's
+        kernel = functools.partial(pg.gather_columns_jit, mode=mode)
         t_take = timed(lambda: take(tables, idx))
         t_kernel = timed(lambda: kernel(tables, idx))
         elems = probe_rows * n_tables
@@ -416,8 +419,8 @@ def gather_micro(table_sizes=None, probe_rows=None, n_tables=3, runs=3,
         # shape): per-probe cost independent of table size
         idx_s = jnp.sort(idx)
         planes = pg.prepare_word_planes(tables[0])
-        win = jax.jit(functools.partial(pg.gather_word_windowed,
-                                        word_dtype="int64", mode=mode))
+        win = functools.partial(pg.gather_word_windowed_jit,
+                                word_dtype="int64", mode=mode)
         t_win = timed(lambda: win(planes, idx_s))
         records.append({
             "table_rows": w, "probe_rows": probe_rows, "n_tables": 1,
@@ -742,6 +745,87 @@ def memory_pressure_soak(n_queries=None, out_path="BENCH_memory.json"):
 
 
 # ---------------------------------------------------------------------------
+# --check-regressions: history-based latency gate over BENCH_r*.json
+# ---------------------------------------------------------------------------
+
+def load_bench_round(path):
+    """Extract per-config steady-state walls from one BENCH round file.
+
+    Accepts the driver format ({"n","cmd","rc","tail"} where `tail`
+    carries the emitted JSON lines — the LAST parseable line wins, the
+    same cumulative-emit contract bench uses) or a raw emitted record.
+    Returns {config: tpu_steady_ms} or None when the round produced no
+    usable record (e.g. an rc=124 driver kill before the first emit)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if isinstance(doc, dict) and "tail" in doc:
+        recs = []
+        for line in doc["tail"].splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                continue              # torn tail line
+        doc = recs[-1] if recs else None
+    if not isinstance(doc, dict):
+        return None
+    detail = doc.get("detail", doc)
+    out = {}
+    for cfg, d in detail.items():
+        if isinstance(d, dict) and "tpu_steady_ms" in d:
+            out[cfg] = float(d["tpu_steady_ms"])
+    return out or None
+
+
+def check_regressions(paths=None, ratio=None, mad_k=None,
+                      min_prior=2):
+    """Diff the newest BENCH_r*.json round against the prior rounds'
+    per-config baselines with the SAME median+MAD rule the query-history
+    detector applies (server/history.py): a config regresses when its
+    steady wall exceeds median * ratio AND the robust MAD envelope.
+    Returns (ok, report); configs with fewer than `min_prior` baseline
+    rounds are reported but never judged."""
+    import glob as _glob
+
+    from trino_tpu.server.history import (MAD_K, RATIO, is_regressed,
+                                          robust_baseline)
+    ratio = RATIO if ratio is None else ratio
+    mad_k = MAD_K if mad_k is None else mad_k
+    if paths is None:
+        paths = sorted(_glob.glob("BENCH_r*.json"))
+    rounds = [(p, load_bench_round(p)) for p in paths]
+    rounds = [(p, r) for p, r in rounds if r]
+    report = {"metric": "bench_regression_check", "rounds": len(rounds),
+              "configs": {}, "regressions": []}
+    if len(rounds) < 2:
+        report["note"] = "need at least 2 parseable rounds to compare"
+        return True, report
+    latest_path, latest = rounds[-1]
+    report["latest"] = latest_path
+    for cfg, cur in sorted(latest.items()):
+        prior = [r[cfg] for _, r in rounds[:-1] if cfg in r]
+        entry = {"steady_ms": cur, "baseline_rounds": len(prior)}
+        if len(prior) < min_prior:
+            entry["status"] = "insufficient-baseline"
+        else:
+            med, mad = robust_baseline(prior)
+            entry["baseline_median_ms"] = round(med, 1)
+            entry["baseline_mad_ms"] = round(mad, 1)
+            if is_regressed(cur, med, mad, ratio=ratio, mad_k=mad_k):
+                entry["status"] = "REGRESSED"
+                report["regressions"].append(cfg)
+            else:
+                entry["status"] = "ok"
+        report["configs"][cfg] = entry
+    return not report["regressions"], report
+
+
+# ---------------------------------------------------------------------------
 
 def run_config(session, sql, runs=RUNS, prewarm=PREWARM):
     """End-to-end timings: cold (first exec: compiles + ingest), then
@@ -817,16 +901,60 @@ def cached_baseline(key: str, fn):
     return result, cpu_ms, False
 
 
-def main():
-    if "--chaos" in sys.argv:
+def build_parser():
+    """Flag-style subcommands (each former ad-hoc `"--x" in sys.argv`
+    check is now a declared argparse flag, so `--help` documents the
+    full surface and typos fail loudly instead of silently running the
+    default bench). Exactly one mode runs per invocation; with no mode
+    flag the TPC-H e2e bench runs as before."""
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="bench.py",
+        description="trino-tpu driver benchmark and operational soaks "
+                    "(one JSON line per result)")
+    mode = p.add_argument_group("modes (default: TPC-H e2e bench)")
+    mode.add_argument("--chaos", action="store_true",
+                      help="seeded fault-injection soak -> "
+                           "BENCH_chaos.json")
+    mode.add_argument("--memory-pressure", action="store_true",
+                      help="concurrent soak at 25%% pool -> "
+                           "BENCH_memory.json")
+    mode.add_argument("--gather-micro", action="store_true",
+                      help="Pallas tiled-gather microbench -> "
+                           "BENCH_gather_micro.json")
+    mode.add_argument("--check-regressions", action="store_true",
+                      help="gate the newest BENCH_r*.json round against "
+                           "prior rounds (median+MAD); exit 1 on a "
+                           "regression")
+    gate = p.add_argument_group("--check-regressions options")
+    gate.add_argument("--rounds-glob", default="BENCH_r*.json",
+                      help="round files to diff (default: BENCH_r*.json)")
+    gate.add_argument("--ratio", type=float, default=None,
+                      help="regression ratio gate (default: history "
+                           "detector's 2.0)")
+    gate.add_argument("--mad-k", type=float, default=None,
+                      help="MAD envelope multiplier (default: 6.0)")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.chaos:
         chaos_soak()
-        return
-    if "--memory-pressure" in sys.argv:
+        return 0
+    if args.memory_pressure:
         memory_pressure_soak()
-        return
-    if "--gather-micro" in sys.argv:
+        return 0
+    if args.gather_micro:
         gather_micro()
-        return
+        return 0
+    if args.check_regressions:
+        import glob as _glob
+        ok, report = check_regressions(
+            sorted(_glob.glob(args.rounds_glob)),
+            ratio=args.ratio, mad_k=args.mad_k)
+        print(json.dumps(report), flush=True)
+        return 0 if ok else 1
     threading.Thread(target=_watchdog, daemon=True).start()
     import jax
     from trino_tpu.exec.session import Session
@@ -929,7 +1057,8 @@ def main():
         del session10, tables10
 
     emit(final=True)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)

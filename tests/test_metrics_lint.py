@@ -85,6 +85,16 @@ REQUIRED_FAMILIES = (
     "trino_tpu_exchange_backpressure_waits_total",
     "trino_tpu_pageserde_crc_failures_total",
     "trino_tpu_sched_task_retries_total",
+    # round-10 performance-introspection surface: JIT-compile
+    # observability, fenced device-time attribution, query history +
+    # latency-regression detection
+    "trino_tpu_jit_compiles_total",
+    "trino_tpu_jit_cache_hits_total",
+    "trino_tpu_jit_compile_seconds",
+    "trino_tpu_operator_device_ms_total",
+    "trino_tpu_operator_compile_ms_total",
+    "trino_tpu_query_latency_regressions_total",
+    "trino_tpu_query_history_records_total",
 )
 
 

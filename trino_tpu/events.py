@@ -45,6 +45,8 @@ class QueryCompletedEvent:
     bytes_shuffled: int = 0
     faults_survived: int = 0      # task retries + checksum rejections
     hedges_fired: int = 0
+    spills: int = 0               # spill-tier activations (history +
+                                  # regression-detector input)
 
 
 class EventListener:
@@ -96,5 +98,6 @@ class EventListenerManager:
             tasks=len(st.get("tasks", ())),
             bytes_shuffled=int(st.get("bytes_shuffled", 0)),
             faults_survived=int(st.get("faults_survived", 0)),
-            hedges_fired=int(st.get("hedged_tasks", 0)))
+            hedges_fired=int(st.get("hedged_tasks", 0)),
+            spills=int(getattr(tq, "spills", 0)))
         self._dispatch("query_completed", ev)

@@ -43,9 +43,10 @@ import numpy as np
 from ..batch import (Batch, Column, batch_from_numpy, batch_to_numpy,
                      bucket_capacity, pad_capacity)
 from ..planner import logical as L
+from .profiler import instrument, recorded_jit
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
+@recorded_jit(static_argnums=(0, 1), site="exec.slice_widen")
 def _slice_widen(cap: int, wide_names: tuple, datas, valids,
                  start, end, num_rows):
     """Slice one chunk straight from device-resident narrowed columns
@@ -716,8 +717,14 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
                     {id(j): s for j, s in zip(spine, specs)}, adapt,
                     gather_mode=gmode)
                 if mine is not None:
-                    jitted = jax.jit(mine[0])
-                    executor.stats.jit_compiles += 1
+                    # routed through the compile recorder: the first
+                    # chunk call records the actual XLA compile (site
+                    # exec.fused_chunk, fingerprint = plan-structure
+                    # hash), bumping ExecStats.jit_compiles via the
+                    # thread binding — re-used traces count as hits
+                    jitted = instrument(jax.jit(mine[0]),
+                                        site="exec.fused_chunk",
+                                        fingerprint=skey or "adhoc")
                     if ckey is not None:
                         if len(executor._fused_cache) >= 8:
                             executor._fused_cache.pop(

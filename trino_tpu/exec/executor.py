@@ -271,6 +271,8 @@ class Executor:
 
     def execute(self, root: L.OutputNode) -> Batch:
         assert isinstance(root, L.OutputNode)
+        from .profiler import RECORDER
+        RECORDER.bind_stats(self.stats)
         self._kill_reason = None
         # release reservations surviving from the previous query (the root
         # batch lives until its results are drained)
@@ -297,6 +299,10 @@ class Executor:
     TRACE = bool(os.environ.get("TRINO_TPU_TRACE_NODES"))
 
     def run(self, node: L.PlanNode) -> Batch:
+        # bind this executor's stats to the dispatch thread so the
+        # compile recorder attributes fresh XLA compiles here
+        from .profiler import RECORDER
+        RECORDER.bind_stats(self.stats)
         sub = self._subst.get(id(node))
         if sub is not None:
             return sub
@@ -370,15 +376,36 @@ class Executor:
                   flush=True)
         elif self.profile:
             import time
+            from .profiler import RECORDER
+            c0 = RECORDER.thread_compile_seconds()
             t0 = time.monotonic()
             out = self.dispatch(node)
-            # blocking per node serializes XLA async dispatch, so profiled
+            t1 = time.monotonic()
+            # fencing per node serializes XLA async dispatch, so profiled
             # times cover the node's own device work (OperatorStats role,
-            # operator/OperatorStats.java:37)
+            # operator/OperatorStats.java:37). The fence splits wall into
+            # components: device = time blocked on the fence, compile =
+            # recorder-attributed compile seconds during the dispatch,
+            # host = the dispatch remainder; the three sum to wall
+            # exactly (the misattribution He et al. warn about — async
+            # device time landing on whichever later op blocks — cannot
+            # happen across a fence).
+            jax.block_until_ready(out)
+            t2 = time.monotonic()
+            compile_s = min(max(RECORDER.thread_compile_seconds() - c0,
+                                0.0), t1 - t0)
+            device_s = t2 - t1
+            host_s = (t1 - t0) - compile_s
             rows = int(jnp.sum(out.live))
-            self.node_stats[id(node)] = (time.monotonic() - t0, rows)
-            from ..metrics import OPERATOR_ROWS
-            OPERATOR_ROWS.inc(rows, operator=type(node).__name__)
+            op = type(node).__name__
+            self.node_stats[id(node)] = (t2 - t0, rows, device_s,
+                                         host_s, compile_s)
+            from ..metrics import (OPERATOR_COMPILE_MS,
+                                   OPERATOR_DEVICE_MS, OPERATOR_ROWS)
+            OPERATOR_ROWS.inc(rows, operator=op)
+            OPERATOR_DEVICE_MS.inc(device_s * 1000, operator=op)
+            if compile_s:
+                OPERATOR_COMPILE_MS.inc(compile_s * 1000, operator=op)
         else:
             # always-on operator metrics: host dispatch wall only (device
             # work stays async — a per-node sync here would serialize the
@@ -1424,8 +1451,10 @@ class Executor:
 import functools
 import jax
 
+from .profiler import recorded_jit
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
+
+@recorded_jit(static_argnums=(1, 2))
 def filter_project_fused(batch: Batch, exprs, predicate) -> Batch:
     """Project-then-filter in one jit (Filter over Project)."""
     projected = project(batch, exprs)
@@ -1477,7 +1506,7 @@ def compact_batch(batch: Batch, new_capacity: int) -> Batch:
     return _compact_gather(batch, new_capacity)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
+@recorded_jit(static_argnums=(2, 3))
 def _append_packed_key(batch: Batch, kmins, keys: tuple,
                        bits: tuple) -> Batch:
     """Append one int64 column packing the key columns by shared range
@@ -1510,7 +1539,7 @@ def _strip_packed_columns(out: Batch, node: L.JoinNode, n_probe: int,
     return Batch(tuple(cols), out.live)
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
+@recorded_jit(static_argnums=(1,))
 def _compact_sort(batch: Batch, new_capacity: int) -> Batch:
     operands = [(~batch.live).astype(jnp.int8)]
     for c in batch.columns:
@@ -1525,7 +1554,7 @@ def _compact_sort(batch: Batch, new_capacity: int) -> Batch:
     return Batch(tuple(cols), out[-1][:new_capacity])
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
+@recorded_jit(static_argnums=(1,))
 def _compact_gather(batch: Batch, new_capacity: int) -> Batch:
     idx = jnp.argsort(~batch.live, stable=True)[:new_capacity]
     cols = tuple(Column(jnp.take(c.data, idx, axis=0),
@@ -1534,7 +1563,7 @@ def _compact_gather(batch: Batch, new_capacity: int) -> Batch:
     return Batch(cols, jnp.take(batch.live, idx, axis=0))
 
 
-@jax.jit
+@recorded_jit()
 def concat_batches(a: Batch, b: Batch) -> Batch:
     """UNION ALL: columnwise concatenation on device (UnionNode lowering —
     Trino's union is a pass-through exchange, ours is one concat per

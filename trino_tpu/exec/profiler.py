@@ -1,0 +1,278 @@
+"""JIT-compile observability: the central compile-event recorder.
+
+Reference: the reference engine keeps ExpressionCompiler/PageProcessor
+codegen warm in long-lived caches and exposes their hit rates over JMX
+(sql/gen/ExpressionCompiler.java:38 with its CacheStatsMBean); operator
+wall times come from OperatorStats with explicit scheduled/blocked
+splits. The XLA analog of codegen is jit tracing + compilation, and
+under async dispatch its cost lands wherever the first blocking fetch
+happens — invisible to host wall clocks unless measured at the jit
+boundary itself.
+
+Here: every jit site routes through `recorded_jit`/`instrument`, which
+detect a fresh XLA compile by watching the jitted callable's cache size
+across the call. Each compile (and each cache hit) is recorded with its
+site, an argument-shape fingerprint (the jaxpr-identity proxy: same
+tree of shapes/dtypes + statics => same trace => same program), and the
+compile duration, into:
+
+- the process-global `RECORDER` ring (served raw at `GET /v1/jit` and
+  as `system.runtime.jit_cache`),
+- Prometheus families (trino_tpu_jit_compiles_total{site},
+  trino_tpu_jit_cache_hits_total{site}, trino_tpu_jit_compile_seconds),
+- the thread-bound ExecStats (`jit_compiles` — the executor binds its
+  stats object per dispatch thread, so per-executor counts attribute
+  compiles to the executor whose dispatch triggered them),
+- a per-thread compile-seconds accumulator the profiled dispatch path
+  reads to split operator wall into device/host/compile components.
+
+Design constraints: recording must never change execution (a wrapper
+failure falls through to the raw call), must cost ~a cache-size probe
+per call on the hot path, and must stay silent inside an outer trace
+(a jitted kernel calling another jitted kernel records nothing — the
+outer program owns the compile).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict, deque
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class CompileEvent:
+    site: str
+    fingerprint: str
+    duration_s: float       # trace+compile wall for misses, 0.0 for hits
+    hit: bool               # True = the program cache already had it
+    when: float             # time.time() at record
+
+
+def _arg_fingerprint(args, kwargs) -> str:
+    """Cheap jaxpr-identity proxy: the tree of array (shape, dtype)
+    leaves plus static leaves, hashed. Two calls with the same
+    fingerprint hit the same compiled program for a given jit site.
+    Built on Python's tuple hash (not a cryptographic digest) because
+    this runs on EVERY instrumented dispatch — the fingerprint is an
+    in-process cache key, not a cross-process identity."""
+    import jax
+    parts = []
+    for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            parts.append((shape, str(getattr(leaf, "dtype", "?"))))
+        else:
+            try:
+                parts.append(hash(leaf))
+            except TypeError:
+                parts.append(repr(leaf)[:48])
+    return f"{hash(tuple(parts)) & 0xFFFFFFFFFFFFFFFF:016x}"
+
+
+class CompileRecorder:
+    """Thread-safe compile-event ring + per-(site, fingerprint) cache
+    aggregates. One per process (module-level RECORDER): jitted programs
+    are process-global, so their compile ledger is too."""
+
+    MAX_EVENTS = 512
+    MAX_ENTRIES = 2048
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events: "deque[CompileEvent]" = deque(maxlen=self.MAX_EVENTS)
+        # (site, fingerprint) -> mutable aggregate dict
+        self._entries: "OrderedDict[tuple, dict]" = OrderedDict()
+        self.total_compiles = 0
+        self.total_hits = 0
+        self.total_compile_s = 0.0
+        self._tl = threading.local()
+
+    # -- per-thread attribution --------------------------------------------
+
+    def bind_stats(self, stats) -> None:
+        """Attribute compiles recorded on THIS thread to `stats`
+        (ExecStats.jit_compiles). The executor binds its stats object at
+        dispatch entry; worker task threads each bind their own."""
+        self._tl.stats = stats
+
+    def thread_compile_seconds(self) -> float:
+        """Cumulative compile seconds recorded on this thread — the
+        profiled dispatch path diffs this around a dispatch to isolate
+        the compile component of an operator's wall."""
+        return getattr(self._tl, "compile_s", 0.0)
+
+    @contextmanager
+    def site_context(self, prefix: str):
+        """Prefix every site recorded on this thread inside the block —
+        the spill tier wraps its partition-wise re-runs so their kernel
+        compiles attribute to the spill path, not the resident one."""
+        prev = getattr(self._tl, "site_prefix", None)
+        self._tl.site_prefix = prefix
+        try:
+            yield
+        finally:
+            self._tl.site_prefix = prev
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, site: str, fingerprint: str, duration_s: float,
+               hit: bool) -> None:
+        prefix = getattr(self._tl, "site_prefix", None)
+        if prefix:
+            site = f"{prefix}:{site}"
+        from ..metrics import (JIT_CACHE_HITS, JIT_COMPILES,
+                               JIT_COMPILE_SECONDS)
+        ev = CompileEvent(site, fingerprint, duration_s if not hit
+                          else 0.0, hit, time.time())
+        with self._lock:
+            self.events.append(ev)
+            key = (site, fingerprint)
+            e = self._entries.get(key)
+            if e is None:
+                if len(self._entries) >= self.MAX_ENTRIES:
+                    self._entries.popitem(last=False)
+                e = self._entries[key] = {
+                    "site": site, "fingerprint": fingerprint,
+                    "compiles": 0, "hits": 0, "compile_ms": 0.0,
+                    "last_compile_ms": 0.0, "last_used": 0.0}
+            e["last_used"] = ev.when
+            if hit:
+                e["hits"] += 1
+                self.total_hits += 1
+            else:
+                e["compiles"] += 1
+                e["compile_ms"] += duration_s * 1000
+                e["last_compile_ms"] = duration_s * 1000
+                self.total_compiles += 1
+                self.total_compile_s += duration_s
+        if hit:
+            JIT_CACHE_HITS.inc(site=site)
+        else:
+            JIT_COMPILES.inc(site=site)
+            JIT_COMPILE_SECONDS.observe(duration_s)
+            # per-thread attribution: the executor whose dispatch thread
+            # triggered the compile owns it
+            self._tl.compile_s = getattr(self._tl, "compile_s", 0.0) \
+                + duration_s
+            stats = getattr(self._tl, "stats", None)
+            if stats is not None:
+                stats.jit_compiles += 1
+
+    # -- read surface ------------------------------------------------------
+
+    def snapshot(self) -> List[dict]:
+        """Per-(site, fingerprint) aggregates, most-recently-used last —
+        the /v1/jit and system.runtime.jit_cache payload."""
+        with self._lock:
+            return [dict(e) for e in self._entries.values()]
+
+    def totals(self) -> dict:
+        with self._lock:
+            return {"compiles": self.total_compiles,
+                    "hits": self.total_hits,
+                    "compileSeconds": round(self.total_compile_s, 6),
+                    "entries": len(self._entries)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self.events.clear()
+            self._entries.clear()
+            self.total_compiles = 0
+            self.total_hits = 0
+            self.total_compile_s = 0.0
+
+
+RECORDER = CompileRecorder()
+
+
+def _trace_clean() -> bool:
+    try:
+        import jax.core
+        return jax.core.trace_state_clean()
+    except Exception:        # noqa: BLE001 — recording is best-effort
+        return True
+
+
+def instrument(jitted: Callable, site: str,
+               fingerprint: Optional[str] = None,
+               recorder: Optional[CompileRecorder] = None) -> Callable:
+    """Wrap an already-jitted callable with compile-event recording.
+    Detection is a cache-size probe around the call; a fixed
+    `fingerprint` (e.g. the fused pipeline's plan hash) skips the
+    arg-shape hash. Calls made inside an outer trace bypass recording
+    entirely (the outer program owns the compile), as does any probe
+    failure — the wrapper can never change execution."""
+    rec = recorder or RECORDER
+    probe = getattr(jitted, "_cache_size", None)
+
+    def wrapped(*args, **kwargs):
+        if probe is None or not _trace_clean():
+            return jitted(*args, **kwargs)
+        try:
+            before = probe()
+        except Exception:        # noqa: BLE001 — probe is best-effort
+            return jitted(*args, **kwargs)
+        t0 = time.monotonic()
+        out = jitted(*args, **kwargs)
+        dt = time.monotonic() - t0
+        try:
+            hit = probe() == before
+            fp = fingerprint if fingerprint is not None else \
+                _arg_fingerprint(args, kwargs)
+            rec.record(site, fp, dt, hit)
+        except Exception:        # noqa: BLE001 — never break the call
+            pass
+        return out
+
+    wrapped.__name__ = f"recorded[{site}]"
+    wrapped.__wrapped__ = jitted
+    return wrapped
+
+
+def recorded_jit(site: Optional[str] = None, static_argnums=None,
+                 static_argnames=None, **jit_kwargs) -> Callable:
+    """Decorator: jax.jit + compile recording in one step — the drop-in
+    replacement for `@functools.partial(jax.jit, static_argnums=...)`
+    at every module-level jit site."""
+    def deco(fn):
+        import jax
+        kw = dict(jit_kwargs)
+        if static_argnums is not None:
+            kw["static_argnums"] = static_argnums
+        if static_argnames is not None:
+            kw["static_argnames"] = static_argnames
+        s = site or f"{fn.__module__.rsplit('.', 1)[-1]}.{fn.__name__}"
+        return instrument(jax.jit(fn, **kw), s)
+    return deco
+
+
+def device_memory_stats() -> dict:
+    """Live device/HBM stats of this process's first accelerator, in the
+    /v1/status heartbeat shape. TPU/GPU backends report allocator stats;
+    CPU returns platform-only (the fields read 0)."""
+    try:
+        import jax
+        d = jax.local_devices()[0]
+        stats = None
+        if hasattr(d, "memory_stats"):
+            try:
+                stats = d.memory_stats()
+            except Exception:    # noqa: BLE001 — backend-dependent
+                stats = None
+        out = {"platform": d.platform, "deviceCount": jax.local_device_count()}
+        if stats:
+            out["bytesInUse"] = int(stats.get("bytes_in_use", 0))
+            out["bytesLimit"] = int(stats.get("bytes_limit", 0))
+            out["peakBytesInUse"] = int(stats.get("peak_bytes_in_use", 0))
+        else:
+            out["bytesInUse"] = 0
+            out["bytesLimit"] = 0
+            out["peakBytesInUse"] = 0
+        return out
+    except Exception:            # noqa: BLE001 — stats are best-effort
+        return {}

@@ -113,6 +113,12 @@ SESSION_PROPERTY_DEFAULTS = {
     # under a propagating tracer — coordinator + worker spans stitch into
     # one trace served at GET /v1/query/{id}/trace
     "enable_tracing": (False, _bool),
+    # device-time profiling (exec/profiler.py): fence every operator
+    # dispatch with block_until_ready, splitting per-operator wall into
+    # device/host/compile components in ExecStats / operator metrics /
+    # EXPLAIN ANALYZE. Costs a device sync per plan node — forced
+    # automatically during (distributed) EXPLAIN ANALYZE
+    "enable_profiling": (False, _bool),
 }
 
 
@@ -173,6 +179,9 @@ class Session:
         kb = self.properties["stream_build_min_kb"]
         ex.stream_build_bytes = (kb << 10) if kb else None
         ex.enable_pallas_gather = self.properties["enable_pallas_gather"]
+        ex.profile = self.properties["enable_profiling"]
+        if ex.profile:
+            ex.node_stats = {}       # per-query attribution
 
     def execute_query(self, stmt, t0) -> QueryResult:
         # spans mirror the reference's: planner / fragment-plan / execute
@@ -184,10 +193,20 @@ class Session:
         assert isinstance(root, OutputNode)
         with self.tracer.span("optimize"):
             root = prune_plan(root)
-        with self.tracer.span("execute"):
+        with self.tracer.span("execute") as sp:
             batch = self.executor.execute(root)
             names, arrays, valids = self.executor.result_to_host(root,
                                                                  batch)
+            if sp is not None and self.executor.profile:
+                ns = [v for v in self.executor.node_stats.values()
+                      if len(v) >= 5]
+                sp.attributes["profiled"] = True
+                sp.attributes["deviceMs"] = round(
+                    sum(v[2] for v in ns) * 1000, 3)
+                sp.attributes["hostMs"] = round(
+                    sum(v[3] for v in ns) * 1000, 3)
+                sp.attributes["compileMs"] = round(
+                    sum(v[4] for v in ns) * 1000, 3)
         with self.tracer.span("decode", rows=len(arrays[0])
                               if arrays else 0):
             rows = self.decode_rows(rel, arrays, valids)
@@ -230,6 +249,13 @@ class Session:
                 est = estimate(node)
                 if s is None:
                     return est
+                if len(s) >= 5:
+                    # fenced profiling splits the wall into components
+                    # (device + host + compile sum to wall exactly)
+                    return (f"[{s[0] * 1000:.2f}ms (device "
+                            f"{s[2] * 1000:.2f} + host {s[3] * 1000:.2f}"
+                            f" + compile {s[4] * 1000:.2f}), "
+                            f"{s[1]} rows] {est}")
                 return f"[{s[0] * 1000:.2f}ms, {s[1]} rows] {est}"
         text = explain_text(root, annotate=annotate)
         return QueryResult(["query plan"],

@@ -318,6 +318,22 @@ def _host_equi_join(parrs, pvalids, barrs, bvalids, pkeys, bkeys,
 # ExceededMemoryLimitError fallback)
 # --------------------------------------------------------------------------
 
+def _spill_site(fn):
+    """Attribute every XLA compile triggered by a spill-tier re-run to a
+    `spill:`-prefixed site in the central compile recorder — the
+    partition-wise shapes differ from the resident kernels', so their
+    compiles are a real (and otherwise invisible) cost of spilling."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        from .profiler import RECORDER
+        with RECORDER.site_context("spill"):
+            return fn(*args, **kwargs)
+    return wrapped
+
+
+@_spill_site
 def spill_join(executor, node: L.JoinNode) -> Optional[Batch]:
     """Radix-partitioned host join for a JoinNode whose working set blew
     the pool. None = shape unsupported (caller re-raises the original
@@ -366,6 +382,7 @@ def _empty_output(node: L.JoinNode) -> Batch:
                             valids=[np.zeros(0, np.bool_) for _ in arrs])
 
 
+@_spill_site
 def spill_aggregate(executor, node: L.AggregateNode) -> Optional[Batch]:
     """Spillable aggregation, two strategies (the hash-vs-sort group-by
     study's trade-off, arXiv:2411.13245):
@@ -437,6 +454,7 @@ def spill_aggregate(executor, node: L.AggregateNode) -> Optional[Batch]:
     return batch_from_numpy(arrs2, valids=vals2)
 
 
+@_spill_site
 def spill_sort(executor, node: L.SortNode) -> Batch:
     """Host-side ORDER BY fallback: when the device sort's batch cannot
     fit the pool, sort the live rows on host with the same key

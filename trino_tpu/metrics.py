@@ -330,6 +330,39 @@ BACKPRESSURE_WAITS = REGISTRY.counter(
     "trino_tpu_exchange_backpressure_waits_total",
     "Producer pauses because a task output buffer hit its byte bound")
 
+# JIT-compile observability (exec/profiler.py): every jit site routes
+# through the compile recorder, which mirrors into these families
+JIT_COMPILES = REGISTRY.counter(
+    "trino_tpu_jit_compiles_total",
+    "Fresh XLA compiles detected at instrumented jit sites", ("site",))
+JIT_CACHE_HITS = REGISTRY.counter(
+    "trino_tpu_jit_cache_hits_total",
+    "Instrumented jit-site calls served by an already-compiled program",
+    ("site",))
+JIT_COMPILE_SECONDS = REGISTRY.histogram(
+    "trino_tpu_jit_compile_seconds",
+    "Trace+compile wall per fresh XLA compile (seconds)")
+
+# device-time attribution (profiled dispatches: enable_profiling /
+# EXPLAIN ANALYZE fence each operator, splitting wall into components)
+OPERATOR_DEVICE_MS = REGISTRY.counter(
+    "trino_tpu_operator_device_ms_total",
+    "Fenced device-execution time per operator (ms; profiled runs only)",
+    ("operator",))
+OPERATOR_COMPILE_MS = REGISTRY.counter(
+    "trino_tpu_operator_compile_ms_total",
+    "Compile time attributed to each operator's dispatch (ms; profiled "
+    "runs only)", ("operator",))
+
+# query history + latency-regression detection (server/history.py)
+LATENCY_REGRESSIONS = REGISTRY.counter(
+    "trino_tpu_query_latency_regressions_total",
+    "Completed queries flagged as regressed vs their per-fingerprint "
+    "baseline (median + MAD)")
+HISTORY_RECORDS = REGISTRY.counter(
+    "trino_tpu_query_history_records_total",
+    "Completed-query records appended to the query history store")
+
 # the labeled families acceptance scrapes: seed the hot label values so
 # a cold server's /v1/metrics already carries them at 0
 for _op in ("scan", "output"):
@@ -337,3 +370,9 @@ for _op in ("scan", "output"):
 RETRY_ATTEMPTS.init_labels(component="announce")
 MEMORY_RESERVED.init_labels(pool="general")
 MEMORY_REVOCABLE.init_labels(pool="general")
+for _site in ("exec.fused_chunk", "exec.slice_widen"):
+    JIT_COMPILES.init_labels(site=_site)
+    JIT_CACHE_HITS.init_labels(site=_site)
+for _op in ("ScanNode", "JoinNode", "AggregateNode"):
+    OPERATOR_DEVICE_MS.init_labels(operator=_op)
+    OPERATOR_COMPILE_MS.init_labels(operator=_op)

@@ -35,6 +35,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..exec.profiler import recorded_jit
 from jax import lax
 
 from ..batch import Batch, Column
@@ -71,7 +73,7 @@ def _identity(func: str, dtype) -> object:
 # direct (dense small-domain) strategy — masked reductions
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+@recorded_jit(static_argnums=(1, 2, 3))
 def direct_group_aggregate(batch: Batch, key_indices: tuple,
                            domains: tuple, aggs: tuple) -> Batch:
     """Group by small-domain integer/dictionary keys.
@@ -154,7 +156,7 @@ def _segmented_scan(vals: jax.Array, boundary: jax.Array, op):
     return out
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+@recorded_jit(static_argnums=(1, 2, 3, 4))
 def sort_group_aggregate(batch: Batch, key_indices: tuple, aggs: tuple,
                          out_capacity: int,
                          gather_mode: str = "off") -> Batch:
@@ -407,7 +409,7 @@ def _measure_key_bits(batch: Batch, key_indices: tuple, fetch=None):
     return np.asarray(kmins, dtype=np.int64), tuple(bits)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
+@recorded_jit(static_argnums=(2, 3, 4, 5, 6, 7))
 def packed_sort_group_aggregate(batch: Batch, kmins, key_indices: tuple,
                                 key_bits: tuple, aggs: tuple,
                                 out_capacity: int,
@@ -456,7 +458,7 @@ def packed_sort_group_aggregate(batch: Batch, kmins, key_indices: tuple,
 # global (ungrouped) aggregation — Trino's AggregationOperator
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnums=(1,))
+@recorded_jit(static_argnums=(1,))
 def global_aggregate(batch: Batch, aggs: tuple) -> Batch:
     """No GROUP BY: one output row, always live (SQL: aggregates over an
     empty input produce one row of NULLs / zero counts). Pure masked

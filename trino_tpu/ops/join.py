@@ -39,6 +39,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ..exec.profiler import recorded_jit
+
 from ..batch import Batch, Column
 from . import pallas_gather
 
@@ -96,7 +98,7 @@ def _out_of_domain(key: jax.Array, ok: jax.Array, domain: int):
     return jnp.any(ok & ((key < 0) | (key >= domain)))
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+@recorded_jit(static_argnums=(2, 3, 4, 5, 6))
 def join_unique_build_dense(probe: Batch, build: Batch, probe_keys: tuple,
                             build_keys: tuple, kind: str, domain: int,
                             gather_mode: str = "off"):
@@ -187,7 +189,7 @@ def _gather_build_payload(probe: Batch, build: Batch, src_c, matched, pk,
     return Batch(columns=probe.columns + tuple(build_cols), live=live)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
+@recorded_jit(static_argnums=(1, 2))
 def dense_build_lut(build: Batch, build_keys: tuple, domain: int):
     """Build the dense key->row LUT ONCE for a pinned build side (chunked
     execution reuses it across every probe chunk instead of re-scattering
@@ -202,7 +204,7 @@ def dense_build_lut(build: Batch, build_keys: tuple, domain: int):
     return lut, dup, oob
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))
+@recorded_jit(static_argnums=(3, 4, 5, 6))
 def dense_join_with_lut(probe: Batch, build: Batch, lut: jax.Array,
                         probe_keys: tuple, build_keys: tuple,
                         kind: str, gather_mode: str = "off") -> Batch:
@@ -225,7 +227,7 @@ def dense_join_with_lut(probe: Batch, build: Batch, lut: jax.Array,
                                  build_keys, kind, gather_mode)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
+@recorded_jit(static_argnums=(2, 3))
 def build_lut_chunk(lut: jax.Array, chunk: Batch, key_idx: int,
                     domain: int, start) -> jax.Array:
     """Scatter one build chunk's GLOBAL row ids into a persistent dense
@@ -248,7 +250,7 @@ def build_lut_chunk(lut: jax.Array, chunk: Batch, key_idx: int,
             jnp.sum(ok & ~in_dom, dtype=jnp.int64))
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+@recorded_jit(static_argnums=(1, 2, 3, 4))
 def dense_build_packed_lut(build: Batch, build_keys: tuple, domain: int,
                            meta: tuple, word_dtype: str):
     """Value-packed dense LUT: the build row's PAYLOAD values pack into
@@ -366,7 +368,7 @@ def compact_live(batch: Batch, cap: int):
     return Batch(cols, ok), overflow
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
+@recorded_jit(static_argnums=(2, 3, 4, 5, 6, 7))
 def dense_join_packed(probe: Batch, lut: jax.Array, probe_keys: tuple,
                       meta: tuple, bkey: int, out_dtypes: tuple,
                       kind: str, gather_mode: str = "off") -> Batch:
@@ -403,7 +405,7 @@ def dense_join_packed(probe: Batch, lut: jax.Array, probe_keys: tuple,
     return Batch(columns=probe.columns + tuple(build_cols), live=live)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+@recorded_jit(static_argnums=(2, 3, 4))
 def dense_probe(probe: Batch, build: Batch, probe_keys: tuple,
                 build_keys: tuple, domain: int):
     """Phase 1 of the two-phase dense join: LUT build + probe lookup
@@ -422,7 +424,7 @@ def dense_probe(probe: Batch, build: Batch, probe_keys: tuple,
     return src, matched, dup, oob, jnp.sum(matched, dtype=jnp.int64)
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7))
+@recorded_jit(static_argnums=(4, 5, 6, 7))
 def dense_join_compacted(probe: Batch, src: jax.Array,
                          matched: jax.Array, build: Batch,
                          probe_keys: tuple, build_keys: tuple,
@@ -494,7 +496,7 @@ def _flood_first(vals: jax.Array, boundary: jax.Array) -> jax.Array:
     return out
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+@recorded_jit(static_argnums=(2, 3, 4))
 def join_unique_build_merge(probe: Batch, build: Batch,
                             probe_keys: tuple, build_keys: tuple,
                             kind: str):
@@ -583,7 +585,7 @@ def join_unique_build_merge(probe: Batch, build: Batch,
     return Batch(columns=tuple(cols), live=live), dup
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+@recorded_jit(static_argnums=(2, 3, 4))
 def join_unique_build(probe: Batch, build: Batch, probe_keys: tuple,
                       build_keys: tuple, kind: str):
     """Equi-join where the build side is unique on its key.
@@ -703,7 +705,7 @@ def _probe_runs(probe: Batch, build: Batch, probe_keys: tuple,
     return lo, counts, order, pk_ok, oob
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+@recorded_jit(static_argnums=(2, 3, 4, 5, 6))
 def join_expand(probe: Batch, build: Batch, probe_keys: tuple,
                 build_keys: tuple, kind: str, out_capacity: int,
                 domain=None):
@@ -744,7 +746,7 @@ def join_expand(probe: Batch, build: Batch, probe_keys: tuple,
     return Batch(columns=tuple(out_cols), live=out_live), total, oob
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+@recorded_jit(static_argnums=(2, 3, 4, 5, 6))
 def join_mark(probe: Batch, build: Batch, probe_keys: tuple,
               build_keys: tuple, residual, out_capacity: int,
               domain=None):

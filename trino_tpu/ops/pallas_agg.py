@@ -39,6 +39,8 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..exec.profiler import recorded_jit
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -91,7 +93,7 @@ def _kernel(n_groups: int, n_cols: int, n_aggs: int):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4))
+@recorded_jit(static_argnums=(3, 4))
 def _mxu_sums(gid: jax.Array, hi: jax.Array, lo: jax.Array,
               n_groups: int, interpret: bool) -> jax.Array:
     """gid [n] int32 (n_groups = miss), hi/lo [A, n] int32 ->
@@ -130,7 +132,7 @@ def _mxu_sums(gid: jax.Array, hi: jax.Array, lo: jax.Array,
     return tot
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+@recorded_jit(static_argnums=(1, 2, 3, 4))
 def direct_group_aggregate_mxu(batch: Batch, key_indices: tuple,
                                domains: tuple, aggs: tuple,
                                interpret: bool = False) -> Batch:

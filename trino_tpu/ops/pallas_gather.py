@@ -391,3 +391,21 @@ def gather_word_windowed(planes: jax.Array, idx, word_dtype: str,
     word = _join_planes([out[p] for p in range(P)],
                         word_dtype)[:n].astype(jnp.int64)
     return word, jnp.sum(esc.astype(jnp.int64))
+
+
+# --------------------------------------------------------------------------
+# pre-jitted, compile-recorded entry points. Inside an executor kernel the
+# ENCLOSING jit owns the compile (the recorder stays silent under an open
+# trace), so these exist for the eager boundary: the gather microbench and
+# any ad-hoc top-level kernel use route their XLA compiles through the
+# central recorder (exec/profiler.py) like every other jit site.
+# --------------------------------------------------------------------------
+
+from ..exec.profiler import instrument as _instrument  # noqa: E402
+
+gather_columns_jit = _instrument(
+    jax.jit(gather_columns, static_argnames=("fills", "mode")),
+    site="pallas_gather.gather_columns")
+gather_word_windowed_jit = _instrument(
+    jax.jit(gather_word_windowed, static_argnames=("word_dtype", "mode")),
+    site="pallas_gather.gather_word_windowed")

@@ -20,6 +20,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..exec.profiler import recorded_jit
+
 from .. import ir
 from ..batch import Batch, Column
 from ..types import TypeKind
@@ -593,7 +595,7 @@ def project(batch: Batch, exprs) -> Batch:
     return Batch(columns=tuple(cols), live=batch.live)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
+@recorded_jit(static_argnums=(1, 2))
 def filter_project(batch: Batch, filter_expr, project_exprs) -> Batch:
     """Jitted fused filter+project — the PageProcessor equivalent
     (operator/project/PageProcessor.java:99). Expressions are static

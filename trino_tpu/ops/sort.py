@@ -14,6 +14,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..exec.profiler import recorded_jit
+
 from ..batch import Batch, Column
 
 
@@ -37,7 +39,7 @@ def _sort_key_encoding(col: Column, ascending: bool, nulls_first: bool):
     return null_rank.astype(jnp.int8), data
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
+@recorded_jit(static_argnums=(1, 2))
 def sort_batch(batch: Batch, keys: tuple, limit) -> Batch:
     """keys: tuple of (col_index, ascending, nulls_first). Dead rows sort
     last; an optional limit marks only the first `limit` rows live (TopN)."""
@@ -72,7 +74,7 @@ def sort_pack_plan(batch: Batch, keys: tuple, fetch=None):
                          fetch=fetch)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+@recorded_jit(static_argnums=(2, 3, 4))
 def sort_batch_packed(batch: Batch, kmins, keys: tuple, key_bits: tuple,
                       limit) -> Batch:
     """sort_batch via one packed int64 key (see sort_pack_plan): rank
@@ -109,7 +111,7 @@ def sort_batch_packed(batch: Batch, kmins, keys: tuple, key_bits: tuple,
     return Batch(columns=cols, live=live)
 
 
-@jax.jit
+@recorded_jit()
 def limit_batch(batch: Batch, count: jax.Array) -> Batch:
     """Keep the first `count` live rows (in current order)."""
     rank = jnp.cumsum(batch.live.astype(jnp.int64)) - 1

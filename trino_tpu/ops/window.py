@@ -29,6 +29,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from ..exec.profiler import recorded_jit
 from jax import lax
 
 from ..batch import Batch, Column
@@ -80,7 +82,7 @@ def _lower_bound(vals: jax.Array, lo0: jax.Array, hi0: jax.Array,
     return lo
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+@recorded_jit(static_argnums=(1, 2, 3))
 def window_compute(batch: Batch, partition_keys: tuple, order_keys: tuple,
                    specs: tuple) -> Batch:
     """Append one column per spec, in the batch's ORIGINAL row order.

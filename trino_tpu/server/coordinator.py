@@ -48,6 +48,7 @@ ROUTES = (
     ("GET", ("v1", "info"), "_get_info", False),
     ("GET", ("v1", "status"), "_get_status", False),
     ("GET", ("v1", "metrics"), "_get_metrics", False),
+    ("GET", ("v1", "jit"), "_get_jit", False),
     ("GET", ("v1", "spooled", "segments", STAR), "_get_segment", True),
     ("GET", ("v1", "resourceGroup"), "_get_resource_group", True),
     ("GET", ("v1", "memory"), "_get_memory", True),
@@ -99,6 +100,9 @@ class RegisteredNode:
         # last heartbeat-reported memory pool snapshot (cluster
         # arbitration input; scheduler placement prefers low-memory nodes)
         self.memory: Optional[dict] = None
+        # last heartbeat-reported device/HBM allocator stats
+        # (system.runtime.nodes surface)
+        self.device: Optional[dict] = None
 
 
 class Dispatcher:
@@ -280,8 +284,20 @@ class Dispatcher:
             if pool is not None:
                 pool.set_current_tag("")
 
+    def _spill_counter(self) -> int:
+        """Cumulative spill-tier activations of the session executor —
+        diffed around an attempt so the completion event (and history
+        store) carry a per-query spill count."""
+        st = getattr(getattr(self.session, "executor", None), "stats",
+                     None)
+        if st is None:
+            return 0
+        return (st.spilled_joins + st.spilled_aggregations +
+                st.spilled_sorts)
+
     def _execute_attempt_inner(self, tq: TrackedQuery, t0: float) -> None:
         result = None
+        spills0 = self._spill_counter()
         if self.scheduler is not None:
             # cluster path: fragment + dispatch to workers; None = not
             # eligible / no workers (coordinator executes locally)
@@ -315,6 +331,7 @@ class Dispatcher:
         tq.elapsed_s = time.monotonic() - t0
         tq.result = result
         tq.rows_returned = len(result.rows)
+        tq.spills = max(0, self._spill_counter() - spills0)
 
 
 class CoordinatorState:
@@ -338,8 +355,16 @@ class CoordinatorState:
         # tick() on demand) to enforce a cluster limit
         from .memorymanager import ClusterMemoryManager
         self.memory_manager = ClusterMemoryManager(self)
-        # system.runtime.{queries,nodes,tasks,operator_stats} backed by
-        # this coordinator's state
+        # query history + regression detection (server/history.py): fed
+        # from QueryCompletedEvent, flushed-to on tracker eviction, and
+        # served as system.runtime.query_history
+        from .history import HistoryEventListener, QueryHistoryStore
+        self.history = QueryHistoryStore()
+        self.dispatcher.event_listeners.register(
+            HistoryEventListener(self.history))
+        self.tracker.on_evict = self.history.record_tracked
+        # system.runtime.{queries,nodes,tasks,operator_stats,jit_cache,
+        # query_history} backed by this coordinator's state
         from .system_connector import SystemConnector
         session.catalog.register("system", SystemConnector(self))
 
@@ -537,11 +562,22 @@ class _Handler(BaseHTTPRequestHandler):
     def _get_status(self, parts, user):
         # liveness for load balancers / the failure detector: open
         # even on a secured cluster (no query data exposed)
-        self._send(200, {"nodeId": "coordinator", "state": "ACTIVE"})
+        from ..exec.profiler import device_memory_stats
+        self._send(200, {"nodeId": "coordinator", "state": "ACTIVE",
+                         "device": device_memory_stats()})
 
     def _get_metrics(self, parts, user):
         from ..metrics import REGISTRY
         self._send_text(200, REGISTRY.render())
+
+    def _get_jit(self, parts, user):
+        # JIT-compile observability (exec/profiler.py): per-(site,
+        # fingerprint) compile/hit aggregates plus process totals — the
+        # scrape twin of system.runtime.jit_cache (no query data, so it
+        # stays open like /v1/metrics)
+        from ..exec.profiler import RECORDER
+        self._send(200, {"totals": RECORDER.totals(),
+                         "entries": RECORDER.snapshot()})
 
     def _get_segment(self, parts, user):
         data = self.state.spooling.read(parts[3])

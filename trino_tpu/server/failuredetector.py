@@ -76,6 +76,7 @@ class HeartbeatFailureDetector:
             st = self.stats.setdefault(node.node_id, NodeStats())
             ok = False
             memory = None
+            device = None
             try:
                 if self.injector is not None:
                     # chaos: RAISE/DROP -> failed probe sample; DELAY ->
@@ -87,9 +88,11 @@ class HeartbeatFailureDetector:
                     ok = resp.status == 200
                     try:
                         # heartbeat payload carries the worker's memory
-                        # pool snapshot for cluster arbitration
-                        memory = json.loads(resp.read().decode()
-                                            ).get("memory")
+                        # pool snapshot for cluster arbitration plus its
+                        # live device/HBM allocator stats
+                        payload = json.loads(resp.read().decode())
+                        memory = payload.get("memory")
+                        device = payload.get("device")
                     except Exception:    # noqa: BLE001 — old workers
                         memory = None
             except Exception:
@@ -101,6 +104,8 @@ class HeartbeatFailureDetector:
                     continue
                 if ok and memory is not None:
                     live.memory = memory
+                if ok and device is not None:
+                    live.device = device
                 if st.failure_ratio > self.threshold:
                     live.state = "FAILED"
                 elif live.state == "FAILED":

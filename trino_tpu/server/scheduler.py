@@ -353,7 +353,10 @@ class StageScheduler:
                 self.operator_history.append(
                     {"query_id": lq.get("query_id") or "",
                      "operator": op, "rows": d["rows"],
-                     "wall_ms": d["wall_ms"], "calls": d["calls"]})
+                     "wall_ms": d["wall_ms"], "calls": d["calls"],
+                     "device_ms": d.get("device_ms", 0.0),
+                     "host_ms": d.get("host_ms", 0.0),
+                     "compile_ms": d.get("compile_ms", 0.0)})
 
     def _record_task(self, task: "RemoteTask") -> None:
         """Fetch a finished task's terminal status — TaskStats + spans —
@@ -381,10 +384,15 @@ class StageScheduler:
                 lq["bytes_shuffled"] += task.bytes_drained
                 for op, d in (stats.get("operators") or {}).items():
                     acc = lq["operators"].setdefault(
-                        op, {"rows": 0, "wall_ms": 0.0, "calls": 0})
+                        op, {"rows": 0, "wall_ms": 0.0, "calls": 0,
+                             "device_ms": 0.0, "host_ms": 0.0,
+                             "compile_ms": 0.0})
                     acc["rows"] += int(d.get("rows", 0))
                     acc["wall_ms"] += float(d.get("wallMs", 0.0))
                     acc["calls"] += int(d.get("calls", 0))
+                    acc["device_ms"] += float(d.get("deviceMs", 0.0))
+                    acc["host_ms"] += float(d.get("hostMs", 0.0))
+                    acc["compile_ms"] += float(d.get("compileMs", 0.0))
         self._tracer().adopt(st.get("spans") or [])
 
     # -- eligibility + planning -------------------------------------------
@@ -553,7 +561,10 @@ class StageScheduler:
         for op in sorted(lq["operators"]):
             d = lq["operators"][op]
             lines.append(f"  operator {op}: rows={d['rows']}, "
-                         f"wall={d['wall_ms']:.1f}ms, "
+                         f"wall={d['wall_ms']:.1f}ms "
+                         f"(device {d.get('device_ms', 0.0):.1f} + "
+                         f"host {d.get('host_ms', 0.0):.1f} + "
+                         f"compile {d.get('compile_ms', 0.0):.1f}), "
                          f"calls={d['calls']}")
         return QueryResult(["query plan"],
                            [(line,) for line in lines],

@@ -9,6 +9,7 @@ state holder with listeners, plus a registry with expiry.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -118,6 +119,9 @@ class TrackedQuery:
     tracer: Optional[object] = None       # utils.tracing.Tracer
     trace: Optional[list] = None          # exported span dicts
     stage_stats: Optional[dict] = None
+    # spill-tier activations during this query (executor stats delta) —
+    # one of the regression detector's inputs (server/history.py)
+    spills: int = 0
 
     @property
     def state(self) -> str:
@@ -126,13 +130,23 @@ class TrackedQuery:
 
 class QueryTracker:
     """Registry of live + recently finished queries (QueryTracker.java:51;
-    expiry mirrors query.min-expire-age)."""
+    expiry mirrors query.min-expire-age). The cap is configurable via
+    TRINO_TPU_QUERY_HISTORY, and evicted queries flush through the
+    `on_evict` hook (the coordinator wires it to the persistent history
+    store) so completed-query stats outlive the in-memory ring."""
 
-    def __init__(self, max_history: int = 100):
+    def __init__(self, max_history: Optional[int] = None):
         self._queries: Dict[str, TrackedQuery] = {}
         self._lock = threading.Lock()
         self._seq = 0
+        if max_history is None:
+            try:
+                max_history = int(
+                    os.environ.get("TRINO_TPU_QUERY_HISTORY", 100))
+            except ValueError:
+                max_history = 100
         self.max_history = max_history
+        self.on_evict: Optional[Callable[[TrackedQuery], None]] = None
 
     def next_query_id(self) -> str:
         with self._lock:
@@ -143,7 +157,15 @@ class QueryTracker:
     def register(self, q: TrackedQuery) -> None:
         with self._lock:
             self._queries[q.query_id] = q
-            self._expire_locked()
+            evicted = self._expire_locked()
+        # the flush runs OUTSIDE the lock: the history store may hit disk,
+        # and a listener calling back into the tracker must not deadlock
+        if self.on_evict is not None:
+            for old in evicted:
+                try:
+                    self.on_evict(old)
+                except Exception:  # noqa: BLE001 — eviction never fails
+                    pass
 
     def get(self, query_id: str) -> Optional[TrackedQuery]:
         with self._lock:
@@ -153,11 +175,14 @@ class QueryTracker:
         with self._lock:
             return list(self._queries.values())
 
-    def _expire_locked(self) -> None:
+    def _expire_locked(self) -> List[TrackedQuery]:
         done = [q for q in self._queries.values()
                 if q.state_machine.is_done()]
         excess = len(done) - self.max_history
+        evicted: List[TrackedQuery] = []
         if excess > 0:
             done.sort(key=lambda q: q.state_machine.ended_at or 0)
             for q in done[:excess]:
                 del self._queries[q.query_id]
+                evicted.append(q)
+        return evicted

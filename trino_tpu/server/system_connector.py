@@ -31,7 +31,7 @@ class SystemConnector:
     def table_names(self, schema: str):
         if schema == "runtime":
             return ["queries", "nodes", "tasks", "operator_stats",
-                    "resource_groups"]
+                    "resource_groups", "jit_cache", "query_history"]
         return []
 
     def get_table(self, schema: str, table: str) -> TableData:
@@ -47,6 +47,10 @@ class SystemConnector:
             return self._operator_stats_table()
         if table == "resource_groups":
             return self._resource_groups_table()
+        if table == "jit_cache":
+            return self._jit_cache_table()
+        if table == "query_history":
+            return self._query_history_table()
         raise KeyError(f"system table {table!r} not found")
 
     def _scheduler(self):
@@ -74,12 +78,36 @@ class SystemConnector:
             base.columns + [elapsed, rows])
 
     def _nodes_table(self) -> TableData:
+        """Node inventory + each worker's last heartbeat-reported memory
+        pool and live device/HBM allocator stats (zeros until the first
+        heartbeat lands, and always zero off-TPU)."""
         nodes = list(self.state.nodes.values()) if self.state else []
-        return _strings_table(
+        base = _strings_table(
             "nodes",
             [("node_id", [n.node_id for n in nodes]),
              ("http_uri", [n.uri for n in nodes]),
              ("state", [n.state for n in nodes])])
+        mem = [getattr(n, "memory", None) or {} for n in nodes]
+        dev = [getattr(n, "device", None) or {} for n in nodes]
+        reserved = np.array([int(m.get("reserved", 0)) for m in mem],
+                            dtype=np.int64)
+        revocable = np.array([int(m.get("revocable", 0)) for m in mem],
+                             dtype=np.int64)
+        in_use = np.array([int(d.get("bytesInUse", 0)) for d in dev],
+                          dtype=np.int64)
+        limit = np.array([int(d.get("bytesLimit", 0)) for d in dev],
+                         dtype=np.int64)
+        peak = np.array([int(d.get("peakBytesInUse", 0)) for d in dev],
+                        dtype=np.int64)
+        return TableData(
+            "nodes",
+            Schema(base.schema.fields +
+                   (Field("reserved_bytes", BIGINT),
+                    Field("revocable_bytes", BIGINT),
+                    Field("device_bytes_in_use", BIGINT),
+                    Field("device_bytes_limit", BIGINT),
+                    Field("device_peak_bytes", BIGINT))),
+            base.columns + [reserved, revocable, in_use, limit, peak])
 
     def _tasks_table(self) -> TableData:
         """Recent remote tasks with their merged TaskStats (the
@@ -141,7 +169,9 @@ class SystemConnector:
     def _operator_stats_table(self) -> TableData:
         """Per-(query, operator) rollup from worker TaskStats — the
         operator half of the OperatorStats pyramid, queryable like the
-        reference's optimizer_rule_stats/operator views."""
+        reference's optimizer_rule_stats/operator views. Profiled runs
+        (EXPLAIN ANALYZE / enable_profiling) carry the fenced
+        device/host/compile wall split; unprofiled rows read 0."""
         sched = self._scheduler()
         recs = list(sched.operator_history) if sched is not None else []
         base = _strings_table(
@@ -151,9 +181,76 @@ class SystemConnector:
         rows = np.array([r["rows"] for r in recs], dtype=np.int64)
         wall = np.array([r["wall_ms"] for r in recs], dtype=np.float64)
         calls = np.array([r["calls"] for r in recs], dtype=np.int64)
+        device = np.array([r.get("device_ms", 0.0) for r in recs],
+                          dtype=np.float64)
+        host = np.array([r.get("host_ms", 0.0) for r in recs],
+                        dtype=np.float64)
+        compile_ = np.array([r.get("compile_ms", 0.0) for r in recs],
+                            dtype=np.float64)
         return TableData(
             "operator_stats",
             Schema(base.schema.fields +
                    (Field("rows", BIGINT), Field("wall_ms", DOUBLE),
-                    Field("calls", BIGINT))),
-            base.columns + [rows, wall, calls])
+                    Field("calls", BIGINT),
+                    Field("device_ms", DOUBLE),
+                    Field("host_ms", DOUBLE),
+                    Field("compile_ms", DOUBLE))),
+            base.columns + [rows, wall, calls, device, host, compile_])
+
+    def _jit_cache_table(self) -> TableData:
+        """The process compile recorder's per-(site, fingerprint)
+        aggregates (exec/profiler.py) — the SQL twin of GET /v1/jit."""
+        from ..exec.profiler import RECORDER
+        recs = RECORDER.snapshot()
+        base = _strings_table(
+            "jit_cache",
+            [("site", [r["site"] for r in recs]),
+             ("fingerprint", [r["fingerprint"] for r in recs])])
+        compiles = np.array([r["compiles"] for r in recs],
+                            dtype=np.int64)
+        hits = np.array([r["hits"] for r in recs], dtype=np.int64)
+        total_ms = np.array([r["compile_ms"] for r in recs],
+                            dtype=np.float64)
+        last_ms = np.array([r["last_compile_ms"] for r in recs],
+                           dtype=np.float64)
+        return TableData(
+            "jit_cache",
+            Schema(base.schema.fields +
+                   (Field("compiles", BIGINT),
+                    Field("cache_hits", BIGINT),
+                    Field("compile_ms", DOUBLE),
+                    Field("last_compile_ms", DOUBLE))),
+            base.columns + [compiles, hits, total_ms, last_ms])
+
+    def _query_history_table(self) -> TableData:
+        """The coordinator's persistent completed-query ring
+        (server/history.py) — latency/bytes/spill records per plan
+        fingerprint with the detector's regression verdicts."""
+        store = getattr(self.state, "history", None) if self.state \
+            else None
+        recs = store.snapshot() if store is not None else []
+        base = _strings_table(
+            "query_history",
+            [("query_id", [r.get("query_id", "") for r in recs]),
+             ("fingerprint", [r.get("fingerprint", "") for r in recs]),
+             ("state", [r.get("state", "") for r in recs]),
+             ("user", [r.get("user", "") for r in recs])])
+        elapsed = np.array([float(r.get("elapsed_s", 0) or 0)
+                            for r in recs], dtype=np.float64)
+        rows = np.array([int(r.get("rows", 0) or 0) for r in recs],
+                        dtype=np.int64)
+        shuffled = np.array([int(r.get("bytes_shuffled", 0) or 0)
+                             for r in recs], dtype=np.int64)
+        spills = np.array([int(r.get("spills", 0) or 0) for r in recs],
+                          dtype=np.int64)
+        regressed = np.array([int(bool(r.get("regressed")))
+                              for r in recs], dtype=np.int64)
+        return TableData(
+            "query_history",
+            Schema(base.schema.fields +
+                   (Field("elapsed_seconds", DOUBLE),
+                    Field("rows", BIGINT),
+                    Field("bytes_shuffled", BIGINT),
+                    Field("spills", BIGINT),
+                    Field("regressed", BIGINT))),
+            base.columns + [elapsed, rows, shuffled, spills, regressed])

@@ -371,12 +371,20 @@ class TaskManager:
     def _fold_node_stats(ex, names: Dict[int, str],
                          op_agg: Dict[str, list]) -> None:
         """Aggregate one profiled run's per-node stats into per-operator
-        totals [wall_ms, rows, calls] and reset for the next split."""
-        for nid, (wall_s, rows) in ex.node_stats.items():
-            acc = op_agg.setdefault(names.get(nid, "?"), [0.0, 0, 0])
-            acc[0] += wall_s * 1000
-            acc[1] += rows
+        totals [wall_ms, rows, calls, device_ms, host_ms, compile_ms]
+        and reset for the next split. Fenced runs (exec/profiler.py)
+        carry the device/host/compile split; the components sum to
+        wall, so the rollup preserves that invariant per operator."""
+        for nid, st in ex.node_stats.items():
+            acc = op_agg.setdefault(names.get(nid, "?"),
+                                    [0.0, 0, 0, 0.0, 0.0, 0.0])
+            acc[0] += st[0] * 1000
+            acc[1] += st[1]
             acc[2] += 1
+            if len(st) >= 5:
+                acc[3] += st[2] * 1000
+                acc[4] += st[3] * 1000
+                acc[5] += st[4] * 1000
         ex.node_stats = {}
 
     def _finalize_stats(self, task: WorkerTask, tracer: Tracer,
@@ -387,7 +395,10 @@ class TaskManager:
         On success paths this runs BEFORE the FINISHED transition so a
         consumer that sees the terminal state always sees final stats."""
         ops = {op: {"wallMs": round(v[0], 3), "rows": int(v[1]),
-                    "calls": int(v[2])} for op, v in op_agg.items()}
+                    "calls": int(v[2]), "deviceMs": round(v[3], 3),
+                    "hostMs": round(v[4], 3),
+                    "compileMs": round(v[5], 3)}
+               for op, v in op_agg.items()}
         with task.lock:
             task.stats = {"rowsOut": task.rows_out,
                           "bytesOut": task.bytes_out,
@@ -444,7 +455,7 @@ class TaskManager:
             with self._exec_lock, \
                     tracer.span("worker-task", taskId=task.task_id,
                                 node=self.node_id,
-                                splits=len(task.splits)):
+                                splits=len(task.splits)) as wspan:
                 ex = self._executor
                 ex._subst.clear()
                 ex._subst_opaque.clear()
@@ -515,6 +526,16 @@ class TaskManager:
                     for b in ex._node_bytes.values():
                         ex.pool.free(b)
                     ex._node_bytes.clear()
+                    if wspan is not None and op_agg:
+                        # fenced split totals ride the worker-task span
+                        # so the stitched trace carries device time, not
+                        # just host wall
+                        wspan.attributes["deviceMs"] = round(
+                            sum(v[3] for v in op_agg.values()), 3)
+                        wspan.attributes["hostMs"] = round(
+                            sum(v[4] for v in op_agg.values()), 3)
+                        wspan.attributes["compileMs"] = round(
+                            sum(v[5] for v in op_agg.values()), 3)
             self._finalize_stats(task, tracer, t_start, op_agg)
             with task.lock:
                 # a cancel landing during the last split must not be

@@ -107,6 +107,7 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         if self.worker.fail_status:          # fault injection hook
             self._send(500, {"error": "injected failure"})
             return
+        from ..exec.profiler import device_memory_stats
         self._send(200, {"nodeId": self.worker.node_id,
                          "state": self.worker.state,
                          "uptime": time.time() - self.worker.started_at,
@@ -114,7 +115,10 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                          # detector's pings carry this to the
                          # coordinator's ClusterMemoryManager
                          "memory":
-                             self.worker.task_manager.memory_info()})
+                             self.worker.task_manager.memory_info(),
+                         # live accelerator/HBM allocator stats (zeros
+                         # off-TPU) — surfaced in system.runtime.nodes
+                         "device": device_memory_stats()})
 
     def _get_info(self, parts, user):
         self._send(200, {"nodeVersion": {"version": "trino-tpu-0.1"},
