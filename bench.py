@@ -744,6 +744,182 @@ def memory_pressure_soak(n_queries=None, out_path="BENCH_memory.json"):
     return rec
 
 
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def concurrency_soak(n_clients=None, queries_per_client=None,
+                     out_path="BENCH_concurrency.json"):
+    """High-concurrency serving soak (round-11 acceptance): >= 100 mixed
+    clients against one coordinator with the serving layer fully on
+    (plan cache, result cache, CPU/TPU cost routing, micro-batching).
+    Point/cached/small-aggregate traffic runs host-side WITHOUT the
+    device exec lock while scan-heavy plans keep the device, so the mix
+    must not serialize. Requires 0 wrong answers vs the uncached oracle
+    (every HTTP result — cache hits, micro-batched rows, host-routed
+    rows — compared bit-exact against a direct pre-server execution),
+    nonzero result-cache/router/micro-batch counters, and a post-write
+    rerun proving catalog-version invalidation. Emits
+    BENCH_concurrency.json with throughput and p50/p99 per mix."""
+    import tempfile
+    import threading as _th
+
+    from trino_tpu.client.client import Client, QueryError
+    from trino_tpu.exec.session import Session
+    from trino_tpu.metrics import REGISTRY
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.resourcegroups import (ResourceGroupConfig,
+                                                 ResourceGroupManager)
+
+    n = n_clients if n_clients is not None else \
+        int(os.environ.get("TRINO_TPU_CONCURRENCY_CLIENTS", 120))
+    per = queries_per_client if queries_per_client is not None else \
+        int(os.environ.get("TRINO_TPU_CONCURRENCY_QUERIES", 5))
+    t_start = time.monotonic()
+    # fresh history file: stale medians from earlier rounds (cold
+    # compile walls) would bias the router's baseline input
+    hist = tempfile.NamedTemporaryFile(prefix="concurrency_hist_",
+                                       suffix=".jsonl", delete=False)
+    saved_hist_env = os.environ.get("TRINO_TPU_HISTORY_PATH")
+    os.environ["TRINO_TPU_HISTORY_PATH"] = hist.name
+
+    session = Session(default_schema="tiny")
+    session.execute("CREATE TABLE memory.s.counters (k bigint, v bigint)")
+    session.execute("INSERT INTO memory.s.counters VALUES (1, 10), (2, 20)")
+
+    mixes = {
+        "point": [f"SELECT n_name FROM nation WHERE n_nationkey = {k}"
+                  for k in range(25)],
+        "cached": ["SELECT r_name FROM region ORDER BY r_name",
+                   "SELECT count(*) FROM supplier",
+                   "SELECT v FROM memory.s.counters WHERE k = 2"],
+        "small_agg": ["SELECT min(s_suppkey), max(s_suppkey) "
+                      "FROM supplier",
+                      "SELECT count(*) FROM customer"],
+        "scan_heavy": [
+            "SELECT l_returnflag, l_linestatus, sum(l_quantity) AS q, "
+            "count(*) AS c FROM lineitem "
+            "GROUP BY l_returnflag, l_linestatus "
+            "ORDER BY l_returnflag, l_linestatus",
+            "SELECT count(*) FROM orders JOIN customer "
+            "ON o_custkey = c_custkey WHERE c_acctbal > 0"],
+    }
+    # uncached oracle: every distinct statement executed directly (no
+    # serving layer) BEFORE the server starts — the soak's bit-exact
+    # reference for cached/host/micro-batched paths alike
+    oracle = {}
+    for qs in mixes.values():
+        for q in qs:
+            oracle[q] = _chaos_rows(session.execute(q).rows)
+
+    session.properties["enable_result_cache"] = True
+    session.properties["enable_microbatch"] = True
+    session.properties["microbatch_window_ms"] = 4.0
+    coord = CoordinatorServer(session, max_concurrency=32).start()
+    # the coordinator's history store is bound now: restore the env so
+    # later stores in this process keep their configured path
+    if saved_hist_env is None:
+        os.environ.pop("TRINO_TPU_HISTORY_PATH", None)
+    else:
+        os.environ["TRINO_TPU_HISTORY_PATH"] = saved_hist_env
+    coord.state.dispatcher.resource_groups = ResourceGroupManager(
+        ResourceGroupConfig("root", hard_concurrency_limit=32,
+                            max_queued=100_000))
+
+    reg0 = REGISTRY.snapshot()
+    mix_names = list(mixes)
+    lock = _th.Lock()
+    latencies = {m: [] for m in mix_names}
+    rec = {"metric": "concurrency_soak", "clients": n,
+           "queries_per_client": per, "queries": 0, "wrong_answers": 0,
+           "failed_queries": 0}
+
+    def one(i: int) -> None:
+        mix = mix_names[i % len(mix_names)]
+        qs = mixes[mix]
+        client = Client(coord.uri, user=f"conc{i}", timeout_s=180,
+                        poll_interval_s=0.005)
+        for j in range(per):
+            q = qs[(i + j) % len(qs)]
+            t0 = time.monotonic()
+            try:
+                rows = client.execute(q).rows
+            except Exception:  # noqa: BLE001 — QueryError/transport both
+                with lock:     # count as failures; the thread lives on
+                    rec["failed_queries"] += 1
+                continue
+            ms = (time.monotonic() - t0) * 1000
+            with lock:
+                rec["queries"] += 1
+                latencies[mix].append(ms)
+                if _chaos_rows(rows) != oracle[q]:
+                    rec["wrong_answers"] += 1
+
+    threads = [_th.Thread(target=one, args=(i,), daemon=True)
+               for i in range(n)]
+    t_soak = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    soak_s = time.monotonic() - t_soak
+
+    # post-write rerun: the cached counter read must reflect the write
+    # (catalog-version invalidation), not the cached page
+    client = Client(coord.uri, user="writer")
+    pre = client.execute("SELECT count(*) FROM memory.s.counters").rows
+    again = client.execute("SELECT count(*) FROM memory.s.counters").rows
+    client.execute("INSERT INTO memory.s.counters VALUES (3, 30)")
+    post = client.execute("SELECT count(*) FROM memory.s.counters").rows
+    rec["invalidation_proven"] = (pre == again ==
+                                  [[2]]) and post == [[3]]
+
+    after = REGISTRY.snapshot()
+
+    def delta(*key):
+        return int(after.get(tuple(key), 0) - reg0.get(tuple(key), 0))
+
+    rec["throughput_qps"] = round(rec["queries"] / max(soak_s, 1e-9), 1)
+    rec["soak_seconds"] = round(soak_s, 2)
+    rec["mixes"] = {}
+    for m in mix_names:
+        vals = sorted(latencies[m])
+        rec["mixes"][m] = {
+            "queries": len(vals),
+            "p50_ms": round(_percentile(vals, 0.50), 1),
+            "p99_ms": round(_percentile(vals, 0.99), 1)}
+    rec["plan_cache_hits"] = delta("trino_tpu_plan_cache_hits_total")
+    rec["plan_cache_misses"] = delta("trino_tpu_plan_cache_misses_total")
+    rec["result_cache_hits"] = delta("trino_tpu_result_cache_hits_total")
+    rec["result_cache_invalidations"] = delta(
+        "trino_tpu_result_cache_invalidations_total")
+    rec["router_host"] = delta("trino_tpu_router_decisions_total", "host")
+    rec["router_device"] = delta("trino_tpu_router_decisions_total",
+                                 "device")
+    rec["microbatch_queries"] = delta(
+        "trino_tpu_microbatch_queries_total")
+    rec["microbatch_batches"] = delta(
+        "trino_tpu_microbatch_batches_total")
+    rec["elapsed_s"] = round(time.monotonic() - t_start, 1)
+    rec["passed"] = (rec["wrong_answers"] == 0 and
+                     rec["failed_queries"] == 0 and
+                     rec["queries"] == n * per and
+                     rec["result_cache_hits"] > 0 and
+                     rec["plan_cache_hits"] > 0 and
+                     rec["router_host"] > 0 and
+                     rec["router_device"] > 0 and
+                     rec["invalidation_proven"])
+    coord.stop()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 # ---------------------------------------------------------------------------
 # --check-regressions: history-based latency gate over BENCH_r*.json
 # ---------------------------------------------------------------------------
@@ -926,6 +1102,16 @@ def build_parser():
                       help="gate the newest BENCH_r*.json round against "
                            "prior rounds (median+MAD); exit 1 on a "
                            "regression")
+    mode.add_argument("--concurrency", action="store_true",
+                      help="high-concurrency serving soak (plan/result "
+                           "caches, CPU/TPU routing, micro-batching) -> "
+                           "BENCH_concurrency.json")
+    conc = p.add_argument_group("--concurrency options")
+    conc.add_argument("--clients", type=int, default=None,
+                      help="concurrent clients (default: 120 or "
+                           "TRINO_TPU_CONCURRENCY_CLIENTS)")
+    conc.add_argument("--queries-per-client", type=int, default=None,
+                      help="statements each client runs (default: 5)")
     gate = p.add_argument_group("--check-regressions options")
     gate.add_argument("--rounds-glob", default="BENCH_r*.json",
                       help="round files to diff (default: BENCH_r*.json)")
@@ -948,6 +1134,10 @@ def main(argv=None):
     if args.gather_micro:
         gather_micro()
         return 0
+    if args.concurrency:
+        rec = concurrency_soak(n_clients=args.clients,
+                               queries_per_client=args.queries_per_client)
+        return 0 if rec["passed"] else 1
     if args.check_regressions:
         import glob as _glob
         ok, report = check_regressions(

@@ -95,6 +95,17 @@ REQUIRED_FAMILIES = (
     "trino_tpu_operator_compile_ms_total",
     "trino_tpu_query_latency_regressions_total",
     "trino_tpu_query_history_records_total",
+    # round-11 high-concurrency serving surface: plan/result caches,
+    # cost-based CPU/TPU co-routing, micro-batched point dispatch
+    "trino_tpu_plan_cache_hits_total",
+    "trino_tpu_plan_cache_misses_total",
+    "trino_tpu_plan_cache_evictions_total",
+    "trino_tpu_result_cache_hits_total",
+    "trino_tpu_result_cache_misses_total",
+    "trino_tpu_result_cache_invalidations_total",
+    "trino_tpu_router_decisions_total",
+    "trino_tpu_microbatch_queries_total",
+    "trino_tpu_microbatch_batches_total",
 )
 
 

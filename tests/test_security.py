@@ -117,6 +117,70 @@ def test_merge_source_reads_are_checked():
             WHEN MATCHED THEN UPDATE SET v = n.n_regionkey""", "w")
 
 
+def _mem_tpch_session():
+    from trino_tpu.catalog import Catalog
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.tpch.connector import TpchConnector
+    cat = Catalog()
+    cat.register("m", MemoryConnector())
+    cat.register("tpch", TpchConnector())
+    s = Session(catalog=cat, default_cat="m", default_schema="s")
+    s.execute("CREATE TABLE m.s.t (k bigint, v bigint)")
+    return s
+
+
+def test_update_where_subquery_reads_are_checked():
+    """Round-5 high finding: UPDATE/DELETE access control missed reads
+    in WHERE subqueries — a write grant on one catalog could exfiltrate
+    any denied table via `WHERE k IN (SELECT ... FROM denied)`. The
+    shadow query is now planned and its ScanNodes collected as READ
+    refs, like the MERGE USING fix."""
+    s = _mem_tpch_session()
+    ac = RuleAccessControl([AccessRule(user="w", catalog="m")])
+    with pytest.raises(AccessDeniedError, match="nation"):
+        check_statement_access(ac, s, """
+            UPDATE m.s.t SET v = 1
+            WHERE k IN (SELECT n_nationkey FROM tpch.tiny.nation)""",
+            "w")
+    with pytest.raises(AccessDeniedError, match="nation"):
+        check_statement_access(ac, s, """
+            DELETE FROM m.s.t
+            WHERE k IN (SELECT n_nationkey FROM tpch.tiny.nation)""",
+            "w")
+    with pytest.raises(AccessDeniedError, match="region"):
+        check_statement_access(ac, s, """
+            DELETE FROM m.s.t WHERE EXISTS (
+              SELECT 1 FROM tpch.tiny.region WHERE r_regionkey = k)""",
+            "w")
+    # statements confined to the granted catalog still pass
+    check_statement_access(ac, s, "UPDATE m.s.t SET v = 2 WHERE k = 1",
+                           "w")
+    check_statement_access(ac, s, "DELETE FROM m.s.t WHERE k = 1", "w")
+
+
+def test_update_set_subquery_reads_are_checked():
+    """SET-side scalar subqueries read too (the same round-5 hole)."""
+    s = _mem_tpch_session()
+    ac = RuleAccessControl([AccessRule(user="w", catalog="m")])
+    with pytest.raises(AccessDeniedError, match="region"):
+        check_statement_access(ac, s, """
+            UPDATE m.s.t
+            SET v = (SELECT max(r_regionkey) FROM tpch.tiny.region)
+            WHERE k = 1""", "w")
+
+
+def test_select_item_scalar_subquery_reads_are_checked():
+    """Scalar subqueries embedded in select items carry their plan
+    inside the expression tree; the checker now walks those subplans
+    too instead of only the top-level plan children."""
+    s = _mem_tpch_session()
+    ac = RuleAccessControl([AccessRule(user="w", catalog="m")])
+    with pytest.raises(AccessDeniedError, match="nation"):
+        check_statement_access(ac, s, """
+            SELECT (SELECT max(n_nationkey) FROM tpch.tiny.nation)
+            FROM m.s.t""", "w")
+
+
 def test_liveness_stays_open_on_secured_cluster(coord):
     """Load-balancer probes must not need credentials (documented
     contract; the failure detector pings /v1/status the same way)."""

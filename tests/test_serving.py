@@ -1,0 +1,336 @@
+"""High-concurrency serving layer (server/serving.py, exec/router.py).
+
+Round-11 acceptance surface: plan-cache hit/miss + eviction, result-cache
+correctness including catalog-version invalidation after a write, router
+decisions on small vs scan-heavy plans (forced via session property
+overrides), micro-batch coalescing returning per-client-correct rows, and
+a concurrent-mix throughput smoke (bench.py --concurrency with a small
+client count).
+"""
+
+import threading
+import time
+
+import pytest
+
+from trino_tpu.client.client import Client, QueryError
+from trino_tpu.exec.session import Session
+from trino_tpu.metrics import (MICROBATCH_BATCHES, MICROBATCH_QUERIES,
+                               PLAN_CACHE_EVICTIONS, PLAN_CACHE_HITS,
+                               PLAN_CACHE_MISSES, RESULT_CACHE_HITS,
+                               RESULT_CACHE_INVALIDATIONS,
+                               RESULT_CACHE_MISSES, ROUTER_DECISIONS)
+from trino_tpu.server.coordinator import CoordinatorServer
+
+
+@pytest.fixture
+def coord():
+    session = Session(default_schema="tiny")
+    c = CoordinatorServer(session, max_concurrency=16).start()
+    # deterministic router verdicts: the persistent query-history ring
+    # accumulates across pytest sessions, and its medians would override
+    # the row-estimate path these tests assert on
+    c.state.dispatcher.serving.history = None
+    session.history_store = None
+    yield c
+    c.stop()
+
+
+def _client(coord, user="serve"):
+    return Client(coord.uri, user=user, poll_interval_s=0.005)
+
+
+# ---------------------------------------------------------------------------
+# plan cache
+# ---------------------------------------------------------------------------
+
+def test_plan_cache_hit_miss(coord):
+    client = _client(coord)
+    sql = "SELECT count(*) FROM supplier"
+    m0, h0 = PLAN_CACHE_MISSES.value(), PLAN_CACHE_HITS.value()
+    first = client.execute(sql).rows
+    assert PLAN_CACHE_MISSES.value() > m0
+    h1 = PLAN_CACHE_HITS.value()
+    second = client.execute(sql).rows
+    assert PLAN_CACHE_HITS.value() > h1
+    assert first == second
+    # formatting differences share the normalized fingerprint: still hits
+    h2 = PLAN_CACHE_HITS.value()
+    third = client.execute("select   COUNT(*)  from SUPPLIER;").rows
+    assert PLAN_CACHE_HITS.value() > h2
+    assert third == first
+
+
+def test_plan_cache_lru_and_byte_eviction():
+    from trino_tpu.server.serving import PlanCache, PlanEntry
+
+    def entry(i, weight):
+        return PlanEntry(sql=f"q{i}", fingerprint=f"fp{i}", stmt=None,
+                         rel=None, root=None, cacheable=True,
+                         point_shape=None, weight=weight)
+
+    e0 = PLAN_CACHE_EVICTIONS.value()
+    cache = PlanCache(max_entries=3, max_bytes=10_000)
+    for i in range(4):
+        cache.put((f"fp{i}",), entry(i, 100))
+    assert len(cache) == 3                       # LRU entry cap
+    assert cache.get(("fp0",)) is None           # oldest evicted
+    assert cache.get(("fp3",)) is not None
+    assert PLAN_CACHE_EVICTIONS.value() > e0
+    # byte cap: one huge entry evicts the rest but itself survives
+    cache.put(("big",), entry(9, 9_999))
+    assert cache.get(("big",)) is not None
+    assert len(cache) == 1
+
+
+def test_plan_cache_invalidated_by_catalog_version(coord):
+    """DDL bumps the catalog version, which is part of the plan-cache
+    key: the stale plan is simply never looked up again."""
+    client = _client(coord)
+    client.execute("CREATE TABLE memory.s.pc (x bigint)")
+    client.execute("INSERT INTO memory.s.pc VALUES (1)")
+    assert client.execute("SELECT count(*) FROM memory.s.pc"
+                          ).rows == [[1]]
+    client.execute("INSERT INTO memory.s.pc VALUES (2)")
+    assert client.execute("SELECT count(*) FROM memory.s.pc"
+                          ).rows == [[2]]
+
+
+def test_plan_cache_system_table(coord):
+    client = _client(coord)
+    client.execute("SELECT count(*) FROM region")
+    rows = client.execute(
+        "SELECT fingerprint, hits FROM system.runtime.plan_cache").rows
+    assert rows, "plan cache system table should list cached plans"
+
+
+# ---------------------------------------------------------------------------
+# result cache
+# ---------------------------------------------------------------------------
+
+def test_result_cache_hit_and_bit_exact(coord):
+    client = _client(coord)
+    client.execute("SET SESSION enable_result_cache = true")
+    sql = "SELECT r_regionkey, r_name FROM region ORDER BY r_regionkey"
+    uncached = client.execute(sql).rows            # populates
+    h0 = RESULT_CACHE_HITS.value()
+    cached = client.execute(sql).rows              # served from cache
+    assert RESULT_CACHE_HITS.value() > h0
+    assert cached == uncached                      # bit-exact
+    info = client.query_info(client.execute(sql).query_id)
+    assert info["route"] == "cache"
+
+
+def test_result_cache_invalidated_by_write(coord):
+    client = _client(coord)
+    client.execute("CREATE TABLE memory.s.rc (k bigint, v bigint)")
+    client.execute("INSERT INTO memory.s.rc VALUES (1, 10), (2, 20)")
+    client.execute("SET SESSION enable_result_cache = true")
+    sql = "SELECT sum(v) FROM memory.s.rc"
+    assert client.execute(sql).rows == [[30]]
+    assert client.execute(sql).rows == [[30]]      # hit
+    i0 = RESULT_CACHE_INVALIDATIONS.value()
+    client.execute("INSERT INTO memory.s.rc VALUES (3, 30)")
+    # post-write rerun: the catalog version moved, the stale page must
+    # be dropped (counted) and the fresh answer returned
+    assert client.execute(sql).rows == [[60]]
+    assert RESULT_CACHE_INVALIDATIONS.value() > i0
+    # UPDATE/DELETE invalidate too
+    client.execute("UPDATE memory.s.rc SET v = 0 WHERE k = 1")
+    assert client.execute(sql).rows == [[50]]
+    client.execute("DELETE FROM memory.s.rc WHERE k = 2")
+    assert client.execute(sql).rows == [[30]]
+
+
+def test_result_cache_never_caches_system_tables(coord):
+    """system.runtime state changes without any catalog-version bump:
+    those plans are marked non-cacheable and always execute."""
+    client = _client(coord)
+    client.execute("SET SESSION enable_result_cache = true")
+    sql = "SELECT count(*) FROM system.runtime.queries"
+    a = client.execute(sql).rows[0][0]
+    b = client.execute(sql).rows[0][0]
+    # every execution adds a tracked query, so a cached (stale) page
+    # would return the SAME count twice
+    assert b > a
+
+
+def test_result_cache_disabled_by_default(coord):
+    client = _client(coord)
+    h0 = RESULT_CACHE_HITS.value() + RESULT_CACHE_MISSES.value()
+    client.execute("SELECT count(*) FROM region")
+    client.execute("SELECT count(*) FROM region")
+    assert RESULT_CACHE_HITS.value() + RESULT_CACHE_MISSES.value() == h0
+
+
+# ---------------------------------------------------------------------------
+# cost router
+# ---------------------------------------------------------------------------
+
+def test_router_forced_host_and_device(coord):
+    client = _client(coord)
+    sql = "SELECT count(*) FROM nation"
+    client.execute("SET SESSION routing_mode = host")
+    h0 = ROUTER_DECISIONS.value(target="host")
+    r = client.execute(sql)
+    assert ROUTER_DECISIONS.value(target="host") > h0
+    host_rows = r.rows
+    assert client.query_info(r.query_id)["route"] == "host"
+    client.execute("SET SESSION routing_mode = device")
+    d0 = ROUTER_DECISIONS.value(target="device")
+    r = client.execute(sql)
+    assert ROUTER_DECISIONS.value(target="device") > d0
+    assert client.query_info(r.query_id)["route"] == "device"
+    assert r.rows == host_rows                     # bit-exact across routes
+
+
+def test_router_auto_small_vs_scan_heavy(coord):
+    client = _client(coord)
+    # warm stats so the estimator sees materialized row counts
+    client.execute("SELECT count(*) FROM nation")
+    client.execute("SET SESSION router_host_max_rows = 1000")
+    r = client.execute("SELECT n_name FROM nation WHERE n_nationkey = 7")
+    assert client.query_info(r.query_id)["route"] == "host"
+    # lineitem tiny is ~60k rows > the 1k threshold -> device
+    r = client.execute(
+        "SELECT count(*) FROM lineitem WHERE l_quantity > 49")
+    info = client.query_info(r.query_id)
+    assert info["route"] == "device"
+    assert "scanned rows" in info["routeReason"]
+
+
+def test_router_grouped_aggregation_goes_device(coord):
+    client = _client(coord)
+    client.execute("SET SESSION routing_mode = host")   # forced, but...
+    r = client.execute(
+        "SELECT r_regionkey, count(*) FROM region GROUP BY r_regionkey")
+    # ...grouped aggregation is not host-eligible: falls back to device
+    assert client.query_info(r.query_id)["route"] == "device"
+
+
+def test_explain_shows_routing_decision(coord):
+    client = _client(coord)
+    rows = client.execute("EXPLAIN SELECT count(*) FROM region").rows
+    text = "\n".join(r[0] for r in rows)
+    assert "routing:" in text
+
+
+def test_host_path_bit_exact_vs_device():
+    """The numpy host path must decode bit-identically to the device
+    executor across types: ints, decimals, doubles, varchar dictionary
+    codes, dates, NULL handling, sorts and global aggregates."""
+    session = Session(default_schema="tiny")
+    queries = [
+        "SELECT count(*), sum(l_quantity), min(l_shipdate), "
+        "max(l_discount) FROM lineitem",
+        "SELECT n_nationkey, n_name FROM nation "
+        "WHERE n_regionkey = 2 ORDER BY n_nationkey",
+        "SELECT r_name FROM region WHERE r_regionkey >= 1 "
+        "ORDER BY r_name DESC LIMIT 3",
+        "SELECT s_suppkey + 1, s_acctbal * 2 FROM supplier "
+        "WHERE s_nationkey IN (1, 3) ORDER BY s_suppkey LIMIT 5",
+        "SELECT count(*) FROM orders "
+        "WHERE o_orderdate >= DATE '1996-01-01'",
+    ]
+    from trino_tpu.exec.router import host_supported, run_host
+    from trino_tpu.planner.optimizer import prune_plan
+    for sql in queries:
+        stmt, rel = session.plan(sql)
+        root = prune_plan(rel.node)
+        assert host_supported(root) is None, sql
+        host = run_host(session, rel, root, time.monotonic())
+        device = session.execute(sql)
+        assert host.rows == device.rows, sql
+        assert host.column_names == device.column_names
+
+
+def test_host_unsupported_reports_reason():
+    session = Session(default_schema="tiny")
+    from trino_tpu.exec.router import host_supported
+    from trino_tpu.planner.optimizer import prune_plan
+    _, rel = session.plan(
+        "SELECT c_name FROM customer JOIN nation "
+        "ON c_nationkey = n_nationkey")
+    reason = host_supported(prune_plan(rel.node))
+    assert reason is not None and "JoinNode" in reason
+
+
+# ---------------------------------------------------------------------------
+# micro-batching
+# ---------------------------------------------------------------------------
+
+def test_microbatch_coalesces_and_demuxes_per_client(coord):
+    client = _client(coord)
+    session = coord.state.session
+    oracle = {k: session.execute(
+        f"SELECT n_name, n_regionkey FROM nation WHERE n_nationkey = {k}"
+    ).rows for k in range(8)}
+    client.execute("SET SESSION enable_microbatch = true")
+    client.execute("SET SESSION microbatch_window_ms = 40")
+    q0, b0 = MICROBATCH_QUERIES.value(), MICROBATCH_BATCHES.value()
+    results = {}
+
+    def one(k):
+        c = _client(coord, user=f"mb{k}")
+        results[k] = c.execute(
+            f"SELECT n_name, n_regionkey FROM nation "
+            f"WHERE n_nationkey = {k}").rows
+
+    threads = [threading.Thread(target=one, args=(k,)) for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    for k in range(8):
+        assert [tuple(r) for r in results[k]] == \
+            [tuple(r) for r in oracle[k]], f"key {k}"
+    dq = MICROBATCH_QUERIES.value() - q0
+    db = MICROBATCH_BATCHES.value() - b0
+    assert db >= 1, "no gather window flushed"
+    assert dq > db, "no coalescing happened (queries == batches)"
+
+
+def test_microbatch_duplicate_literals_share_one_dispatch(coord):
+    client = _client(coord)
+    client.execute("SET SESSION enable_microbatch = true")
+    client.execute("SET SESSION microbatch_window_ms = 40")
+    results = []
+    lock = threading.Lock()
+
+    def one(i):
+        c = _client(coord, user=f"dup{i}")
+        rows = c.execute(
+            "SELECT n_name FROM nation WHERE n_nationkey = 5").rows
+        with lock:
+            results.append(rows)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert all(r == [["ETHIOPIA"]] for r in results), results
+
+
+def test_microbatch_off_by_default(coord):
+    client = _client(coord)
+    q0 = MICROBATCH_QUERIES.value()
+    client.execute("SELECT n_name FROM nation WHERE n_nationkey = 1")
+    assert MICROBATCH_QUERIES.value() == q0
+
+
+# ---------------------------------------------------------------------------
+# concurrent-mix throughput smoke (tier-1 cover for bench --concurrency)
+# ---------------------------------------------------------------------------
+
+def test_concurrency_soak_smoke():
+    import bench
+    rec = bench.concurrency_soak(n_clients=12, queries_per_client=3,
+                                 out_path=None)
+    assert rec["wrong_answers"] == 0
+    assert rec["failed_queries"] == 0
+    assert rec["result_cache_hits"] > 0
+    assert rec["plan_cache_hits"] > 0
+    assert rec["router_host"] > 0 and rec["router_device"] > 0
+    assert rec["invalidation_proven"]
+    assert rec["passed"], rec

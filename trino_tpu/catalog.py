@@ -18,6 +18,17 @@ class Catalog:
     def __init__(self):
         self._connectors: Dict[str, object] = {}
         self._stats_cache: Dict[tuple, object] = {}
+        # monotonic catalog version: bumped on every DDL/write that goes
+        # through the session (CREATE/DROP/INSERT/UPDATE/DELETE/MERGE).
+        # The serving layer stamps every cached plan and result page with
+        # the version it observed, so a write invalidates them all
+        # without enumerating which tables changed.
+        self.version = 0
+
+    def bump_version(self) -> None:
+        self.version += 1
+        # table contents moved: cached plan-time stats are stale too
+        self._stats_cache.clear()
 
     def register(self, name: str, connector) -> None:
         self._connectors[name] = connector

@@ -783,6 +783,19 @@ class Executor:
     # ------------------------------------------------------------------
 
     def run_scan(self, node: L.ScanNode) -> Batch:
+        if node.catalog == "system" or \
+                node.schema_name == "information_schema":
+            # volatile introspection state: never scan-cache (a cached
+            # batch would pin the first snapshot, and its dictionary
+            # codes go stale against freshly planned decode scopes)
+            data = self.catalog.get_table(node.catalog, node.schema_name,
+                                          node.table)
+            arrays = [data.columns[i] for i in node.column_indices]
+            valids = None if data.valids is None else \
+                [data.valids[i] for i in node.column_indices]
+            self.stats.scans += 1
+            self.stats.rows_scanned += data.num_rows
+            return batch_from_numpy(arrays, valids=valids)
         key = (node.catalog, node.schema_name, node.table,
                node.column_indices)
         hit = self._scan_cache.get(key)

@@ -119,6 +119,27 @@ SESSION_PROPERTY_DEFAULTS = {
     # EXPLAIN ANALYZE. Costs a device sync per plan node — forced
     # automatically during (distributed) EXPLAIN ANALYZE
     "enable_profiling": (False, _bool),
+    # --- high-concurrency serving layer (server/serving.py) ---
+    # logical-plan cache keyed by the normalized-SQL plan fingerprint:
+    # repeated statements skip parse/plan entirely
+    "enable_plan_cache": (True, _bool),
+    # coordinator result cache for FINISHED pages (catalog-version
+    # invalidated; volatile/system scans never cache). Opt-in: cached
+    # pages skip execution, which fault-injection/chaos runs must see
+    "enable_result_cache": (False, _bool),
+    # micro-batching: concurrent same-shape point queries coalesce into
+    # one dispatch behind a short gather window
+    "enable_microbatch": (False, _bool),
+    "microbatch_window_ms": (4.0, float),
+    # cost-based CPU/TPU co-routing (exec/router.py): auto routes by
+    # history baseline + scan-row estimates; host/device force a target
+    "routing_mode": ("auto", lambda v: str(v).lower()),
+    # auto mode: plans scanning at most this many estimated rows run on
+    # the host numpy path (no device dispatch, no exec lock)
+    "router_host_max_rows": (200_000, int),
+    # auto mode: fingerprints whose history median latency is under this
+    # run on the host regardless of the row estimate
+    "router_host_latency_ms": (30.0, float),
 }
 
 
@@ -186,13 +207,19 @@ class Session:
     def execute_query(self, stmt, t0) -> QueryResult:
         # spans mirror the reference's: planner / fragment-plan / execute
         # (SqlQueryExecution.java:473,501)
-        self._apply_executor_properties(t0)
         with self.tracer.span("plan"):
             rel = self.planner().plan_query(stmt)
         root = rel.node
         assert isinstance(root, OutputNode)
         with self.tracer.span("optimize"):
             root = prune_plan(root)
+        return self.execute_planned(rel, root, t0)
+
+    def execute_planned(self, rel, root, t0) -> QueryResult:
+        """Execute an already planned + pruned query — the plan-cache
+        re-entry point (server/serving.py): cached statements skip
+        parse/plan and land here directly."""
+        self._apply_executor_properties(t0)
         with self.tracer.span("execute") as sp:
             batch = self.executor.execute(root)
             names, arrays, valids = self.executor.result_to_host(root,
@@ -258,8 +285,18 @@ class Session:
                             f"{s[1]} rows] {est}")
                 return f"[{s[0] * 1000:.2f}ms, {s[1]} rows] {est}"
         text = explain_text(root, annotate=annotate)
-        return QueryResult(["query plan"],
-                           [(line,) for line in text.split("\n")],
+        rows = [(line,) for line in text.split("\n")]
+        # CPU/TPU co-routing verdict (exec/router.py): what the serving
+        # layer would do with this plan, and why
+        try:
+            from .router import decide_route
+            dec = decide_route(planner, root, self.properties,
+                               history=getattr(self, "history_store",
+                                               None))
+            rows.append((f"routing: {dec.target} ({dec.reason})",))
+        except Exception:    # noqa: BLE001 — EXPLAIN must never fail on
+            pass             # a router estimate
+        return QueryResult(["query plan"], rows,
                            time.monotonic() - t0)
 
     def execute_show(self, stmt, t0) -> QueryResult:
@@ -294,6 +331,10 @@ class Session:
         _, parser = SESSION_PROPERTY_DEFAULTS[stmt.name]
         raw = getattr(stmt.value, "value", getattr(stmt.value, "text",
                                                    None))
+        if raw is None and hasattr(stmt.value, "parts"):
+            # bare-identifier value (SET SESSION routing_mode = device):
+            # same spelling as the quoted form
+            raw = ".".join(stmt.value.parts)
         self.properties[stmt.name] = parser(raw)
         if stmt.name == "distributed":
             self.set_distributed(self.properties["distributed"])
@@ -342,6 +383,7 @@ class Session:
             cat, sch, tbl = self.resolve_table(stmt.table)
             self.catalog.connector(cat).drop_table(sch, tbl,
                                                    stmt.if_exists)
+            self.catalog.bump_version()
             self.executor = type(self.executor)(self.catalog)
             return QueryResult(["result"], [("DROP TABLE",)],
                                time.monotonic() - t0)
@@ -354,6 +396,7 @@ class Session:
                 data = TableData(tbl, Schema(tuple(fields)), arrays,
                                  valids=valids)
                 conn.create_table(sch, tbl, data, stmt.if_not_exists)
+                self.catalog.bump_version()
                 n = data.num_rows
                 return QueryResult(["rows"], [(n,)],
                                    time.monotonic() - t0)
@@ -367,6 +410,7 @@ class Session:
                               TableData(tbl, Schema(tuple(fields)),
                                         arrays),
                               stmt.if_not_exists)
+            self.catalog.bump_version()
             return QueryResult(["result"], [("CREATE TABLE",)],
                                time.monotonic() - t0)
 
@@ -376,6 +420,7 @@ class Session:
         n = self.catalog.connector(cat).insert(sch, tbl, arrays, valids,
                                                fields)
         # stored table changed: refresh any cached scans
+        self.catalog.bump_version()
         self.executor.invalidate_scan_cache()
         return QueryResult(["rows"], [(n,)], time.monotonic() - t0)
 
@@ -466,6 +511,7 @@ class Session:
                 n = conn.update_rows(sch, tbl, ids, updates)
         finally:
             conn.drop_table(sch, shadow, if_exists=True)
+        self.catalog.bump_version()
         self.executor.invalidate_scan_cache()
         return QueryResult(["rows"], [(n,)], time.monotonic() - t0)
 
@@ -574,6 +620,7 @@ class Session:
                                  full_fields)
         finally:
             conn.drop_table(sch, shadow, if_exists=True)
+        self.catalog.bump_version()
         self.executor.invalidate_scan_cache()
         return QueryResult(["rows"], [(n,)], time.monotonic() - t0)
 

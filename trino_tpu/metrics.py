@@ -354,6 +354,35 @@ OPERATOR_COMPILE_MS = REGISTRY.counter(
     "Compile time attributed to each operator's dispatch (ms; profiled "
     "runs only)", ("operator",))
 
+# high-concurrency serving layer (server/serving.py, exec/router.py)
+PLAN_CACHE_HITS = REGISTRY.counter(
+    "trino_tpu_plan_cache_hits_total",
+    "Statements served a cached logical plan (parse/plan skipped)")
+PLAN_CACHE_MISSES = REGISTRY.counter(
+    "trino_tpu_plan_cache_misses_total",
+    "Plan-cache lookups that planned fresh")
+PLAN_CACHE_EVICTIONS = REGISTRY.counter(
+    "trino_tpu_plan_cache_evictions_total",
+    "Plan-cache entries evicted by the LRU/byte cap")
+RESULT_CACHE_HITS = REGISTRY.counter(
+    "trino_tpu_result_cache_hits_total",
+    "Queries answered from the coordinator result cache")
+RESULT_CACHE_MISSES = REGISTRY.counter(
+    "trino_tpu_result_cache_misses_total",
+    "Result-cache lookups that executed fresh")
+RESULT_CACHE_INVALIDATIONS = REGISTRY.counter(
+    "trino_tpu_result_cache_invalidations_total",
+    "Cached pages dropped because the catalog version moved (DDL/write)")
+ROUTER_DECISIONS = REGISTRY.counter(
+    "trino_tpu_router_decisions_total",
+    "Cost-router execution-target decisions", ("target",))
+MICROBATCH_QUERIES = REGISTRY.counter(
+    "trino_tpu_microbatch_queries_total",
+    "Point queries coalesced into micro-batched dispatches")
+MICROBATCH_BATCHES = REGISTRY.counter(
+    "trino_tpu_microbatch_batches_total",
+    "Micro-batch gather windows flushed as one dispatch")
+
 # query history + latency-regression detection (server/history.py)
 LATENCY_REGRESSIONS = REGISTRY.counter(
     "trino_tpu_query_latency_regressions_total",
@@ -376,3 +405,5 @@ for _site in ("exec.fused_chunk", "exec.slice_widen"):
 for _op in ("ScanNode", "JoinNode", "AggregateNode"):
     OPERATOR_DEVICE_MS.init_labels(operator=_op)
     OPERATOR_COMPILE_MS.init_labels(operator=_op)
+for _target in ("host", "device"):
+    ROUTER_DECISIONS.init_labels(target=_target)

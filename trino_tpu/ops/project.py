@@ -31,7 +31,11 @@ from ..types import TypeKind
 # --------------------------------------------------------------------------
 
 
-def rescale(data: jax.Array, from_scale: int, to_scale: int) -> jax.Array:
+def rescale(data: jax.Array, from_scale: int, to_scale: int,
+            xp=jnp) -> jax.Array:
+    """`xp` selects the array namespace (jnp on device, np for the
+    host-routed point-query path in exec/router.py) so the HALF_UP
+    rounding rule cannot drift between the two executions."""
     if to_scale == from_scale:
         return data
     if to_scale > from_scale:
@@ -41,7 +45,7 @@ def rescale(data: jax.Array, from_scale: int, to_scale: int) -> jax.Array:
     # round half away from zero, like Trino's HALF_UP
     pos = (data + half) // d
     neg = -((-data + half) // d)
-    return jnp.where(data >= 0, pos, neg)
+    return xp.where(data >= 0, pos, neg)
 
 
 _FLIPPED_CMP = {'<': '>', '<=': '>=', '>': '<', '>=': '<=',
@@ -49,17 +53,19 @@ _FLIPPED_CMP = {'<': '>', '<=': '>=', '>': '<', '>=': '<=',
 
 
 def _decimal_compare(a: jax.Array, sa: int, b: jax.Array, sb: int,
-                     op: str) -> jax.Array:
+                     op: str, xp=jnp) -> jax.Array:
     """Exact comparison of scaled-int64 decimals at different scales.
 
     Never multiplies either operand: the larger-scale side is split into
     (hi, lo) by floor division, and ``a <op> b/10^k`` is decided from
     ``a`` vs ``hi`` plus the sign of ``lo`` — int64-overflow-free where
-    ``a * 10^k`` would wrap (Trino compares on Int128, Decimals.java)."""
+    ``a * 10^k`` would wrap (Trino compares on Int128, Decimals.java).
+    `xp` is unused (pure operators) but accepted for symmetry with the
+    other shared helpers the host router path calls."""
     if sa == sb:
         return _apply_cmp(op, a, b)
     if sa > sb:
-        return _decimal_compare(b, sb, a, sa, _FLIPPED_CMP[op])
+        return _decimal_compare(b, sb, a, sa, _FLIPPED_CMP[op], xp)
     d = 10 ** (sb - sa)
     hi = b // d                      # floor div: lo is always in [0, d)
     lo = b - hi * d
@@ -91,7 +97,8 @@ def _apply_cmp(op: str, l: jax.Array, r: jax.Array) -> jax.Array:
     return l >= r
 
 
-def _to_comparable(expr: ir.Expr, data: jax.Array, target) -> jax.Array:
+def _to_comparable(expr: ir.Expr, data: jax.Array, target,
+                   xp=jnp) -> jax.Array:
     """Rescale/convert one comparison operand to the common type."""
     t = expr.dtype
     # DECIMAL comparison targets never reach here: eval_expr routes them
@@ -99,10 +106,10 @@ def _to_comparable(expr: ir.Expr, data: jax.Array, target) -> jax.Array:
     assert target.kind is not TypeKind.DECIMAL
     if target.kind is TypeKind.DOUBLE:
         if t.kind is TypeKind.DECIMAL:
-            return data.astype(jnp.float64) / (10 ** t.scale)
-        return data.astype(jnp.float64)
+            return data.astype(xp.float64) / (10 ** t.scale)
+        return data.astype(xp.float64)
     if target.kind is TypeKind.TIMESTAMP and t.kind is TypeKind.DATE:
-        return data.astype(jnp.int64) * 86_400_000_000
+        return data.astype(xp.int64) * 86_400_000_000
     return data
 
 

@@ -26,7 +26,6 @@ import threading
 import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
-from contextlib import nullcontext
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
@@ -44,7 +43,10 @@ SERVER_NAME = "coordinator"
 # authenticates.
 ROUTES = (
     ("POST", ("v1", "statement"), "_post_statement", True),
-    ("POST", ("v1", "announce"), "_post_announce", False),
+    # worker registration is cluster-internal: guarded by the shared
+    # secret (TRINO_TPU_INTERNAL_SECRET) so a rogue process with network
+    # reach cannot join the cluster and absorb splits
+    ("POST", ("v1", "announce"), "_post_announce", "internal"),
     ("GET", ("v1", "info"), "_get_info", False),
     ("GET", ("v1", "status"), "_get_status", False),
     ("GET", ("v1", "metrics"), "_get_metrics", False),
@@ -69,6 +71,14 @@ ROUTES = (
 )
 
 register_routes(SERVER_NAME, ROUTES)
+
+
+class ClusterHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a serving-grade accept backlog: the
+    stdlib default (request_queue_size=5) resets connections under a
+    thundering herd of concurrent clients — the exact load the serving
+    layer exists to absorb."""
+    request_queue_size = 256
 
 
 class QueryDeclinedError(RuntimeError):
@@ -121,7 +131,9 @@ class Dispatcher:
         self.tracker = tracker
         self.pool = ThreadPoolExecutor(max_workers=max_concurrency,
                                        thread_name_prefix="dispatch")
-        self.exec_lock = threading.Lock()
+        # RLock: traced attempts hold it across the whole attempt while
+        # the serving layer re-acquires for its device-path execution
+        self.exec_lock = threading.RLock()
         self.failure_injector = None      # FailureInjector (tests/ops)
         # retry-policy QUERY (admin/fault-tolerant-execution.md): rerun the
         # whole query on failure; deterministic kernels + the dedup of
@@ -142,6 +154,13 @@ class Dispatcher:
         from .security import AllowAllAccessControl
         self.authenticator = None            # None = open cluster
         self.access_control = AllowAllAccessControl()
+        # high-concurrency serving layer (server/serving.py): plan +
+        # result caches, CPU/TPU cost routing, micro-batched point
+        # queries. Host-routed and cache-served statements bypass the
+        # exec lock entirely; device executions still take it inside
+        # ServingLayer.run_routed.
+        from .serving import ServingLayer
+        self.serving = ServingLayer(session, self.exec_lock)
 
     def submit(self, sql: str, user: str,
                traceparent: Optional[str] = None) -> TrackedQuery:
@@ -225,24 +244,47 @@ class Dispatcher:
                     if self.failure_injector is not None:
                         self.failure_injector.maybe_fail("DISPATCH",
                                                          tq.sql)
-                    with self.exec_lock:
+                    if tracer is not None:
+                        # tracing swaps the SHARED session tracer, so a
+                        # traced attempt serializes end-to-end like the
+                        # pre-serving coordinator did
+                        with self.exec_lock:
+                            if sm.is_done():
+                                return
+                            sm.transition("RUNNING")
+                            if self.failure_injector is not None:
+                                self.failure_injector.maybe_fail(
+                                    "EXECUTION", tq.sql)
+                            saved_tracer = self.session.tracer
+                            self.session.tracer = tracer
+                            try:
+                                with tracer.span("query",
+                                                 queryId=tq.query_id,
+                                                 user=tq.session_user,
+                                                 attempt=attempt):
+                                    self._execute_attempt(tq)
+                            finally:
+                                self.session.tracer = saved_tracer
+                    else:
+                        # untraced path: the exec lock moves INSIDE the
+                        # attempt (serving layer) so host-routed and
+                        # cache-served queries run concurrently while
+                        # device executions still serialize
                         if sm.is_done():
                             return
                         sm.transition("RUNNING")
                         if self.failure_injector is not None:
-                            self.failure_injector.maybe_fail("EXECUTION",
-                                                             tq.sql)
+                            self.failure_injector.maybe_fail(
+                                "EXECUTION", tq.sql)
+                        # restore the session tracer afterwards even
+                        # untraced: a SET SESSION enable_tracing=true
+                        # must not leave a live session-level tracer
+                        # soaking up every later query's spans (the
+                        # per-query tracer swap above is the only way
+                        # spans reach a protocol query)
                         saved_tracer = self.session.tracer
-                        if tracer is not None:
-                            self.session.tracer = tracer
                         try:
-                            with (tracer.span("query",
-                                              queryId=tq.query_id,
-                                              user=tq.session_user,
-                                              attempt=attempt)
-                                  if tracer is not None
-                                  else nullcontext()):
-                                self._execute_attempt(tq)
+                            self._execute_attempt(tq)
                         finally:
                             self.session.tracer = saved_tracer
                     sm.transition("FINISHING")
@@ -298,13 +340,25 @@ class Dispatcher:
     def _execute_attempt_inner(self, tq: TrackedQuery, t0: float) -> None:
         result = None
         spills0 = self._spill_counter()
-        if self.scheduler is not None:
+        serving = getattr(self, "serving", None)
+        if serving is not None:
+            # FINISHED page straight from the result cache: no lock, no
+            # planning, no scheduler round trip
+            result = serving.lookup_cached(tq)
+        no_workers = self.scheduler is not None and \
+            not self.scheduler.state.active_nodes()
+        if result is None and self.scheduler is not None and no_workers:
+            # no cluster: skip the exec-lock round trip entirely so
+            # host-routed queries stay lock-free on a plain coordinator
+            tq.fallback_reason = "no active workers"
+        elif result is None and self.scheduler is not None:
             # cluster path: fragment + dispatch to workers; None = not
-            # eligible / no workers (coordinator executes locally)
+            # eligible (coordinator executes locally)
             from .scheduler import TaskFailedError
             try:
-                result = self.scheduler.execute(tq.sql,
-                                                query_id=tq.query_id)
+                with self.exec_lock:
+                    result = self.scheduler.execute(tq.sql,
+                                                    query_id=tq.query_id)
                 tq.fallback_reason = self.scheduler.fallback_reason \
                     if result is None else None
             except TaskFailedError as te:
@@ -327,7 +381,14 @@ class Dispatcher:
                 "require_distributed: cluster declined the "
                 f"query ({tq.fallback_reason})")
         if result is None:
-            result = self.session.execute(tq.sql)
+            if serving is not None:
+                # local path through the serving layer: plan cache,
+                # micro-batching, CPU/TPU routing (device executions
+                # take the exec lock inside)
+                result = serving.execute_local(tq)
+            else:
+                with self.exec_lock:
+                    result = self.session.execute(tq.sql)
         tq.elapsed_s = time.monotonic() - t0
         tq.result = result
         tq.rows_returned = len(result.rows)
@@ -363,6 +424,10 @@ class CoordinatorState:
         self.dispatcher.event_listeners.register(
             HistoryEventListener(self.history))
         self.tracker.on_evict = self.history.record_tracked
+        # the cost router's history baseline input + EXPLAIN's routing
+        # annotation both read per-fingerprint medians from this store
+        self.dispatcher.serving.history = self.history
+        session.history_store = self.history
         # system.runtime.{queries,nodes,tasks,operator_stats,jit_cache,
         # query_history} backed by this coordinator's state
         from .system_connector import SystemConnector
@@ -620,6 +685,7 @@ class _Handler(BaseHTTPRequestHandler):
             "rows": tq.rows_returned, "retries": tq.retries,
             "distributed": tq.distributed,
             "fallbackReason": tq.fallback_reason,
+            "route": tq.route, "routeReason": tq.route_reason,
             "stageStats": {
                 "stages": st.get("stages", 0),
                 "tasks": len(st.get("tasks", ())),
@@ -679,7 +745,7 @@ class CoordinatorServer:
         self.state = CoordinatorState(session or Session(),
                                       max_concurrency, retry_policy)
         handler = type("BoundHandler", (_Handler,), {"state": self.state})
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.httpd = ClusterHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
         self.uri = f"http://127.0.0.1:{self.port}"
         self._thread: Optional[threading.Thread] = None

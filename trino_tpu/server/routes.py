@@ -9,12 +9,17 @@ if/elif chains instead — invisible to metrics and impossible to lint. Every
     (METHOD, pattern, handler_method_name, needs_auth)
 
 where `pattern` is a tuple of path segments and STAR matches any single
-segment. `dispatch()` is the entire body of each do_GET/do_POST/...: match,
-count the request in trino_tpu_http_requests_total{server,route}, enforce
-auth, call the handler. Adding a route therefore *cannot* skip the metrics
-surface, and tier-1 lints exactly that (tests/test_metrics_lint.py:
-handlers may not contain inline path literals; every table entry must have
-a pre-initialized counter sample).
+segment. `needs_auth` is False (open), True (end-user authentication via
+the server's authenticator), or "internal" (cluster-membership routes:
+the shared-secret header TRINO_TPU_INTERNAL_SECRET configures — worker
+task/exchange routes and the coordinator announce route reject callers
+without it with 401). `dispatch()` is the entire body of each
+do_GET/do_POST/...: match, count the request in
+trino_tpu_http_requests_total{server,route}, enforce auth, call the
+handler. Adding a route therefore *cannot* skip the metrics surface, and
+tier-1 lints exactly that (tests/test_metrics_lint.py: handlers may not
+contain inline path literals; every table entry must have a
+pre-initialized counter sample).
 """
 
 from __future__ import annotations
@@ -56,7 +61,16 @@ def dispatch(handler, method: str, routes, server_name: str) -> None:
         HTTP_REQUESTS.inc(server=server_name,
                           route=route_label(m, pattern))
         user = None
-        if needs_auth:
+        if needs_auth == "internal":
+            from .security import check_internal_request
+            if not check_internal_request(handler.headers):
+                handler._send(401, {"error": {
+                    "message": "cluster-internal route: missing or "
+                               "invalid internal secret",
+                    "errorName": "AUTHENTICATION_FAILED"}})
+                return
+            user = "internal"
+        elif needs_auth:
             user = handler._authenticate()
             if user is None:
                 return           # 401 already sent

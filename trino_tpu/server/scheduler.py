@@ -159,7 +159,9 @@ class RemoteTask:
                  method: str = "GET", accept: str = ""):
         """JSON request; with `accept` = the binary pages media type the
         response may instead be a raw page frame (returned as bytes)."""
-        headers = {"Content-Type": "application/json"}
+        from .security import internal_headers
+        headers = {"Content-Type": "application/json",
+                   **internal_headers()}
         if accept:
             headers["Accept"] = accept
         if self.traceparent is not None:

@@ -122,6 +122,11 @@ class TrackedQuery:
     # spill-tier activations during this query (executor stats delta) —
     # one of the regression detector's inputs (server/history.py)
     spills: int = 0
+    # serving-layer verdicts (server/serving.py): where the query ran
+    # ('host' | 'device' | 'cache' | 'microbatch') and the router's
+    # reasoning — surfaced in /v1/query info
+    route: Optional[str] = None
+    route_reason: Optional[str] = None
 
     @property
     def state(self) -> str:

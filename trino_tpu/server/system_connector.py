@@ -31,7 +31,8 @@ class SystemConnector:
     def table_names(self, schema: str):
         if schema == "runtime":
             return ["queries", "nodes", "tasks", "operator_stats",
-                    "resource_groups", "jit_cache", "query_history"]
+                    "resource_groups", "jit_cache", "query_history",
+                    "plan_cache"]
         return []
 
     def get_table(self, schema: str, table: str) -> TableData:
@@ -51,6 +52,8 @@ class SystemConnector:
             return self._jit_cache_table()
         if table == "query_history":
             return self._query_history_table()
+        if table == "plan_cache":
+            return self._plan_cache_table()
         raise KeyError(f"system table {table!r} not found")
 
     def _scheduler(self):
@@ -221,6 +224,34 @@ class SystemConnector:
                     Field("compile_ms", DOUBLE),
                     Field("last_compile_ms", DOUBLE))),
             base.columns + [compiles, hits, total_ms, last_ms])
+
+    def _plan_cache_table(self) -> TableData:
+        """The serving layer's logical-plan cache (server/serving.py):
+        one row per cached plan with its fingerprint, hit count, and
+        byte-cap weight — the SQL twin of the plan-cache metrics."""
+        serving = getattr(getattr(self.state, "dispatcher", None),
+                          "serving", None) if self.state else None
+        recs = serving.plan_cache.snapshot() if serving is not None \
+            else []
+        base = _strings_table(
+            "plan_cache",
+            [("fingerprint", [r["fingerprint"] for r in recs]),
+             ("query", [r["sql"] for r in recs])])
+        hits = np.array([r["hits"] for r in recs], dtype=np.int64)
+        weight = np.array([r["weight_bytes"] for r in recs],
+                          dtype=np.int64)
+        point = np.array([int(r["point_shape"]) for r in recs],
+                         dtype=np.int64)
+        cacheable = np.array([int(r["cacheable"]) for r in recs],
+                             dtype=np.int64)
+        return TableData(
+            "plan_cache",
+            Schema(base.schema.fields +
+                   (Field("hits", BIGINT),
+                    Field("weight_bytes", BIGINT),
+                    Field("point_shape", BIGINT),
+                    Field("result_cacheable", BIGINT))),
+            base.columns + [hits, weight, point, cacheable])
 
     def _query_history_table(self) -> TableData:
         """The coordinator's persistent completed-query ring

@@ -566,7 +566,9 @@ class TaskManager:
         import json as _json
         import time as _time
         from urllib.request import Request, urlopen
-        headers = {"Accept": "application/x-trino-pages"}
+        from .security import internal_headers
+        headers = {"Accept": "application/x-trino-pages",
+                   **internal_headers()}
         tp = tracer.traceparent()
         if tp is not None:
             headers["traceparent"] = tp

@@ -31,17 +31,22 @@ from .routes import STAR, dispatch, register_routes
 SERVER_NAME = "worker"
 
 # (METHOD, pattern, handler method, needs_auth) — see server/routes.py.
+# The task/exchange data plane is cluster-internal: with
+# TRINO_TPU_INTERNAL_SECRET set, callers without the shared-secret
+# header get 401 (anyone with network reach could otherwise pull result
+# pages or inject work). Liveness/metrics stay open.
 ROUTES = (
     ("GET", ("v1", "status"), "_get_status", False),
     ("GET", ("v1", "info"), "_get_info", False),
     ("GET", ("v1", "metrics"), "_get_metrics", False),
-    ("GET", ("v1", "task", STAR), "_get_task", False),
-    ("GET", ("v1", "task", STAR, "results", STAR), "_get_results", False),
+    ("GET", ("v1", "task", STAR), "_get_task", "internal"),
+    ("GET", ("v1", "task", STAR, "results", STAR), "_get_results",
+     "internal"),
     ("GET", ("v1", "task", STAR, "results", STAR, STAR), "_get_results",
-     False),
-    ("POST", ("v1", "task", STAR), "_post_task", False),
-    ("DELETE", ("v1", "task", STAR), "_delete_task", False),
-    ("PUT", ("v1", "info", "state"), "_put_state", False),
+     "internal"),
+    ("POST", ("v1", "task", STAR), "_post_task", "internal"),
+    ("DELETE", ("v1", "task", STAR), "_delete_task", "internal"),
+    ("PUT", ("v1", "info", "state"), "_put_state", "internal"),
 )
 
 register_routes(SERVER_NAME, ROUTES)
@@ -256,7 +261,8 @@ class WorkerServer:
         self.task_manager = TaskManager(self.catalog, node_id=node_id)
         handler = type("BoundWorkerHandler", (_WorkerHandler,),
                        {"worker": self})
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        from .coordinator import ClusterHTTPServer
+        self.httpd = ClusterHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
         self.uri = f"http://127.0.0.1:{self.port}"
         self.announce_interval_s = announce_interval_s
@@ -281,10 +287,12 @@ class WorkerServer:
         from .retrypolicy import RetryPolicy
 
         def post():
+            from .security import internal_headers
             body = json.dumps({"nodeId": self.node_id,
                                "uri": self.uri}).encode()
             req = Request(f"{self.coordinator_uri}/v1/announce", data=body,
-                          headers={"Content-Type": "application/json"})
+                          headers={"Content-Type": "application/json",
+                                   **internal_headers()})
             with urlopen(req, timeout=5):
                 pass
 
