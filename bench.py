@@ -438,6 +438,92 @@ def gather_micro(table_sizes=None, probe_rows=None, n_tables=3, runs=3,
 
 
 # ---------------------------------------------------------------------------
+# --agg-micro: hash vs sort vs direct aggregation across cardinalities
+# ---------------------------------------------------------------------------
+
+def agg_micro(cardinalities=None, rows=None, runs=3,
+              out_path="BENCH_agg_micro.json"):
+    """Microbenchmark the aggregation strategies (ops/pallas_hash.py
+    hash table, ops/aggregate.py sort kernel, direct masked reductions
+    where the domain allows) across group cardinalities, recording the
+    per-strategy walls as one JSON artifact so the q18-class trajectory
+    (hash >= 5x sort at high cardinality) is measurable round over
+    round and gated by --check-regressions.
+
+    On TPU this sweeps 10^2..10^7 groups over a large batch; under
+    JAX_PLATFORMS=cpu it drops to a tiny smoke configuration in Pallas
+    interpret mode (numbers meaningless there — the run exists so
+    tier-1 exercises the harness end to end)."""
+    import jax
+    import jax.numpy as jnp
+
+    from trino_tpu.batch import batch_from_numpy
+    from trino_tpu.ops import pallas_hash as ph
+    from trino_tpu.ops.aggregate import (AggSpec, direct_group_aggregate,
+                                         key_pack_plan,
+                                         sort_group_aggregate)
+
+    on_tpu = jax.default_backend() == "tpu"
+    mode = "device" if on_tpu else "interpret"
+    if cardinalities is None:
+        cardinalities = [100, 1000, 10_000, 100_000, 1_000_000,
+                         10_000_000] if on_tpu else [16, 256]
+    if rows is None:
+        rows = (1 << 24) if on_tpu else (1 << 12)
+    rng = np.random.default_rng(11)
+
+    def timed(fn):
+        import jax as _jax
+        _jax.block_until_ready(fn())            # warm (compile)
+        walls = []
+        for _ in range(runs):
+            t0 = time.monotonic()
+            _jax.block_until_ready(fn())
+            walls.append(time.monotonic() - t0)
+        return min(walls) * 1000
+
+    records = []
+    aggs = (AggSpec("sum", 1), AggSpec("count_star", None))
+    for groups in cardinalities:
+        keys = rng.integers(0, groups, rows)
+        vals = rng.integers(-(1 << 40), 1 << 40, rows)
+        batch = batch_from_numpy([keys, vals])
+        cap = 1 << max(10, int(1.3 * groups).bit_length())
+        rec = {"groups": groups, "rows": rows}
+
+        rec["sort_ms"] = round(timed(lambda: sort_group_aggregate(
+            batch, (0,), aggs, min(cap, len(keys) or 1))), 3)
+        if groups <= 64:
+            rec["direct_ms"] = round(timed(
+                lambda: direct_group_aggregate(batch, (0,), (groups,),
+                                               aggs)), 3)
+        plan = key_pack_plan(batch, (0,))
+        if plan is not None:
+            kmins, bits = plan
+            slots, fits = ph.pick_table_slots(groups, aggs)
+            kd = jnp.asarray(kmins)
+            out = ph.hash_group_aggregate(batch, kd, (0,), bits, aggs,
+                                          slots, mode)
+            esc = int(out[1])
+            rec["hash_table_slots"] = slots
+            rec["hash_escapes"] = esc
+            if esc == 0 and fits:
+                rec["hash_ms"] = round(timed(
+                    lambda: ph.hash_group_aggregate(
+                        batch, kd, (0,), bits, aggs, slots, mode)), 3)
+                rec["hash_vs_sort"] = round(
+                    rec["sort_ms"] / max(rec["hash_ms"], 1e-6), 2)
+        records.append(rec)
+
+    out = {"metric": "agg_micro_ms", "device": str(jax.devices()[0]),
+           "mode": mode, "smoke": not on_tpu, "records": records}
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out), flush=True)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # --chaos: seeded randomized fault-injection soak (round-7 robustness PR)
 # ---------------------------------------------------------------------------
 
@@ -950,6 +1036,16 @@ def load_bench_round(path):
         doc = recs[-1] if recs else None
     if not isinstance(doc, dict):
         return None
+    if str(doc.get("metric", "")).startswith("agg_micro"):
+        # --agg-micro rounds gate on the strategy the gate would pick
+        # (hash where present, else sort): a slower kernel in a later
+        # round reads as a regressed agg_micro_g<cardinality> config
+        out = {}
+        for r in doc.get("records", ()):
+            ms = r.get("hash_ms", r.get("sort_ms"))
+            if ms is not None:
+                out[f"agg_micro_g{r['groups']}"] = float(ms)
+        return out or None
     detail = doc.get("detail", doc)
     out = {}
     for cfg, d in detail.items():
@@ -1098,6 +1194,10 @@ def build_parser():
     mode.add_argument("--gather-micro", action="store_true",
                       help="Pallas tiled-gather microbench -> "
                            "BENCH_gather_micro.json")
+    mode.add_argument("--agg-micro", action="store_true",
+                      help="hash vs sort vs direct aggregation "
+                           "microbench across group cardinalities -> "
+                           "BENCH_agg_micro.json")
     mode.add_argument("--check-regressions", action="store_true",
                       help="gate the newest BENCH_r*.json round against "
                            "prior rounds (median+MAD); exit 1 on a "
@@ -1134,6 +1234,9 @@ def main(argv=None):
     if args.gather_micro:
         gather_micro()
         return 0
+    if args.agg_micro:
+        agg_micro()
+        return 0
     if args.concurrency:
         rec = concurrency_soak(n_clients=args.clients,
                                queries_per_client=args.queries_per_client)
@@ -1143,6 +1246,16 @@ def main(argv=None):
         ok, report = check_regressions(
             sorted(_glob.glob(args.rounds_glob)),
             ratio=args.ratio, mad_k=args.mad_k)
+        # the aggregation trajectory gates as its own series: later
+        # rounds append BENCH_agg_micro_r*.json next to the canonical
+        # BENCH_agg_micro.json, and a slower hash kernel fails the gate
+        agg_paths = sorted(_glob.glob("BENCH_agg_micro*.json"))
+        if agg_paths:
+            ok2, report2 = check_regressions(agg_paths,
+                                             ratio=args.ratio,
+                                             mad_k=args.mad_k)
+            report["agg_micro"] = report2
+            ok = ok and ok2
         print(json.dumps(report), flush=True)
         return 0 if ok else 1
     threading.Thread(target=_watchdog, daemon=True).start()

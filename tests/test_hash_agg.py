@@ -1,0 +1,486 @@
+"""TPU-native hash aggregation + hybrid hash join (ops/pallas_hash.py),
+interpret mode on CPU so tier-1 exercises the real kernel logic.
+
+Property: the hash strategy must be bit-exact vs the sort path — across
+int/decimal/varchar-dict/date keys, NULL keys and values, crafted
+splitmix64-collision keys, the overflow-escape -> radix-partition ->
+re-enter chain, and composition with the round-9 host-spill tier. The
+hybrid hash join must match the sorted searchsorted join for every
+kind, detect duplicate build keys, and degrade partition-by-partition
+when the build exceeds the table.
+
+Shapes stay small (<= 4k rows, 1-2k table slots): the interpreter runs
+the per-row insert loop in XLA CPU, so cost scales with rows x planes.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from trino_tpu.batch import batch_from_numpy, batch_to_numpy
+from trino_tpu.ops import pallas_hash as ph
+from trino_tpu.ops.aggregate import (AggSpec, key_pack_plan,
+                                     sort_group_aggregate)
+
+
+def rows_of(batch):
+    live = np.asarray(batch.live)
+    out = []
+    for i in np.nonzero(live)[0]:
+        out.append(tuple(
+            (np.asarray(c.data)[i].item()
+             if np.asarray(c.valid)[i] else None)
+            for c in batch.columns))
+    return sorted(out, key=repr)
+
+
+def run_hash(batch, keys, aggs, slots=1024):
+    plan = key_pack_plan(batch, keys)
+    assert plan is not None
+    kmins, bits = plan
+    return ph.hash_group_aggregate(batch, jnp.asarray(kmins), keys,
+                                   bits, aggs, slots, "interpret")
+
+
+AGGS5 = (AggSpec("sum", 1), AggSpec("count", 1), AggSpec("min", 1),
+         AggSpec("max", 1), AggSpec("count_star", None))
+
+
+def test_hash_agg_bitexact_vs_sort_with_nulls():
+    """Random int keys (negative too), NULL keys AND NULL values: every
+    aggregate state matches the sort kernel bit for bit."""
+    rng = np.random.default_rng(7)
+    n = 2000
+    keys = rng.integers(-40, 160, n)
+    vals = rng.integers(-(1 << 52), 1 << 52, n)
+    batch = batch_from_numpy(
+        [keys, vals], valids=[rng.random(n) > 0.1, rng.random(n) > 0.1])
+    out, esc, occ = run_hash(batch, (0,), AGGS5)
+    assert int(esc) == 0
+    ref = sort_group_aggregate(batch, (0,), AGGS5, 1024)
+    assert rows_of(out) == rows_of(ref)
+    assert int(occ) == len(rows_of(ref))
+
+
+def test_hash_agg_multikey_packed_and_null_groups():
+    """Two packed key columns; NULL keys form their own groups (SQL
+    GROUP BY treats NULLs as equal), exactly like the sort path."""
+    rng = np.random.default_rng(8)
+    n = 2000
+    k1 = rng.integers(0, 12, n)
+    k2 = rng.integers(-5, 7, n)
+    v = rng.integers(-1000, 1000, n)
+    batch = batch_from_numpy(
+        [k1, k2, v], valids=[rng.random(n) > 0.2, rng.random(n) > 0.2,
+                             None])
+    aggs = (AggSpec("sum", 2), AggSpec("count_star", None))
+    out, esc, _ = run_hash(batch, (0, 1), aggs)
+    assert int(esc) == 0
+    ref = sort_group_aggregate(batch, (0, 1), aggs, 1024)
+    assert rows_of(out) == rows_of(ref)
+
+
+def _np_splitmix64(x):
+    with np.errstate(over="ignore"):
+        z = x + np.uint64(0x9E3779B97F4A7C15)
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return z ^ (z >> np.uint64(31))
+
+
+def test_hash_agg_crafted_collision_keys():
+    """Keys crafted so many distinct values share ONE home slot: linear
+    probing must keep them distinct groups (equality is on the exact
+    packed key, so hash collisions can never merge groups)."""
+    slots = 1024
+    # packed word for key k with kmin=0 is k+1 (include 0 so kmin=0)
+    cands = np.arange(0, 60000, dtype=np.int64)
+    with np.errstate(over="ignore"):
+        home = (_np_splitmix64(
+            (cands + 1).view(np.uint64) + ph._SLOT_SEED)
+            % np.uint64(slots)).astype(np.int64)
+    target = home[0]
+    all_colliders = cands[home == target]
+    assert len(all_colliders) >= 8      # the craft actually collided
+    colliders = all_colliders[:ph.MAX_PROBES - 4]
+    keys = np.concatenate([[0], np.repeat(colliders, 3)])
+    vals = np.arange(len(keys), dtype=np.int64) * 7 - 11
+    batch = batch_from_numpy([keys, vals])
+    aggs = (AggSpec("sum", 1), AggSpec("count_star", None))
+    out, esc, occ = run_hash(batch, (0,), aggs, slots)
+    assert int(esc) == 0
+    ref = sort_group_aggregate(batch, (0,), aggs, 1024)
+    assert rows_of(out) == rows_of(ref)
+    # a chain DEEPER than the probe bound must escape, never drop rows
+    if len(all_colliders) > ph.MAX_PROBES + 4:
+        # keep key 0 so kmin stays 0 and the crafted homes still hold
+        dk = np.concatenate([[0], all_colliders[:ph.MAX_PROBES + 4]])
+        deep = batch_from_numpy([dk, np.ones(len(dk), dtype=np.int64)])
+        _, esc2, _ = run_hash(deep, (0,), aggs, slots)
+        assert int(esc2) > 0
+
+
+def test_hash_agg_overflow_escape_counts():
+    """More distinct keys than the load cap: the kernel reports the
+    breach instead of dropping rows silently."""
+    n = 900
+    keys = np.arange(n, dtype=np.int64)      # 900 > 640 = 1024 * 5/8
+    vals = np.ones(n, dtype=np.int64)
+    batch = batch_from_numpy([keys, vals])
+    out, esc, occ = run_hash(batch, (0,), (AggSpec("sum", 1),), 1024)
+    assert int(esc) > 0
+    assert int(occ) <= 1024 * ph.LOAD_NUM // ph.LOAD_DEN
+
+
+def test_executor_escape_partitions_and_reenters():
+    """The executor's escape chain: overflow -> radix partition by the
+    spill tier's splitmix64 -> per-partition re-entry, bit-exact."""
+    from trino_tpu.exec.executor import Executor
+    from trino_tpu.catalog import default_catalog
+    ex = Executor(default_catalog())
+    ex.enable_pallas_hash = "true"        # interpret on CPU
+    ex.hash_table_slots = 1024
+    rng = np.random.default_rng(9)
+    n = 3000
+    keys = rng.integers(0, 1500, n)       # ~1400 groups > 640 cap
+    vals = rng.integers(-(1 << 40), 1 << 40, n)
+    batch = batch_from_numpy([keys, vals],
+                             valids=[rng.random(n) > 0.05, None])
+    aggs = (AggSpec("sum", 1), AggSpec("count_star", None))
+    out = ex.try_hash_group_agg(batch, (0,), aggs, est_groups=1500)
+    assert out is not None
+    assert ex.stats.hash_agg_escapes == 1
+    ref = sort_group_aggregate(batch, (0,), aggs, 2048)
+    assert rows_of(out) == rows_of(ref)
+
+
+def test_merge_group_aggregate_hash_partial_merge():
+    """The chunked driver's FINAL step routes hash-strategy partials
+    through the hash-partial merge; states merge exactly."""
+    from types import SimpleNamespace
+    from trino_tpu.exec.executor import Executor
+    from trino_tpu.catalog import default_catalog
+    ex = Executor(default_catalog())
+    ex.enable_pallas_hash = "true"
+    rng = np.random.default_rng(10)
+    # two partial pages: (key, sum_state, count_state)
+    pages = []
+    for seed in (1, 2):
+        k = rng.integers(0, 50, 400)
+        s = rng.integers(-(1 << 30), 1 << 30, 400)
+        c = rng.integers(1, 5, 400)
+        pages.append(batch_from_numpy([k, s, c]))
+    from trino_tpu.exec.executor import concat_batches
+    merged = concat_batches(*pages)
+    node = SimpleNamespace(strategy="hash", group_keys=(0,))
+    merge_aggs = (AggSpec("sum", 1), AggSpec("sum", 2))
+    out = ex.merge_group_aggregate(node, merged, merge_aggs, 1024)
+    ref = sort_group_aggregate(merged, (0,), merge_aggs, 1024)
+    assert rows_of(out) == rows_of(ref)
+    node2 = SimpleNamespace(strategy="sort", group_keys=(0,))
+    out2 = ex.merge_group_aggregate(node2, merged, merge_aggs, 1024)
+    assert rows_of(out2) == rows_of(ref)
+
+
+# -- session-level: typed keys, DISTINCT fallback, spill composition -------
+
+@pytest.fixture(scope="module")
+def hash_session():
+    from trino_tpu.catalog import Catalog, default_catalog
+    from trino_tpu.connectors.memory import MemoryConnector
+    cat = default_catalog()
+    cat.register("m", MemoryConnector())
+    from trino_tpu.exec.session import Session
+    s = Session(catalog=cat, default_schema="tiny")
+    s.execute("CREATE TABLE m.s.t AS SELECT o_orderdate AS d, "
+              "o_orderpriority AS pr, o_totalprice AS v, "
+              "o_custkey AS k FROM orders WHERE o_orderkey <= 1600")
+    return s
+
+
+def _hash_on(s, slots=2048):
+    s.execute("SET SESSION enable_pallas_hash = true")
+    s.execute("SET SESSION hash_agg_mode = force")
+    s.execute(f"SET SESSION hash_table_slots = {slots}")
+
+
+def _hash_off(s):
+    s.execute("SET SESSION enable_pallas_hash = false")
+    s.execute("SET SESSION hash_agg_mode = auto")
+    s.execute("SET SESSION hash_table_slots = 0")
+
+
+def test_session_hash_agg_date_and_decimal_keys(hash_session):
+    """Date keys, decimal(HALF_UP) AVG and sum: hash-forced results are
+    row-identical to the default (sort) plan."""
+    s = hash_session
+    q = ("SELECT d, count(*), sum(v), avg(v) FROM m.s.t "
+         "GROUP BY d ORDER BY d")
+    _hash_off(s)
+    ref = s.execute(q).rows
+    _hash_on(s)
+    got = s.execute(q).rows
+    _hash_off(s)
+    assert s.executor.stats.hash_agg_calls >= 1
+    assert got == ref
+
+
+def test_session_hash_agg_varchar_dict_keys(hash_session):
+    """Varchar keys ride their dictionary codes through the hash table;
+    decoded strings match the sort plan."""
+    s = hash_session
+    q = ("SELECT pr, count(*), min(k), max(k) FROM m.s.t "
+         "GROUP BY pr ORDER BY pr")
+    _hash_off(s)
+    ref = s.execute(q).rows
+    _hash_on(s)
+    got = s.execute(q).rows
+    assert s.executor.strategy_decisions.get("AggregateNode") == "hash"
+    _hash_off(s)
+    assert got == ref
+
+
+def test_session_distinct_aggregate_routes_to_sort(hash_session):
+    """DISTINCT aggregates are outside the kernel's contract: even
+    under hash_agg_mode=force the planner keeps the sort strategy and
+    results stay exact."""
+    s = hash_session
+    q = ("SELECT pr, count(DISTINCT k) FROM m.s.t "
+         "GROUP BY pr ORDER BY pr")
+    _hash_off(s)
+    ref = s.execute(q).rows
+    _hash_on(s)
+    got = s.execute(q).rows
+    assert s.executor.strategy_decisions.get("AggregateNode") == "sort"
+    _hash_off(s)
+    assert got == ref
+
+
+def test_session_hash_agg_spill_composition(hash_session):
+    """Overflow-escape + host-spill composition: the round-9 spill tier
+    radix-partitions the aggregation with the SAME splitmix64
+    partitioner the hash kernel's escape path uses, so spilled
+    partitions re-enter the kernel — bit-exact, 0 wrong rows."""
+    import time as _time
+    from trino_tpu.exec.spill import spill_aggregate
+    from trino_tpu.planner import logical as L
+    from trino_tpu.planner.optimizer import prune_plan
+    s = hash_session
+    _hash_on(s, slots=1024)
+    s._apply_executor_properties(_time.monotonic())
+    _stmt, rel = s.plan("SELECT k, count(*), sum(v) FROM m.s.t "
+                        "GROUP BY k")
+    root = prune_plan(rel.node)
+
+    def find_agg(node):
+        if isinstance(node, L.AggregateNode):
+            return node
+        for c in L.children(node):
+            got = find_agg(c)
+            if got is not None:
+                return got
+        return None
+
+    agg = find_agg(root)
+    assert agg is not None and agg.strategy == "hash"
+    ex = s.executor
+    calls0 = ex.stats.hash_agg_calls
+    out = spill_aggregate(ex, agg)          # the 25%-pool retry path
+    spilled = ex.stats.spilled_aggregations
+    # resident reference with the kernel OFF: the spilled partitions'
+    # hash outputs must match the sort path exactly
+    ex.enable_pallas_hash = "false"
+    ref = ex.run(agg)
+    _hash_off(s)
+    assert out is not None
+    assert spilled >= 1
+    assert ex.stats.hash_agg_calls > calls0   # partitions re-entered
+    assert rows_of(out) == rows_of(ref)
+
+
+def test_explain_carries_strategy_lines(hash_session):
+    s = hash_session
+    _hash_on(s)
+    rows = [r[0] for r in s.execute(
+        "EXPLAIN SELECT k, count(*) FROM m.s.t GROUP BY k").rows]
+    _hash_off(s)
+    assert any(r.startswith("agg strategy: hash") for r in rows)
+    rows2 = [r[0] for r in s.execute(
+        "EXPLAIN SELECT c_name, o_orderdate FROM customer, orders "
+        "WHERE c_custkey = o_custkey").rows]
+    assert any(r.startswith("join strategy:") for r in rows2)
+
+
+def test_strategy_decision_metrics_move():
+    from trino_tpu.metrics import (AGG_STRATEGY_DECISIONS,
+                                   JOIN_STRATEGY_DECISIONS)
+    # pre-initialized families (lint also enforces this)
+    for strat in ("direct", "sort", "hash"):
+        assert AGG_STRATEGY_DECISIONS.has_sample(strategy=strat)
+    for strat in ("dense-lut", "hybrid-hash"):
+        assert JOIN_STRATEGY_DECISIONS.has_sample(strategy=strat)
+    from trino_tpu.exec.session import Session
+    s = Session(default_schema="tiny")
+    before = AGG_STRATEGY_DECISIONS.value(strategy="direct")
+    jsnap = {st: JOIN_STRATEGY_DECISIONS.value(strategy=st)
+             for st in ("dense-lut", "sort-merge", "sorted", "expand")}
+    s.execute("SELECT l_returnflag, count(*) FROM lineitem "
+              "GROUP BY l_returnflag")
+    s.execute("SELECT n_name FROM nation, region "
+              "WHERE n_regionkey = r_regionkey AND r_name = 'ASIA'")
+    assert AGG_STRATEGY_DECISIONS.value(strategy="direct") > before
+    ran = s.executor.strategy_decisions.get("JoinNode")
+    assert ran in jsnap
+    assert JOIN_STRATEGY_DECISIONS.value(strategy=ran) > jsnap[ran]
+
+
+def test_operator_stats_table_has_strategy_column():
+    """The system table carries the per-operator strategy column and
+    surfaces what the scheduler rollup recorded."""
+    from types import SimpleNamespace
+    from trino_tpu.server.system_connector import SystemConnector
+    sched = SimpleNamespace(operator_history=[
+        {"query_id": "q1", "operator": "AggregateNode", "rows": 10,
+         "wall_ms": 1.0, "calls": 1, "strategy": "hash"}])
+    state = SimpleNamespace(scheduler=sched)
+    conn = SystemConnector(state)
+    data = conn.get_table("runtime", "operator_stats")
+    names = [f.name for f in data.schema.fields]
+    assert "strategy" in names
+    # decode through the schema dictionary: the recorded value survives
+    j = names.index("strategy")
+    fld = data.schema.fields[j]
+    code = int(data.columns[j][0])
+    assert fld.dictionary[code] == "hash"
+
+
+# -- hybrid hash join ------------------------------------------------------
+
+def _join_rows(b):
+    return rows_of(b)
+
+
+def test_hash_join_kinds_bitexact_vs_sorted():
+    from trino_tpu.ops.join import join_unique_build
+    rng = np.random.default_rng(3)
+    nb, npr = 400, 1500
+    bkeys = rng.permutation(500000)[:nb].astype(np.int64)
+    build = batch_from_numpy([bkeys, rng.integers(0, 99, nb)],
+                             valids=[rng.random(nb) > 0.05, None])
+    pkeys = np.concatenate([bkeys[:200],
+                            rng.integers(0, 500000, npr - 200)])
+    probe = batch_from_numpy([pkeys.astype(np.int64),
+                              rng.integers(0, 9, npr)],
+                             valids=[rng.random(npr) > 0.05, None])
+    slots, fits = ph.join_table_slots(build.capacity)
+    assert fits
+    tkl, tkh, src, dup, esc = ph.build_join_table(build, (0,), slots,
+                                                  "interpret")
+    assert int(dup) == 0 and int(esc) == 0
+    for kind in ("inner", "left", "semi", "anti"):
+        got = ph.hash_join_probe(probe, build, tkl, tkh, src, (0,),
+                                 (0,), kind, "off")
+        ref, _ = join_unique_build(probe, build, (0,), (0,), kind)
+        assert _join_rows(got) == _join_rows(ref), kind
+
+
+def test_hash_join_detects_duplicate_build_keys():
+    build = batch_from_numpy(
+        [np.array([7, 7, 9, 11], dtype=np.int64),
+         np.arange(4, dtype=np.int64)])
+    tkl, tkh, src, dup, esc = ph.build_join_table(build, (0,), 1024,
+                                                  "interpret")
+    assert int(dup) == 1 and int(esc) == 0
+
+
+def test_executor_hash_join_partitioned_degrade():
+    """Build bigger than the pinned table: the hybrid path partitions
+    both sides by the spill partitioner and joins per partition —
+    bit-exact vs the sorted kernel, duplicates handled by expansion."""
+    from trino_tpu.catalog import default_catalog
+    from trino_tpu.exec.executor import Executor
+    from trino_tpu.planner import logical as L
+    from trino_tpu.types import BIGINT
+    ex = Executor(default_catalog())
+    ex.enable_pallas_hash = "true"
+    ex.hash_table_slots = 1024
+    rng = np.random.default_rng(4)
+    nb, npr = 1500, 2000                    # 1500 > 640 load cap
+    bkeys = rng.permutation(1 << 20)[:nb].astype(np.int64)
+    build = batch_from_numpy([bkeys, rng.integers(0, 99, nb)])
+    pkeys = np.concatenate([bkeys[:500],
+                            rng.integers(0, 1 << 20, npr - 500)])
+    probe = batch_from_numpy([pkeys.astype(np.int64),
+                              rng.integers(0, 9, npr)])
+    out_cols = tuple((f"c{i}", BIGINT) for i in range(4))
+    vals = L.ValuesNode(arrays=(), valids=(), num_rows=0, fields=(),
+                        output=out_cols[:2])
+    node = L.JoinNode(kind="inner", left=vals, right=vals,
+                      left_keys=(0,), right_keys=(0,), residual=None,
+                      build_unique=True, output=out_cols)
+    status, got = ex.try_hash_join(node, probe, build, allow_dup=False)
+    assert status == "ok"
+    assert ex.stats.hash_join_escapes == 1
+    from trino_tpu.ops.join import join_unique_build
+    ref, dup = join_unique_build(probe, build, (0,), (0,), "inner")
+    assert int(dup) == 0
+    assert _join_rows(got) == _join_rows(ref)
+
+
+def test_session_membership_join_via_hash(hash_session):
+    """Semi join whose build keys are too sparse for the dense LUT
+    (values x100000 push past the domain cap): the hash path carries
+    it; results match the sorted-fallback plan exactly."""
+    s = hash_session
+    s.execute("CREATE TABLE m.s.dim AS "
+              "SELECT c_custkey * 100000 AS bk FROM customer")
+    s.execute("CREATE TABLE m.s.f AS SELECT o_custkey * 100000 AS pk, "
+              "o_totalprice AS v FROM orders WHERE o_orderkey <= 4000")
+    q = ("SELECT count(*) FROM m.s.f "
+         "WHERE EXISTS (SELECT 1 FROM m.s.dim WHERE bk = pk)")
+    _hash_off(s)
+    ref = s.execute(q).rows
+    assert s.executor.strategy_decisions.get("JoinNode") == "sorted"
+    s.execute("SET SESSION enable_pallas_hash = true")
+    got = s.execute(q).rows
+    joined_via = s.executor.strategy_decisions.get("JoinNode")
+    _hash_off(s)
+    assert got == ref
+    assert joined_via == "hybrid-hash"
+
+
+# -- bench harness ---------------------------------------------------------
+
+def test_agg_micro_smoke_and_regression_series(tmp_path):
+    """--agg-micro CPU smoke writes a parseable round; the regression
+    gate reads agg-micro rounds as their own config series and flags an
+    injected 3x hash-kernel slowdown."""
+    import bench
+    out = bench.agg_micro(cardinalities=[16], rows=1 << 11, runs=1,
+                          out_path=str(tmp_path / "BENCH_agg_micro.json"))
+    assert out["records"] and "sort_ms" in out["records"][0]
+    parsed = bench.load_bench_round(str(tmp_path /
+                                        "BENCH_agg_micro.json"))
+    assert parsed and any(k.startswith("agg_micro_g") for k in parsed)
+    # synthetic series: 3 healthy rounds, then a 3x regression
+    base = {"metric": "agg_micro_ms",
+            "records": [{"groups": 16, "rows": 2048, "sort_ms": 9.0,
+                         "hash_ms": 3.0}]}
+    paths = []
+    for i in range(3):
+        p = tmp_path / f"r{i}.json"
+        p.write_text(json.dumps(base))
+        paths.append(str(p))
+    bad = {"metric": "agg_micro_ms",
+           "records": [{"groups": 16, "rows": 2048, "sort_ms": 9.0,
+                        "hash_ms": 9.5}]}
+    pbad = tmp_path / "r3.json"
+    pbad.write_text(json.dumps(bad))
+    ok, report = bench.check_regressions(paths)
+    assert ok
+    ok2, report2 = bench.check_regressions(paths + [str(pbad)])
+    assert not ok2
+    assert "agg_micro_g16" in report2["regressions"]

@@ -106,6 +106,10 @@ REQUIRED_FAMILIES = (
     "trino_tpu_router_decisions_total",
     "trino_tpu_microbatch_queries_total",
     "trino_tpu_microbatch_batches_total",
+    # round-12 TPU-native hash aggregation / hybrid hash join surface:
+    # the per-operator strategy gate's decision counters
+    "trino_tpu_agg_strategy_decisions_total",
+    "trino_tpu_join_strategy_decisions_total",
 )
 
 

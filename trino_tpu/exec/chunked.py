@@ -954,9 +954,9 @@ def merge_partials(executor, node: L.AggregateNode,
                    partials: List[Batch]) -> Batch:
     """FINAL step: concat partial states, re-aggregate with merge
     functions over the partial layout (keys at 0..n_keys-1, states
-    after)."""
-    from ..ops.aggregate import AggSpec, global_aggregate, \
-        sort_group_aggregate
+    after). Hash-strategy operators merge through the hash-partial
+    path (executor.merge_group_aggregate) instead of the sort merge."""
+    from ..ops.aggregate import AggSpec, global_aggregate
     from .executor import concat_batches
 
     merged = partials[0]
@@ -969,5 +969,5 @@ def merge_partials(executor, node: L.AggregateNode,
         return global_aggregate(merged, merge_aggs)
     capacity = max(node.out_capacity, pad_capacity(
         int(np.asarray(merged.live).sum())))
-    return sort_group_aggregate(merged, tuple(range(n_keys)), merge_aggs,
-                                capacity, executor.gather_mode())
+    return executor.merge_group_aggregate(node, merged, merge_aggs,
+                                          capacity)

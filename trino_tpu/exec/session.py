@@ -69,12 +69,28 @@ SESSION_PROPERTY_DEFAULTS = {
     # RAM/disk instead of failing
     "spill_enabled": (True, _bool),
     "spill_partitions": (8, int),
-    # Pallas MXU one-pass aggregation kernel (ops/pallas_agg.py)
-    "mxu_agg": (False, _bool),
+    # Pallas MXU one-pass aggregation kernel (ops/pallas_agg.py): auto
+    # picks it in its measured win region (direct aggregates with
+    # G >= Executor.MXU_AGG_MIN_GROUPS on TPU); true/false force
+    "mxu_agg": ("auto", lambda v: str(v).lower()),
     # Pallas tiled-gather probe kernel (ops/pallas_gather.py): auto =
     # on for TPU backends; true forces it (interpret mode on CPU, the
     # tier-1 test path); false = jnp.take everywhere
     "enable_pallas_gather": ("auto", lambda v: str(v).lower()),
+    # Pallas VMEM hash-table kernel (ops/pallas_hash.py): hash
+    # aggregation + hybrid hash join; same auto/true/false contract as
+    # the tiled gather (true = interpret mode on CPU, the tier-1 path)
+    "enable_pallas_hash": ("auto", lambda v: str(v).lower()),
+    # hash-agg table size in slots (0 = size from the group estimate;
+    # tests pin it small to exercise the overflow->partition escape)
+    "hash_table_slots": (0, int),
+    # planner hash-vs-sort gate: auto applies the rows-per-group rule,
+    # force always picks hash for grouped aggregates, off never does
+    "hash_agg_mode": ("auto", lambda v: str(v).lower()),
+    # auto mode thresholds: hash needs at least this many estimated
+    # groups AND at most this many estimated rows per group
+    "hash_agg_min_groups": (8192, int),
+    "hash_agg_max_rows_per_group": (64, int),
     # dense 'direct' aggregation bound (GroupByHash strategy choice);
     # capped by the kernel's compile-bound MAX_DIRECT_GROUPS
     "direct_agg_max_groups": (64, int),
@@ -200,6 +216,9 @@ class Session:
         kb = self.properties["stream_build_min_kb"]
         ex.stream_build_bytes = (kb << 10) if kb else None
         ex.enable_pallas_gather = self.properties["enable_pallas_gather"]
+        ex.enable_pallas_hash = self.properties["enable_pallas_hash"]
+        ex.hash_table_slots = self.properties["hash_table_slots"]
+        ex.enable_mxu_agg = self.properties["mxu_agg"]
         ex.profile = self.properties["enable_profiling"]
         if ex.profile:
             ex.node_stats = {}       # per-query attribution
@@ -286,6 +305,14 @@ class Session:
                 return f"[{s[0] * 1000:.2f}ms, {s[1]} rows] {est}"
         text = explain_text(root, annotate=annotate)
         rows = [(line,) for line in text.split("\n")]
+        # per-operator strategy verdicts (the aggregation/join gate's
+        # choice; after ANALYZE the executed strategy is authoritative)
+        try:
+            from .executor import explain_strategy_lines
+            for line in explain_strategy_lines(root, self.executor):
+                rows.append((line,))
+        except Exception:    # noqa: BLE001 — EXPLAIN must never fail
+            pass             # on a strategy estimate
         # CPU/TPU co-routing verdict (exec/router.py): what the serving
         # layer would do with this plan, and why
         try:

@@ -613,8 +613,7 @@ def merge_partial_pages(executor, node: L.AggregateNode,
     Otherwise: radix-partition the concatenated states by group key and
     merge each partition alone (states for one group always share a
     partition, so the merge is exact)."""
-    from ..ops.aggregate import AggSpec, global_aggregate, \
-        sort_group_aggregate
+    from ..ops.aggregate import AggSpec, global_aggregate
     from .chunked import MERGE_FUNC
     from .memory import batch_bytes
     nonempty = [p for p in pages if p[0] and len(p[0][0])]
@@ -638,12 +637,12 @@ def merge_partial_pages(executor, node: L.AggregateNode,
         return global_aggregate(merged, merge_aggs)
     total = _host_bytes(arrs, vals)
     # 3x: input + sort scratch + output headroom for the device merge
+    # (hash-strategy operators merge through the hash-partial path)
     if executor.pool.available() >= 3 * total:
         merged = batch_from_numpy(arrs, valids=vals)
         capacity = max(node.out_capacity, pad_capacity(len(arrs[0])))
-        return sort_group_aggregate(merged, tuple(range(n_keys)),
-                                    merge_aggs, capacity,
-                                    executor.gather_mode())
+        return executor.merge_group_aggregate(node, merged, merge_aggs,
+                                              capacity)
     count = _pick_partitions(executor, total)
     part = _partition_ids(arrs, vals, tuple(range(n_keys)), count)
     outs, outs_v = [], []
@@ -655,9 +654,8 @@ def merge_partial_pages(executor, node: L.AggregateNode,
                               valids=[v[m] for v in vals])
         executor.pool.reserve(batch_bytes(pb))
         try:
-            out = sort_group_aggregate(
-                pb, tuple(range(n_keys)), merge_aggs,
-                pad_capacity(int(m.sum())), executor.gather_mode())
+            out = executor.merge_group_aggregate(
+                node, pb, merge_aggs, pad_capacity(int(m.sum())))
             oa, ov = batch_to_numpy(out)
         finally:
             executor.pool.free(batch_bytes(pb))

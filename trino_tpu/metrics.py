@@ -383,6 +383,15 @@ MICROBATCH_BATCHES = REGISTRY.counter(
     "trino_tpu_microbatch_batches_total",
     "Micro-batch gather windows flushed as one dispatch")
 
+# per-operator strategy decisions (exec/executor.py gate: hash vs sort
+# vs direct aggregation, dense-LUT vs hybrid-hash vs merge joins)
+AGG_STRATEGY_DECISIONS = REGISTRY.counter(
+    "trino_tpu_agg_strategy_decisions_total",
+    "Aggregation strategy picked per operator execution", ("strategy",))
+JOIN_STRATEGY_DECISIONS = REGISTRY.counter(
+    "trino_tpu_join_strategy_decisions_total",
+    "Join strategy picked per operator execution", ("strategy",))
+
 # query history + latency-regression detection (server/history.py)
 LATENCY_REGRESSIONS = REGISTRY.counter(
     "trino_tpu_query_latency_regressions_total",
@@ -407,3 +416,7 @@ for _op in ("ScanNode", "JoinNode", "AggregateNode"):
     OPERATOR_COMPILE_MS.init_labels(operator=_op)
 for _target in ("host", "device"):
     ROUTER_DECISIONS.init_labels(target=_target)
+for _s in ("global", "direct", "mxu", "sort", "hash"):
+    AGG_STRATEGY_DECISIONS.init_labels(strategy=_s)
+for _s in ("dense-lut", "hybrid-hash", "sort-merge", "sorted", "expand"):
+    JOIN_STRATEGY_DECISIONS.init_labels(strategy=_s)

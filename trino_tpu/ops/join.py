@@ -18,6 +18,15 @@ Two build structures, chosen like BigintGroupByHash vs FlatGroupByHash
   build (0.2s at 15M — TPU sorts are fast) and `searchsorted` probes.
   searchsorted lowers to ~24 sequential gather rounds (30s at 60M probes)
   — usable for small/medium probes, pathological at scale, hence the LUT.
+- **hybrid hash** (sparse key domains the dense LUT refuses): the VMEM
+  hash-table kernel (`ops/pallas_hash.py`) builds a key -> min(row_id)
+  table (duplicates detected as inserted > occupied) and the probe walks
+  each linear chain with MAX_PROBES rounds of fused plane gathers —
+  bounded chains, so exhausting them is a definitive miss. Sits in the
+  unique-build ladder ahead of this fallback and carries semi/anti
+  membership joins; a build past the table's load cap degrades
+  partition-by-partition through the spill tier's radix fanout
+  (`Executor.try_hash_join`).
 
 Output-row mapping in the expansion kernels uses scatter + cummax
 (associative scan) instead of a second searchsorted for the same reason.

@@ -26,10 +26,14 @@ Reference role: compiled accumulators + GroupByHash's dense mode
 Measured (v5e, TPC-H SF1 q1 shape, G=6, A=6): 7.4ms vs 2.1ms for the XLA
 masked-reduction path — the custom-call boundary forces the hi/lo planes to
 materialize in HBM, which costs more than the fused single-pass XLA graph
-saves at small G. The kernel therefore sits behind the `mxu_agg` session
-property (off by default); its win region is larger group counts, where the
-XLA path's unrolled G x A reduction graph grows linearly while this stays
-one matmul pass.
+saves at small G; the win region is larger group counts, where the XLA
+path's unrolled G x A reduction graph grows linearly while this stays one
+matmul pass. The strategy gate therefore picks the kernel as the LARGE end
+of the direct-domain arm: `mxu_agg` = auto (default) routes direct
+aggregates with G >= Executor.MXU_AGG_MIN_GROUPS here on TPU backends and
+keeps the fused XLA graph below it; true/false force either way. (Round-12
+folded the kernel into the gate — it previously idled behind an opt-in
+nobody turned on.)
 """
 
 from __future__ import annotations

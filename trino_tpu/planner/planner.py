@@ -2288,6 +2288,12 @@ class Planner:
             return "global", (), 0
         if any_distinct:
             return "sort", (), DEFAULT_SORT_GROUPS   # needs the sort kernel
+        hmode = str(self.properties.get("hash_agg_mode", "auto")).lower()
+        if hmode == "force":
+            # ops/test knob: route every grouped aggregate through the
+            # hash kernel (DISTINCT stays on sort — kernel contract)
+            return "hash", (), self._sort_capacity(group_irs, scope,
+                                                   pre_node)
         domains = []
         for e in group_irs:
             d = self.domain_of(e, scope)
@@ -2309,7 +2315,25 @@ class Planner:
             est = self._input_rows_estimate(pre_node)
             if prod <= limit and (est is None or est >= prod * 64):
                 return "direct", tuple(domains), prod
-        return "sort", (), self._sort_capacity(group_irs, scope, pre_node)
+        capacity = self._sort_capacity(group_irs, scope, pre_node)
+        # hash vs sort: the rows-per-group gate ("Hash-Based vs.
+        # Sort-Based Group-By-Aggregate" — hash wins at HIGH cardinality,
+        # i.e. FEW rows per group, where the sort pays O(n log n) to
+        # discover mostly-distinct keys while the VMEM hash table pays
+        # one insert per row). The executor still falls back to sort at
+        # runtime when the kernel is off or the keys cannot pack.
+        if hmode not in ("off", "false", "0"):
+            est_groups, rows = self._group_rows_estimate(
+                group_irs, scope, pre_node)
+            min_groups = int(self.properties.get(
+                "hash_agg_min_groups", 8192))
+            max_rpg = float(self.properties.get(
+                "hash_agg_max_rows_per_group", 64))
+            if est_groups is not None and rows is not None and \
+                    est_groups >= min_groups and \
+                    rows <= est_groups * max_rpg:
+                return "hash", (), capacity
+        return "sort", (), capacity
 
     def _input_rows_estimate(self, pre_node) -> Optional[int]:
         """Rough input-row bound for strategy choice: the largest scan
@@ -2329,28 +2353,34 @@ class Planner:
         except Exception:      # noqa: BLE001 — stats are best-effort
             return None
 
+    def _group_rows_estimate(self, group_irs, scope: Scope, pre_node):
+        """(estimated group count, estimated input rows) from column
+        NDV stats — the shared input of the sort-capacity sizing and
+        the hash-vs-sort rows-per-group gate. (None, None) without
+        stats."""
+        cstats = self.chain_column_stats(pre_node.child) \
+            if isinstance(pre_node, L.ProjectNode) else None
+        if cstats is None:
+            return None, None
+        # group keys are the pre-projection's leading exprs
+        prod = 1.0
+        for e in group_irs:
+            s = cstats.get(e.index) if isinstance(e, ir.ColumnRef) \
+                else None
+            if s is None:
+                return None, None
+            prod *= max(1.0, s.ndv)
+        rows = self.estimate_rows(pre_node.child)
+        return min(prod, rows), rows
+
     def _sort_capacity(self, group_irs, scope: Scope, pre_node) -> int:
         """Size the sort-aggregation output from stats (NDV product capped
         by input rows) instead of a fixed default: every capacity retry is
         a fresh XLA compile plus a full re-sort, so landing right the
         first time is the difference between one device pass and four
         (GroupByHash's expectedSize estimation)."""
-        est = None
-        cstats = self.chain_column_stats(pre_node.child) \
-            if isinstance(pre_node, L.ProjectNode) else None
-        if cstats is not None:
-            # group keys are the pre-projection's leading exprs
-            prod = 1.0
-            for e in group_irs:
-                s = cstats.get(e.index) if isinstance(e, ir.ColumnRef) \
-                    else None
-                if s is None:
-                    prod = None
-                    break
-                prod *= max(1.0, s.ndv)
-            if prod is not None:
-                rows = self.estimate_rows(pre_node.child)
-                est = min(prod, rows)
+        est, _rows = self._group_rows_estimate(group_irs, scope,
+                                               pre_node)
         if est is None:
             return DEFAULT_SORT_GROUPS
         # 1.3x headroom, pow2 bucket (stable jit cache), floor at the

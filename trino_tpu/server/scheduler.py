@@ -358,7 +358,8 @@ class StageScheduler:
                      "wall_ms": d["wall_ms"], "calls": d["calls"],
                      "device_ms": d.get("device_ms", 0.0),
                      "host_ms": d.get("host_ms", 0.0),
-                     "compile_ms": d.get("compile_ms", 0.0)})
+                     "compile_ms": d.get("compile_ms", 0.0),
+                     "strategy": d.get("strategy", "")})
 
     def _record_task(self, task: "RemoteTask") -> None:
         """Fetch a finished task's terminal status — TaskStats + spans —
@@ -388,13 +389,15 @@ class StageScheduler:
                     acc = lq["operators"].setdefault(
                         op, {"rows": 0, "wall_ms": 0.0, "calls": 0,
                              "device_ms": 0.0, "host_ms": 0.0,
-                             "compile_ms": 0.0})
+                             "compile_ms": 0.0, "strategy": ""})
                     acc["rows"] += int(d.get("rows", 0))
                     acc["wall_ms"] += float(d.get("wallMs", 0.0))
                     acc["calls"] += int(d.get("calls", 0))
                     acc["device_ms"] += float(d.get("deviceMs", 0.0))
                     acc["host_ms"] += float(d.get("hostMs", 0.0))
                     acc["compile_ms"] += float(d.get("compileMs", 0.0))
+                    if d.get("strategy"):
+                        acc["strategy"] = d["strategy"]
         self._tracer().adopt(st.get("spans") or [])
 
     # -- eligibility + planning -------------------------------------------

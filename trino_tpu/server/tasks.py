@@ -394,10 +394,12 @@ class TaskManager:
         the terminal status (OperatorStats pyramid: operator -> task).
         On success paths this runs BEFORE the FINISHED transition so a
         consumer that sees the terminal state always sees final stats."""
+        strategies = getattr(self._executor, "strategy_decisions", {})
         ops = {op: {"wallMs": round(v[0], 3), "rows": int(v[1]),
                     "calls": int(v[2]), "deviceMs": round(v[3], 3),
                     "hostMs": round(v[4], 3),
-                    "compileMs": round(v[5], 3)}
+                    "compileMs": round(v[5], 3),
+                    "strategy": strategies.get(op, "")}
                for op, v in op_agg.items()}
         with task.lock:
             task.stats = {"rowsOut": task.rows_out,
