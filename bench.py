@@ -1256,6 +1256,17 @@ def main(argv=None):
                                              mad_k=args.mad_k)
             report["agg_micro"] = report2
             ok = ok and ok2
+        # the multichip trajectory gates as its own series too: each
+        # driver round lands a MULTICHIP_r*.json whose tail carries the
+        # dryrun's emitted JSON line (rounds before the partitioned-join
+        # step emitted none — they parse to nothing and are skipped)
+        mc_paths = sorted(_glob.glob("MULTICHIP_r*.json"))
+        if mc_paths:
+            ok3, report3 = check_regressions(mc_paths,
+                                             ratio=args.ratio,
+                                             mad_k=args.mad_k)
+            report["multichip"] = report3
+            ok = ok and ok3
         print(json.dumps(report), flush=True)
         return 0 if ok else 1
     threading.Thread(target=_watchdog, daemon=True).start()

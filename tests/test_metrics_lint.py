@@ -110,6 +110,11 @@ REQUIRED_FAMILIES = (
     # the per-operator strategy gate's decision counters
     "trino_tpu_agg_strategy_decisions_total",
     "trino_tpu_join_strategy_decisions_total",
+    # round-13 mesh-partitioned join surface: distribution decisions,
+    # batched dynamic-filter pruning, all_to_all exchange accounting
+    "trino_tpu_join_distribution_decisions_total",
+    "trino_tpu_dynamic_filter_rows_pruned_total",
+    "trino_tpu_mesh_repartition_bytes_total",
 )
 
 

@@ -73,6 +73,10 @@ class ExecStats:
     hash_join_calls: int = 0         # hybrid hash-join builds attempted
     hash_join_escapes: int = 0       # join builds that overflowed the
                                      # table and degraded partition-wise
+    mesh_partitioned_joins: int = 0  # joins hash-repartitioned over the
+                                     # mesh (parallel/dist_executor.py)
+    dynamic_filter_rows_pruned: int = 0   # probe rows cut by build-side
+                                          # bounds before the join ran
 
 
 class QueryDeadlineError(RuntimeError):
@@ -1877,6 +1881,13 @@ def explain_strategy_lines(root: L.PlanNode, executor) -> List[str]:
             else:
                 pred = "sort-merge"
             lines.append("join strategy: " + verdict(pred, "JoinNode"))
+            # mesh placement verdict (parallel/dist_executor.py gate):
+            # the planner's stats choice, overridden by what the mesh
+            # executor actually ran (a partitioned ask can degrade to
+            # broadcast on shape/skew grounds)
+            dist = getattr(node, "distribution", "auto")
+            lines.append("join distribution: "
+                         + verdict(dist, "JoinDistribution"))
         for c in L.children(node):
             walk(c)
 

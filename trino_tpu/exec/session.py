@@ -102,6 +102,10 @@ SESSION_PROPERTY_DEFAULTS = {
     "query_max_run_time_s": (0.0, float),
     # build-side min/max pruning of probe scans (ENABLE_DYNAMIC_FILTERING)
     "dynamic_filtering": (True, _bool),
+    # escape hatch for the batched mesh filter collectives; the old
+    # mid-execution rendezvous deadlock (q77) is gone by construction,
+    # this only exists to isolate regressions
+    "mesh_dynamic_filtering": (True, _bool),
     # gather-free sort-merge unique join at small shapes (compile-cost
     # gated regardless; this disables it outright)
     "merge_join": (True, _bool),
@@ -208,6 +212,8 @@ class Session:
         ex.enable_spill = self.properties["spill_enabled"]
         ex.spill_partitions = self.properties["spill_partitions"]
         ex.enable_dynamic_filtering = self.properties["dynamic_filtering"]
+        ex.mesh_dynamic_filtering = \
+            self.properties["mesh_dynamic_filtering"]
         ex.enable_merge_join = self.properties["merge_join"]
         ex.scan_cache_max_bytes = \
             self.properties["scan_cache_max_mb"] << 20

@@ -392,6 +392,21 @@ JOIN_STRATEGY_DECISIONS = REGISTRY.counter(
     "trino_tpu_join_strategy_decisions_total",
     "Join strategy picked per operator execution", ("strategy",))
 
+# mesh join distribution (parallel/dist_executor.py gate: replicate the
+# build over the mesh vs hash-repartition both sides) and the batched
+# dynamic-filter / repartition data plane it rides on
+JOIN_DISTRIBUTION_DECISIONS = REGISTRY.counter(
+    "trino_tpu_join_distribution_decisions_total",
+    "Join distribution picked per mesh join execution", ("mode",))
+DYNAMIC_FILTER_ROWS_PRUNED = REGISTRY.counter(
+    "trino_tpu_dynamic_filter_rows_pruned_total",
+    "Probe rows pruned by build-side dynamic-filter bounds before the "
+    "join ran")
+MESH_REPARTITION_BYTES = REGISTRY.counter(
+    "trino_tpu_mesh_repartition_bytes_total",
+    "Bytes moved through all_to_all repartition exchanges by "
+    "mesh-partitioned joins")
+
 # query history + latency-regression detection (server/history.py)
 LATENCY_REGRESSIONS = REGISTRY.counter(
     "trino_tpu_query_latency_regressions_total",
@@ -420,3 +435,5 @@ for _s in ("global", "direct", "mxu", "sort", "hash"):
     AGG_STRATEGY_DECISIONS.init_labels(strategy=_s)
 for _s in ("dense-lut", "hybrid-hash", "sort-merge", "sorted", "expand"):
     JOIN_STRATEGY_DECISIONS.init_labels(strategy=_s)
+for _m in ("broadcast", "partitioned"):
+    JOIN_DISTRIBUTION_DECISIONS.init_labels(mode=_m)

@@ -313,7 +313,12 @@ def gather_columns(tables, idx, fills=None, *, mode: str = "off"):
     """Fused multi-table gather: out[t][i] = tables[t][idx[i]] when
     0 <= idx[i] < W, else fills[t].  Bit-exact vs the jnp.take path;
     falls back to it when mode is 'off' or the shape gate fails.
-    `mode` and all shapes must be static (call under jit is fine)."""
+    `mode` and all shapes must be static (call under jit is fine).
+
+    This is also the SHARD-LOCAL entry point: inside a shard_map body
+    (the mesh-partitioned join's per-chip probe) every shape it sees is
+    the per-shard local shape, so the kernel gathers against the 1/N
+    table slice resident on its own chip — no cross-chip traffic."""
     tables = list(tables)
     if fills is None:
         fills = [0] * len(tables)

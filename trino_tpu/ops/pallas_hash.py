@@ -550,3 +550,21 @@ def hash_join_probe(probe: Batch, build: Batch, tk_lo, tk_hi, src,
     src_c = jnp.clip(found, 0, build.capacity - 1)
     return _gather_build_payload(probe, build, src_c, matched, pk,
                                  build_keys, kind, gather_mode)
+
+
+def shard_join(probe: Batch, build: Batch, probe_keys: tuple,
+               build_keys: tuple, kind: str, table_slots: int,
+               mode: str, gather_mode: str = "off"):
+    """Shard-local fused build + probe: the per-chip body of the
+    mesh-partitioned join (parallel/stages.partitioned_hash_join_step).
+    Deliberately NOT a jit entry of its own — it traces inside the
+    enclosing shard_map program, so build, probe, and their validation
+    counters stay in ONE XLA module with zero host round trips; the
+    caller psums (dup_rows, escaped) across the mesh and owns the
+    degrade decision (dup -> expansion join, escape -> skew, host
+    equi-join). Returns (joined, dup_rows, escaped)."""
+    tk_lo, tk_hi, src, dup_rows, escaped = build_join_table(
+        build, build_keys, table_slots, mode)
+    joined = hash_join_probe(probe, build, tk_lo, tk_hi, src,
+                             probe_keys, build_keys, kind, gather_mode)
+    return joined, dup_rows, escaped

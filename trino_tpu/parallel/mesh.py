@@ -25,6 +25,37 @@ from ..batch import Batch, Column
 AXIS = "workers"
 
 
+def shard_map(f, mesh: Mesh, in_specs, out_specs):
+    """Version-portable shard_map: newer jax exposes `jax.shard_map`
+    (replication checking via check_vma), older releases only
+    `jax.experimental.shard_map` (check_rep). Stage programs always
+    disable the replication checker — collective-carrying bodies with
+    manually asserted out_specs are exactly the case it rejects."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
+def pad_to_multiple(batch: Batch, multiple: int) -> Batch:
+    """Grow a batch's capacity to the next multiple of `multiple` with
+    dead rows (live=False, valid=False) so row-sharding divides evenly.
+    Dead padding is invisible to every kernel (the live mask gates all
+    semantics), so this is pure layout."""
+    cap = batch.capacity
+    pad = (-cap) % multiple
+    if pad == 0:
+        return batch
+    cols = tuple(
+        Column(data=jnp.pad(c.data, [(0, pad)] + [(0, 0)] *
+                            (c.data.ndim - 1)),
+               valid=jnp.pad(c.valid, (0, pad)))
+        for c in batch.columns)
+    return Batch(columns=cols, live=jnp.pad(batch.live, (0, pad)))
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = AXIS) -> Mesh:
     devs = jax.devices()
     n = n_devices if n_devices is not None else len(devs)

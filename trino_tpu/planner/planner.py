@@ -1111,6 +1111,11 @@ class Planner:
         forced = self.properties.get("join_distribution_type", "auto")
         if forced in ("broadcast", "partitioned"):
             distribution = forced
+        elif kind != "inner" or residual is not None or null_aware:
+            # only inner equi-joins can co-partition on the mesh today;
+            # predicting "partitioned" for shapes the executor must
+            # demote would make every EXPLAIN verdict a miss
+            distribution = "broadcast"
         else:
             threshold_mb = self.properties.get(
                 "broadcast_join_threshold_mb", 32)

@@ -359,7 +359,8 @@ class StageScheduler:
                      "device_ms": d.get("device_ms", 0.0),
                      "host_ms": d.get("host_ms", 0.0),
                      "compile_ms": d.get("compile_ms", 0.0),
-                     "strategy": d.get("strategy", "")})
+                     "strategy": d.get("strategy", ""),
+                     "distribution": d.get("distribution", "")})
 
     def _record_task(self, task: "RemoteTask") -> None:
         """Fetch a finished task's terminal status — TaskStats + spans —
@@ -389,7 +390,8 @@ class StageScheduler:
                     acc = lq["operators"].setdefault(
                         op, {"rows": 0, "wall_ms": 0.0, "calls": 0,
                              "device_ms": 0.0, "host_ms": 0.0,
-                             "compile_ms": 0.0, "strategy": ""})
+                             "compile_ms": 0.0, "strategy": "",
+                             "distribution": ""})
                     acc["rows"] += int(d.get("rows", 0))
                     acc["wall_ms"] += float(d.get("wallMs", 0.0))
                     acc["calls"] += int(d.get("calls", 0))
@@ -398,6 +400,8 @@ class StageScheduler:
                     acc["compile_ms"] += float(d.get("compileMs", 0.0))
                     if d.get("strategy"):
                         acc["strategy"] = d["strategy"]
+                    if d.get("distribution"):
+                        acc["distribution"] = d["distribution"]
         self._tracer().adopt(st.get("spans") or [])
 
     # -- eligibility + planning -------------------------------------------
