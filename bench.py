@@ -524,6 +524,165 @@ def agg_micro(cardinalities=None, rows=None, runs=3,
 
 
 # ---------------------------------------------------------------------------
+# --scan-micro: zone-map pruning + prefetch-pipeline scan-path microbench
+# ---------------------------------------------------------------------------
+
+def scan_micro(rows=None, runs=3, out_path="BENCH_scan_micro.json"):
+    """Microbenchmark of the round-14 scan path, three claims in one
+    artifact:
+
+    1. `records`: a clustered table swept across predicate
+       selectivities with zone-map pruning on vs off — end-to-end
+       engine walls (scan cache invalidated so the scan really runs),
+       zones/rows-pruned counters, and a bit-exactness check between
+       the two modes.
+    2. `decode`: the same data written as multi-stripe ORC (zlib) and
+       multi-row-group parquet, re-read with read-level `predicates=` —
+       decoded rows and skipped stripes/row groups per selectivity
+       prove statistics pruning cuts decode work (>= 10x at 0.01%).
+    3. `prefetch`: a multi-chunk aggregation with the fact cache
+       disabled so exec/chunked.py really decodes per chunk, at
+       prefetch_depth 0 (serial) vs 2 (pipelined); chunk_spans record
+       decode/compute/wall so overlap is visible (pipelined wall <
+       serial decode+compute sum).
+
+    Under JAX_PLATFORMS=cpu the shape shrinks to a smoke configuration
+    (walls meaningless there; the decode-reduction ratios are
+    measurement-grade anywhere since they count rows, not seconds)."""
+    import tempfile
+
+    import jax
+
+    from trino_tpu.batch import Field, Schema
+    from trino_tpu.connectors.parquetdir import flatten_table
+    from trino_tpu.connectors.tpch.datagen import TableData
+    from trino_tpu.exec.session import Session
+    from trino_tpu.formats.orc import read_orc_file, write_orc
+    from trino_tpu.formats.parquet import read_parquet_file, write_parquet
+    from trino_tpu.types import BIGINT, DOUBLE
+
+    on_tpu = jax.default_backend() == "tpu"
+    mode = "device" if on_tpu else "cpu"
+    if rows is None:
+        rows = (1 << 24) if on_tpu else (1 << 17)
+    zone_rows = max(1024, rows // 64)            # 64 zones / stripes
+    rng = np.random.default_rng(14)
+    selectivities = (0.0001, 0.01, 0.5, 1.0)
+
+    # clustered key -> tight zones; v is the aggregated payload
+    data = TableData("scan_micro", Schema((
+        Field("k", BIGINT), Field("v", DOUBLE))),
+        [np.arange(rows, dtype=np.int64),
+         rng.standard_normal(rows)])
+
+    s = Session()
+    s.catalog.connector("memory").create_table("default", "scan_micro",
+                                               data)
+    s.execute(f"SET SESSION zone_map_rows = {zone_rows}")
+
+    records = []
+    for sel in selectivities:
+        lim = max(1, int(rows * sel))
+        q = (f"SELECT count(*) AS c, sum(v) AS sv FROM "
+             f"memory.default.scan_micro WHERE k < {lim}")
+        rec = {"selectivity": sel, "rows": rows, "zone_rows": zone_rows}
+        results = {}
+        for setting in ("true", "false"):
+            s.execute(f"SET SESSION enable_zone_map_pruning = {setting}")
+            s.execute(q)                         # warm (compile + plan)
+            st = s.executor.stats
+            zones0, rowsp0 = st.scan_zones_pruned, st.scan_rows_pruned
+            walls = []
+            for _ in range(runs):
+                s.executor.invalidate_scan_cache()
+                t0 = time.monotonic()
+                results[setting] = s.execute(q).rows
+                walls.append(time.monotonic() - t0)
+            tag = "prune_on" if setting == "true" else "prune_off"
+            rec[f"{tag}_ms"] = round(min(walls) * 1000, 3)
+            if setting == "true":
+                rec["zones_pruned_per_run"] = \
+                    (st.scan_zones_pruned - zones0) // runs
+                rec["rows_pruned_per_run"] = \
+                    (st.scan_rows_pruned - rowsp0) // runs
+        rec["identical"] = results["true"] == results["false"]
+        records.append(rec)
+
+    # ---- claim 2: file-level decode reduction ---------------------------
+    tmp = tempfile.mkdtemp(prefix="scan_micro_")
+    flat = flatten_table(data, "bench")
+    orc_path = os.path.join(tmp, "scan_micro.orc")
+    pq_path = os.path.join(tmp, "scan_micro.parquet")
+    write_orc(orc_path, *flat, stripe_rows=zone_rows,
+              compression="zlib")
+    write_parquet(pq_path, *flat, row_group_rows=zone_rows)
+    decode = []
+    for sel in selectivities:
+        lim = max(1, int(rows * sel))
+        pred = {"k": (0, lim - 1)}
+        of = read_orc_file(orc_path, predicates=pred)
+        pf = read_parquet_file(pq_path, predicates=pred)
+        decode.append({
+            "selectivity": sel,
+            "orc_decoded_rows": int(len(of.columns[0])),
+            "orc_skipped_stripes": of.skipped_stripes,
+            "orc_total_stripes": of.total_stripes,
+            "parquet_decoded_rows": int(len(pf.columns[0])),
+            "parquet_skipped_row_groups": pf.skipped_row_groups,
+            "parquet_total_row_groups": pf.total_row_groups,
+            "decode_reduction_x": round(
+                rows / max(1, len(of.columns[0])), 1)})
+    for p in (orc_path, pq_path):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+    # ---- claim 3: prefetch overlap (chunked driver really decoding) ----
+    s2 = Session()
+    s2.executor.enable_fact_cache = False        # force per-chunk decode
+    s2.execute("SET SESSION spill_chunk_rows = 8192")
+    s2.execute("SET SESSION enable_zone_map_pruning = false")
+    pq_sql = ("SELECT l_returnflag, count(*) AS c, "
+              "sum(l_extendedprice) AS s FROM tpch.tiny.lineitem "
+              "GROUP BY l_returnflag ORDER BY l_returnflag")
+    prefetch = {}
+    pf_results = {}
+    for depth in (0, 2):
+        s2.execute(f"SET SESSION prefetch_depth = {depth}")
+        s2.execute(pq_sql)                       # warm (compile)
+        walls, spans = [], None
+        for _ in range(runs):
+            t0 = time.monotonic()
+            pf_results[depth] = s2.execute(pq_sql).rows
+            walls.append(time.monotonic() - t0)
+            spans = getattr(s2.executor, "chunk_spans", None)
+        ent = {"wall_ms": round(min(walls) * 1000, 3)}
+        if spans:
+            for k2, v2 in spans.items():
+                ent[k2] = round(v2, 4) if isinstance(v2, float) else v2
+        prefetch[f"depth{depth}"] = ent
+    prefetch["identical"] = pf_results.get(0) == pf_results.get(2)
+    d2 = prefetch["depth2"]
+    if "decode_s" in d2 and "compute_s" in d2 and "wall_s" in d2:
+        # the overlap headline: the pipelined loop's own wall vs the
+        # serialized sum of its decode+compute spans (same run, so no
+        # cross-run noise enters the comparison)
+        prefetch["serialized_sum_ms"] = round(
+            (d2["decode_s"] + d2["compute_s"]) * 1000, 3)
+        prefetch["overlap_win"] = \
+            d2["wall_s"] * 1000 < prefetch["serialized_sum_ms"]
+
+    out = {"metric": "scan_micro_ms", "device": str(jax.devices()[0]),
+           "mode": mode, "smoke": not on_tpu, "records": records,
+           "decode": decode, "prefetch": prefetch}
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out), flush=True)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # --chaos: seeded randomized fault-injection soak (round-7 robustness PR)
 # ---------------------------------------------------------------------------
 
@@ -1036,6 +1195,20 @@ def load_bench_round(path):
         doc = recs[-1] if recs else None
     if not isinstance(doc, dict):
         return None
+    if str(doc.get("metric", "")).startswith("scan_micro"):
+        # --scan-micro rounds gate on the pruned-scan walls per
+        # selectivity plus the two prefetch-pipeline walls: a slower
+        # pruned scan or pipeline in a later round reads as a
+        # regressed scan_micro_* config
+        out = {}
+        for r in doc.get("records", ()):
+            ms = r.get("prune_on_ms")
+            if ms is not None:
+                out[f"scan_micro_sel{r['selectivity']}"] = float(ms)
+        for depth, d in (doc.get("prefetch") or {}).items():
+            if isinstance(d, dict) and "wall_ms" in d:
+                out[f"scan_micro_prefetch_{depth}"] = float(d["wall_ms"])
+        return out or None
     if str(doc.get("metric", "")).startswith("agg_micro"):
         # --agg-micro rounds gate on the strategy the gate would pick
         # (hash where present, else sort): a slower kernel in a later
@@ -1198,6 +1371,10 @@ def build_parser():
                       help="hash vs sort vs direct aggregation "
                            "microbench across group cardinalities -> "
                            "BENCH_agg_micro.json")
+    mode.add_argument("--scan-micro", action="store_true",
+                      help="zone-map pruning + prefetch pipeline "
+                           "scan-path microbench across predicate "
+                           "selectivities -> BENCH_scan_micro.json")
     mode.add_argument("--check-regressions", action="store_true",
                       help="gate the newest BENCH_r*.json round against "
                            "prior rounds (median+MAD); exit 1 on a "
@@ -1237,6 +1414,9 @@ def main(argv=None):
     if args.agg_micro:
         agg_micro()
         return 0
+    if args.scan_micro:
+        scan_micro()
+        return 0
     if args.concurrency:
         rec = concurrency_soak(n_clients=args.clients,
                                queries_per_client=args.queries_per_client)
@@ -1256,6 +1436,15 @@ def main(argv=None):
                                              mad_k=args.mad_k)
             report["agg_micro"] = report2
             ok = ok and ok2
+        # the scan-path trajectory gates as its own series the same way
+        # (BENCH_scan_micro.json + later rounds' BENCH_scan_micro_r*.json)
+        scan_paths = sorted(_glob.glob("BENCH_scan_micro*.json"))
+        if scan_paths:
+            ok4, report4 = check_regressions(scan_paths,
+                                             ratio=args.ratio,
+                                             mad_k=args.mad_k)
+            report["scan_micro"] = report4
+            ok = ok and ok4
         # the multichip trajectory gates as its own series too: each
         # driver round lands a MULTICHIP_r*.json whose tail carries the
         # dryrun's emitted JSON line (rounds before the partitioned-join

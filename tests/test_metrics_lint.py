@@ -115,6 +115,12 @@ REQUIRED_FAMILIES = (
     "trino_tpu_join_distribution_decisions_total",
     "trino_tpu_dynamic_filter_rows_pruned_total",
     "trino_tpu_mesh_repartition_bytes_total",
+    # round-14 scan-path surface: zone-map pruning + the chunked-driver
+    # prefetch pipeline
+    "trino_tpu_scan_splits_pruned_total",
+    "trino_tpu_scan_zones_pruned_total",
+    "trino_tpu_scan_prefetch_buffers_in_use",
+    "trino_tpu_scan_prefetch_stall_seconds",
 )
 
 

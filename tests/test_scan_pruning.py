@@ -1,0 +1,331 @@
+"""Zone-map scan pruning tests (round 14).
+
+Reference pattern: the reader-level predicate pushdown tier
+(TupleDomain + stripe/row-group statistics in lib/trino-orc
+StripeReader and lib/trino-parquet PredicateUtils): scans skip row
+ranges the pushed-down predicate provably cannot match. Pruning is
+conservative-only and the residual filter always re-runs, so the
+load-bearing assertion throughout is BIT-EXACTNESS between pruning on
+and off — on the full TPC-H suite and on edge predicates chosen to
+break naive zone evaluation (NULL-only zones, decimal HALF_UP
+boundaries, varchar dictionary ranges, open-ended ranges, NOT/OR
+shapes that must not push down).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tpch_full import QUERIES
+from trino_tpu.batch import Field, Schema
+from trino_tpu.connectors.tpch.datagen import TableData
+from trino_tpu.exec.session import Session
+from trino_tpu.metrics import SCAN_SPLITS_PRUNED, SCAN_ZONES_PRUNED
+from trino_tpu.types import BIGINT, DATE, VARCHAR, decimal
+
+ZONE_ROWS = 2048          # tiny-scale tables span many zones
+
+
+@pytest.fixture(scope="module")
+def session():
+    s = Session(default_schema="tiny")
+    s.execute(f"SET SESSION zone_map_rows = {ZONE_ROWS}")
+    # the host route never consults zone maps; force the device path so
+    # pruning really executes under every query below
+    s.execute("SET SESSION routing_mode = device")
+    return s
+
+
+def run_both(s, sql):
+    """Execute with pruning on then off; returns (on_rows, off_rows)."""
+    s.execute("SET SESSION enable_zone_map_pruning = true")
+    on = s.execute(sql).rows
+    s.execute("SET SESSION enable_zone_map_pruning = false")
+    off = s.execute(sql).rows
+    s.execute("SET SESSION enable_zone_map_pruning = true")
+    return on, off
+
+
+# ---------------------------------------------------------------------------
+# full TPC-H: pruning on == pruning off, bit for bit
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("qnum", sorted(QUERIES))
+def test_tpch_bit_exact_pruning_on_vs_off(session, qnum):
+    on, off = run_both(session, QUERIES[qnum])
+    assert on == off
+
+
+# ---------------------------------------------------------------------------
+# edge predicates on a purpose-built table
+# ---------------------------------------------------------------------------
+
+N = 8192
+EDGE_ZONE_ROWS = 1024
+
+
+@pytest.fixture(scope="module")
+def edge_session():
+    """Memory table with one all-NULL leading zone, clustered bigint /
+    decimal / date / varchar columns (zone maps are built at insert
+    time by the memory connector)."""
+    s = Session()
+    s.execute(f"SET SESSION zone_map_rows = {EDGE_ZONE_ROWS}")
+    s.execute("SET SESSION routing_mode = device")
+    k = np.arange(N, dtype=np.int64)
+    # DECIMAL(12,2): values ...,-0.05, 0.00, 0.05,... — midpoints where
+    # HALF_UP rounding drift would show up immediately
+    d = (np.arange(N, dtype=np.int64) - N // 2) * 5
+    dt = (10957 + (np.arange(N, dtype=np.int32) // 32)).astype(np.int32)
+    pool = tuple(f"s{i:04d}" for i in range(64))
+    codes = (np.arange(N) * 64 // N).astype(np.int32)   # clustered codes
+    valids = [np.ones(N, dtype=np.bool_) for _ in range(4)]
+    valids[0][:EDGE_ZONE_ROWS] = False                  # NULL-only zone
+    data = TableData("edge", Schema((
+        Field("k", BIGINT), Field("d", decimal(12, 2)),
+        Field("dt", DATE), Field("v", VARCHAR, dictionary=pool))),
+        [k, d, dt, codes], valids=valids)
+    s.catalog.connector("memory").create_table("default", "edge", data)
+    return s
+
+
+EDGE_PREDICATES = [
+    # NULL-only zone: k IS NULL there; no predicate on k may emit it
+    "k >= 0",
+    "k < 1500",
+    # open-ended ranges
+    "k > 7000",
+    "k <= 100",
+    # decimal HALF_UP boundary values (exact scaled-int compares)
+    "d < 0.05",
+    "d <= 0.05",
+    "d = 0.05",
+    "d > -0.05 AND d < 0.10",
+    # dates
+    "dt >= DATE '2000-03-01'",
+    "dt BETWEEN DATE '2000-01-15' AND DATE '2000-02-15'",
+    # varchar ranges through the dictionary-predicate path
+    "v >= 's0050'",
+    "v BETWEEN 's0010' AND 's0020'",
+    "v = 's0001'",
+]
+
+
+@pytest.mark.parametrize("pred", EDGE_PREDICATES)
+def test_edge_predicates_bit_exact(edge_session, pred):
+    sql = (f"SELECT count(*) AS c, min(k) AS mn, max(k) AS mx "
+           f"FROM memory.default.edge WHERE {pred}")
+    on, off = run_both(edge_session, sql)
+    assert on == off
+
+
+def test_null_zone_never_matches(edge_session):
+    """Rows in the all-NULL zone fail every comparison — with pruning on
+    AND off (3VL at the residual filter), so counts exclude them."""
+    on, off = run_both(
+        edge_session,
+        "SELECT count(*) AS c FROM memory.default.edge WHERE k >= 0")
+    assert on == off == [(N - EDGE_ZONE_ROWS,)]
+
+
+def test_selective_query_prunes_zones(edge_session):
+    s = edge_session
+    s.execute("SET SESSION enable_zone_map_pruning = true")
+    before_metric = SCAN_ZONES_PRUNED.value()
+    before = s.executor.stats.scan_zones_pruned
+    s.executor.invalidate_scan_cache()
+    s.execute("SELECT count(*) AS c FROM memory.default.edge "
+              "WHERE k > 8000")
+    assert s.executor.stats.scan_zones_pruned > before
+    assert SCAN_ZONES_PRUNED.value() > before_metric
+
+
+NO_PUSHDOWN_PREDICATES = [
+    # disjunction across columns: not a conjunctive single-column range
+    "k < 100 OR dt > DATE '2000-03-01'",
+    # NOT of a range: conservatively not pushed
+    "NOT (k < 5000)",
+    # arithmetic over the column: not a bare column compare
+    "k + 1 < 100",
+]
+
+
+@pytest.mark.parametrize("pred", NO_PUSHDOWN_PREDICATES)
+def test_non_pushable_shapes_stay_correct(edge_session, pred):
+    sql = (f"SELECT count(*) AS c FROM memory.default.edge "
+           f"WHERE {pred}")
+    on, off = run_both(edge_session, sql)
+    assert on == off
+    # and the planner did not claim a pushdown for these shapes
+    plan = "\n".join(r[0] for r in
+                     edge_session.execute("EXPLAIN " + sql).rows)
+    assert "pushdown=" not in plan
+
+
+# ---------------------------------------------------------------------------
+# observability: EXPLAIN pushdown annotation + EXPLAIN ANALYZE verdicts
+# ---------------------------------------------------------------------------
+
+def test_explain_shows_pushdown(session):
+    plan = "\n".join(r[0] for r in session.execute(
+        "EXPLAIN SELECT count(*) FROM lineitem "
+        "WHERE l_orderkey < 1000").rows)
+    assert "pushdown=" in plan
+
+
+def test_explain_analyze_reports_zone_pruning(session):
+    session.execute("SET SESSION enable_zone_map_pruning = true")
+    rows = session.execute(
+        "EXPLAIN ANALYZE SELECT count(*) FROM lineitem "
+        "WHERE l_orderkey < 1000").rows
+    text = "\n".join(r[0] for r in rows)
+    assert "pruned by zone maps" in text
+
+
+# ---------------------------------------------------------------------------
+# connector-level pruned decode (ORC stripes / parquet row groups)
+# ---------------------------------------------------------------------------
+
+def _clustered_table(n=16384):
+    rng = np.random.default_rng(5)
+    return TableData("t", Schema((
+        Field("k", BIGINT), Field("x", BIGINT))),
+        [np.arange(n, dtype=np.int64),
+         rng.integers(0, 100, n)])
+
+
+def test_orc_connector_pruned_decode(tmp_path):
+    from trino_tpu.connectors.orcdir import OrcConnector
+    from trino_tpu.connectors.parquetdir import flatten_table
+    from trino_tpu.formats.orc import write_orc
+    data = _clustered_table()
+    os.makedirs(tmp_path / "s")
+    write_orc(str(tmp_path / "s" / "t.orc"),
+              *flatten_table(data, "ORC"), stripe_rows=1024,
+              compression="zlib")
+    conn = OrcConnector(str(tmp_path))
+    pruned = conn.get_table_pruned("s", "t", {"k": (0, 999)})
+    assert pruned.skipped_stripes == 15
+    assert pruned.total_stripes == 16
+    assert pruned.num_rows == 1024
+    np.testing.assert_array_equal(pruned.columns[0],
+                                  np.arange(1024, dtype=np.int64))
+    # the predicate-specific result must not poison the table cache
+    full = conn.get_table("s", "t")
+    assert full.num_rows == data.num_rows
+
+
+def test_parquet_connector_pruned_decode(tmp_path):
+    from trino_tpu.connectors.parquetdir import (ParquetConnector,
+                                                 flatten_table)
+    from trino_tpu.formats.parquet import write_parquet
+    data = _clustered_table()
+    os.makedirs(tmp_path / "s")
+    write_parquet(str(tmp_path / "s" / "t.parquet"),
+                  *flatten_table(data, "parquet"), row_group_rows=1024)
+    conn = ParquetConnector(str(tmp_path))
+    pruned = conn.get_table_pruned("s", "t", {"k": (4096, 5000)})
+    assert pruned.skipped_row_groups == 15
+    assert pruned.total_row_groups == 16
+    assert pruned.num_rows == 1024           # only group 4 survives
+    full = conn.get_table("s", "t")
+    assert full.num_rows == data.num_rows
+
+
+# ---------------------------------------------------------------------------
+# distributed tier: the scheduler drops non-matching row-range splits
+# ---------------------------------------------------------------------------
+
+def test_scheduler_prunes_splits():
+    from trino_tpu.client.client import Client
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.worker import WorkerServer
+    session = Session(default_schema="tiny")
+    session.execute("SET SESSION zone_map_rows = 4096")
+    coord = CoordinatorServer(session).start()
+    coord.state.scheduler.split_rows = 8192
+    workers = [WorkerServer(f"worker-{i}", coord.uri,
+                            announce_interval_s=0.1,
+                            catalog=session.catalog).start()
+               for i in range(2)]
+    try:
+        deadline = time.time() + 5
+        while len(coord.state.active_nodes()) < 2 and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        sql = ("SELECT l_linestatus, count(*) AS c FROM lineitem "
+               "WHERE l_orderkey < 3000 GROUP BY l_linestatus "
+               "ORDER BY l_linestatus")
+        want = session.execute(sql).rows
+        before = SCAN_SPLITS_PRUNED.value()
+        client = Client(coord.uri, user="test")
+        r = client.execute(sql)
+        assert r.state == "FINISHED"
+        assert [tuple(row) for row in r.rows] == \
+            [tuple(row) for row in want]
+        assert coord.state.scheduler.stats.get("splits_pruned", 0) > 0
+        assert SCAN_SPLITS_PRUNED.value() > before
+        # the operator_stats rollup row carries the verdict
+        scans = [r for r in coord.state.scheduler.operator_history
+                 if r["operator"] == "TableScan" and
+                 r["strategy"].startswith("zone-pruned:")]
+        assert scans, "TableScan rollup should record split pruning"
+    finally:
+        for w in workers:
+            w.stop()
+        coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# ORC stripe statistics + ZLIB interop against a real reader/writer
+# ---------------------------------------------------------------------------
+
+def test_orc_zlib_round_trip_pyarrow_reads_ours(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    orc = pytest.importorskip("pyarrow.orc")
+    from trino_tpu.formats.orc import write_orc
+    n = 4096
+    rng = np.random.default_rng(9)
+    ints = rng.integers(-(1 << 40), 1 << 40, n)
+    dbls = rng.standard_normal(n)
+    strs = np.array([f"row{i % 97:03d}" for i in range(n)], dtype=object)
+    path = str(tmp_path / "ours.orc")
+    write_orc(path, ["i", "d", "s"], [ints, dbls, strs],
+              stripe_rows=1024, compression="zlib")
+    t = orc.read_table(path)
+    np.testing.assert_array_equal(t.column("i").to_numpy(), ints)
+    np.testing.assert_array_equal(t.column("d").to_numpy(), dbls)
+    assert t.column("s").to_pylist() == list(strs)
+    assert pa is not None
+
+
+def test_orc_stripe_stats_prune_pyarrow_file(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    orc = pytest.importorskip("pyarrow.orc")
+    from trino_tpu.formats.orc import read_orc_file
+    n = 16384
+    tbl = pa.table({"k": np.arange(n, dtype=np.int64)})
+    path = str(tmp_path / "theirs.orc")
+    orc.write_table(tbl, path, stripe_size=8 * 1024)
+    f = read_orc_file(path, predicates={"k": (0, 100)})
+    assert f.total_stripes > 1
+    assert f.skipped_stripes == f.total_stripes - 1
+    np.testing.assert_array_equal(
+        f.columns[0][:101], np.arange(101, dtype=np.int64))
+
+
+def test_orc_zlib_smaller_and_bit_exact(tmp_path):
+    from trino_tpu.connectors.orcdir import load_orc
+    from trino_tpu.connectors.parquetdir import flatten_table
+    from trino_tpu.formats.orc import write_orc
+    data = _clustered_table()
+    flat = flatten_table(data, "ORC")
+    raw, zl = str(tmp_path / "raw.orc"), str(tmp_path / "zl.orc")
+    write_orc(raw, *flat, stripe_rows=2048)
+    write_orc(zl, *flat, stripe_rows=2048, compression="zlib")
+    assert os.path.getsize(zl) < os.path.getsize(raw)
+    a, b = load_orc(raw, "t"), load_orc(zl, "t")
+    for ca, cb in zip(a.columns, b.columns):
+        np.testing.assert_array_equal(ca, cb)

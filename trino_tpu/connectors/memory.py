@@ -55,6 +55,17 @@ class MemoryConnector:
     def __init__(self):
         self._tables: Dict[Tuple[str, str], TableData] = {}
 
+    @staticmethod
+    def _note_zones(data: TableData) -> None:
+        """Eager insert-time zone maps (scans of file/generator tables
+        build theirs lazily). Every mutation stores a NEW TableData, so
+        noting it here also retires the previous version's zones."""
+        try:
+            from ..exec.zonemap import note_table
+            note_table(data)
+        except Exception:   # noqa: BLE001 — pruning is advisory only
+            pass
+
     def schema_names(self):
         return sorted({s for (s, _) in self._tables}) or ["default"]
 
@@ -69,6 +80,7 @@ class MemoryConnector:
                 return
             raise KeyError(f"table {schema}.{name} already exists")
         self._tables[key] = data
+        self._note_zones(data)
 
     def drop_table(self, schema: str, name: str,
                    if_exists: bool = False) -> None:
@@ -118,6 +130,7 @@ class MemoryConnector:
         self._tables[key] = TableData(
             t.name, Schema(tuple(new_fields)), new_cols,
             primary_key=(), valids=new_valids)
+        self._note_zones(self._tables[key])
         return len(arrays[0]) if arrays else 0
 
     def get_table(self, schema: str, table: str) -> TableData:

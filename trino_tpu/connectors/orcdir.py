@@ -16,14 +16,21 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..batch import Field, Schema
-from ..formats.orc import read_orc
+from ..formats.orc import read_orc_file
 from ..types import BIGINT, BOOLEAN, DOUBLE, TypeKind, VARCHAR
 from .tpch.datagen import TableData
 
 
-def load_orc(path: str, name: str) -> TableData:
+def load_orc(path: str, name: str,
+             predicates: Optional[dict] = None) -> TableData:
+    """Decode an ORC file into engine TableData. `predicates` (column
+    name -> (lo, hi) physical bounds) skips stripes whose statistics
+    prove no match; the result then holds only surviving stripes' rows
+    and records skipped_stripes/total_stripes for observability."""
     from ..types import DATE, decimal
-    names, columns, valids, logicals = read_orc(path)
+    f = read_orc_file(path, predicates)
+    names, columns, valids, logicals = \
+        f.names, f.columns, f.valids, f.logicals
     fields: List[Field] = []
     arrays: List[np.ndarray] = []
     out_valids: List[Optional[np.ndarray]] = []
@@ -63,8 +70,11 @@ def load_orc(path: str, name: str) -> TableData:
         out_valids.append(valid)
     if all(v is None for v in out_valids):
         out_valids = None
-    return TableData(name, Schema(tuple(fields)), arrays,
+    data = TableData(name, Schema(tuple(fields)), arrays,
                      valids=out_valids)
+    data.skipped_stripes = f.skipped_stripes
+    data.total_stripes = f.total_stripes
+    return data
 
 
 class OrcConnector:
@@ -103,12 +113,26 @@ class OrcConnector:
     def get_table_schema(self, schema: str, table: str) -> Schema:
         return self.get_table(schema, table).schema
 
+    def get_table_pruned(self, schema: str, table: str,
+                         ranges: dict) -> TableData:
+        """Predicate-pruned decode: stripes whose statistics cannot
+        match `ranges` are never decompressed or decoded. The result is
+        NOT cached as the table (its row set is predicate-specific);
+        callers own caching under a predicate-aware key."""
+        path = os.path.join(self._schema_dir(schema), f"{table}.orc")
+        if not os.path.isfile(path):
+            raise KeyError(f"orc table {schema}.{table} not found "
+                           f"({path})")
+        return load_orc(path, table, predicates=ranges)
 
-def export_table(data: TableData, path: str) -> None:
+
+def export_table(data: TableData, path: str,
+                 compression: str = "none") -> None:
     """Engine TableData -> ORC file (formats/orc.py write_orc), the
     write-parity twin of parquetdir.export_table (lib/trino-orc
     OrcWriter.java's role); flattening is shared with the parquet
-    exporter."""
+    exporter. `compression` is "none" or "zlib"."""
     from ..formats.orc import write_orc
     from .parquetdir import flatten_table
-    write_orc(path, *flatten_table(data, "ORC"))
+    write_orc(path, *flatten_table(data, "ORC"),
+              compression=compression)

@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..batch import Field, Schema
-from ..formats.parquet import read_parquet, write_parquet
+from ..formats.parquet import read_parquet_file, write_parquet
 from ..types import BIGINT, BOOLEAN, DOUBLE, INTEGER, TypeKind, VARCHAR
 from .tpch.datagen import TableData
 
@@ -37,9 +37,16 @@ def _pool_encode(values, mask, key=None):
     return codes, tuple(pool)
 
 
-def load_parquet(path: str, name: str) -> TableData:
+def load_parquet(path: str, name: str,
+                 predicates: Optional[dict] = None) -> TableData:
+    """Decode a parquet file into engine TableData. `predicates`
+    (column name -> (lo, hi) physical bounds) skips row groups whose
+    chunk statistics prove no match; the result then holds only the
+    surviving groups' rows and records skipped/total row groups."""
     from ..types import DATE, decimal
-    names, columns, valids, logicals = read_parquet(path)
+    f = read_parquet_file(path, predicates)
+    names, columns, valids, logicals = \
+        f.names, f.columns, f.valids, f.logicals
     fields: List[Field] = []
     arrays: List[np.ndarray] = []
     out_valids: List[Optional[np.ndarray]] = []
@@ -100,8 +107,11 @@ def load_parquet(path: str, name: str) -> TableData:
         out_valids.append(valid)
     if all(v is None for v in out_valids):
         out_valids = None
-    return TableData(name, Schema(tuple(fields)), arrays,
+    data = TableData(name, Schema(tuple(fields)), arrays,
                      valids=out_valids)
+    data.skipped_row_groups = f.skipped_row_groups
+    data.total_row_groups = f.total_row_groups
+    return data
 
 
 def flatten_table(data: TableData, fmt: str):
@@ -177,3 +187,16 @@ class ParquetConnector:
 
     def get_table_schema(self, schema: str, table: str) -> Schema:
         return self.get_table(schema, table).schema
+
+    def get_table_pruned(self, schema: str, table: str,
+                         ranges: dict) -> TableData:
+        """Predicate-pruned decode: row groups whose chunk statistics
+        cannot match `ranges` are never decompressed or decoded. The
+        result is NOT cached as the table (its row set is
+        predicate-specific); callers own caching under a
+        predicate-aware key."""
+        path = os.path.join(self._schema_dir(schema), f"{table}.parquet")
+        if not os.path.isfile(path):
+            raise KeyError(f"parquet table {schema}.{table} not found "
+                           f"({path})")
+        return load_parquet(path, table, predicates=ranges)

@@ -77,6 +77,13 @@ class ExecStats:
                                      # mesh (parallel/dist_executor.py)
     dynamic_filter_rows_pruned: int = 0   # probe rows cut by build-side
                                           # bounds before the join ran
+    scan_zones_pruned: int = 0       # zone-map row ranges skipped at scan
+                                     # materialization (exec/zonemap.py)
+    scan_rows_pruned: int = 0        # rows those zones would have decoded
+    scan_chunks_skipped: int = 0     # chunked-driver chunks skipped whole
+    scan_prefetched_chunks: int = 0  # chunks served from the prefetch
+                                     # pipeline (exec/chunked.py)
+    scan_prefetch_stalls: int = 0    # consumer waits on an unstaged chunk
 
 
 class QueryDeadlineError(RuntimeError):
@@ -165,9 +172,25 @@ class Executor:
         # session-property knobs (exec/session.py wires these per query)
         self.enable_dynamic_filtering = True
         self.enable_merge_join = True
+        # zone-map scan pruning (exec/zonemap.py): skip decoding /
+        # materializing row ranges the pushed-down scan predicate
+        # provably cannot match. Advisory — the residual filter always
+        # re-runs, so "off" is bit-exact with "on".
+        self.enable_zone_map_pruning = True
+        from .zonemap import DEFAULT_ZONE_ROWS
+        self.zone_map_rows = DEFAULT_ZONE_ROWS
+        # chunked-driver prefetch pipeline depth: how many decoded+staged
+        # chunks may sit ahead of the device (0 = the serial loop)
+        self.prefetch_depth = 2
+        # seeded FailureInjector (server/failureinjector.py) for chaos
+        # coverage of executor-side worker threads; None outside tests
+        self.failure_injector = None
         self.deadline: Optional[float] = None     # time.monotonic() cutoff
         self.scan_cache_max_bytes = 24 << 30      # LRU cap (device bytes)
         self._scan_cache_bytes: Dict[tuple, int] = {}
+        # zone-prune verdicts replayed on cache hits so EXPLAIN ANALYZE
+        # still renders the scan line for a cached (pruned) batch
+        self._scan_prune_info: Dict[tuple, str] = {}
         # build sides estimated above this stream chunk-wise through the
         # dense LUT instead of materializing on device (0/None = off)
         self.stream_build_bytes: Optional[int] = None
@@ -255,6 +278,19 @@ class Executor:
         cached counts would poison replay."""
         return Executor._NoDecisions(self)
 
+    def _scan_key(self, node) -> tuple:
+        """Scan-cache key. The pushed-down predicate participates only
+        when zone-map pruning is on: a pruned batch holds fewer rows than
+        the full table, so it must never be served to a different
+        predicate (or to the same scan with pruning disabled). Subclasses
+        that re-cache a scan (e.g. the mesh executor's sharded placement)
+        must use this same key so they replace the base entry instead of
+        duplicating it."""
+        pruning = node.predicate is not None and self.enable_zone_map_pruning
+        return (node.catalog, node.schema_name, node.table,
+                node.column_indices,
+                repr(node.predicate) if pruning else None)
+
     def invalidate_scan_cache(self) -> None:
         """Drop cached scans AND their byte accounting together — clearing
         only the OrderedDict leaves ghost sizes that permanently shrink the
@@ -262,6 +298,7 @@ class Executor:
         tables, so they drop too."""
         self._scan_cache.clear()
         self._scan_cache_bytes.clear()
+        self._scan_prune_info.clear()
         self.fact_cache.invalidate()
         # decision values never cache for mutable catalogs, but clearing
         # costs nothing and removes any doubt after DML
@@ -819,24 +856,31 @@ class Executor:
             self.stats.scans += 1
             self.stats.rows_scanned += data.num_rows
             return batch_from_numpy(arrays, valids=valids)
-        key = (node.catalog, node.schema_name, node.table,
-               node.column_indices)
+        pruning = node.predicate is not None and self.enable_zone_map_pruning
+        key = self._scan_key(node)
         hit = self._scan_cache.get(key)
         if hit is not None:
             self._scan_cache.move_to_end(key)     # LRU touch
+            info = self._scan_prune_info.get(key)
+            if info is not None:
+                self.strategy_decisions[f"TableScan[{node.table}]"] = info
             return hit
-        data = self.catalog.get_table(node.catalog, node.schema_name,
-                                      node.table)
+        data = self._scan_table_data(node, pruning)
         arrays = [data.columns[i] for i in node.column_indices]
         valids = None
         if data.valids is not None:
             valids = [data.valids[i] for i in node.column_indices]
+        if pruning:
+            arrays, valids, kept_rows = self._prune_scan_rows(
+                node, data, arrays, valids)
+        else:
+            kept_rows = data.num_rows
         if sum(getattr(a, "nbytes", 0) for a in arrays) > (64 << 20):
             from .device_cache import warm_transfer_path
             warm_transfer_path()
         batch = batch_from_numpy(arrays, valids=valids)
         self.stats.scans += 1
-        self.stats.rows_scanned += data.num_rows
+        self.stats.rows_scanned += kept_rows
         # bounded scan cache: evict least-recently-scanned tables so a
         # long-lived server's device memory stays flat (the round-2 cache
         # pinned every table ever scanned)
@@ -848,7 +892,84 @@ class Executor:
             total -= self._scan_cache_bytes.pop(old_key, 0)
         self._scan_cache[key] = batch
         self._scan_cache_bytes[key] = b
+        if pruning:
+            dec = self.strategy_decisions.get(f"TableScan[{node.table}]")
+            if dec is not None:
+                self._scan_prune_info[key] = dec
         return batch
+
+    def _scan_table_data(self, node: L.ScanNode, pruning: bool):
+        """Fetch the table, preferring a connector-side pruned decode
+        (ORC stripe / Parquet row-group skipping) when the scan carries a
+        pushed predicate, the connector supports it, and the full table
+        is not already decoded in its cache. Dictionary-encoded scan
+        columns disqualify the pruned path: a pruned decode rebuilds
+        string pools from surviving rows only, and those codes would
+        not line up with the dictionaries the plan was analyzed against."""
+        if pruning:
+            try:
+                conn = self.catalog.connector(node.catalog)
+            except KeyError:
+                conn = None
+            if conn is not None and \
+                    hasattr(conn, "get_table_pruned") and \
+                    (node.schema_name, node.table) not in \
+                    getattr(conn, "_cache", {}) and \
+                    all(node.table_schema.fields[i].dictionary is None
+                        for i in node.column_indices):
+                from .zonemap import column_ranges
+                ranges = column_ranges(node.predicate, node.column_indices,
+                                       node.table_schema)
+                if ranges:
+                    try:
+                        return conn.get_table_pruned(
+                            node.schema_name, node.table, ranges)
+                    except Exception:
+                        pass      # fall back to the full decode
+        return self.catalog.get_table(node.catalog, node.schema_name,
+                                      node.table)
+
+    def _prune_scan_rows(self, node: L.ScanNode, data, arrays, valids):
+        """Drop row ranges the pushed predicate provably cannot match
+        (zone-map evaluation); surviving ranges concatenate in order, so
+        the post-residual-filter row stream is identical to the unpruned
+        scan's."""
+        from . import zonemap
+        zm = zonemap.zone_map_for(data, self.zone_map_rows)
+        idx = zonemap.surviving_zone_indices(zm, node.predicate,
+                                             node.column_indices)
+        pruned = zm.num_zones - len(idx)
+        if pruned == 0:
+            return arrays, valids, data.num_rows
+        ranges = []
+        for i in idx:
+            s, c = zm.starts[i], zm.counts[i]
+            if ranges and ranges[-1][0] + ranges[-1][1] == s:
+                ranges[-1][1] += c
+            else:
+                ranges.append([s, c])
+
+        def take(a):
+            a = np.asarray(a)
+            if not ranges:
+                return a[:0]
+            if len(ranges) == 1:
+                s, c = ranges[0]
+                return a[s:s + c]
+            return np.concatenate([a[s:s + c] for s, c in ranges])
+
+        kept_rows = sum(c for _, c in ranges)
+        arrays = [take(a) for a in arrays]
+        if valids is not None:
+            valids = [None if v is None else take(v) for v in valids]
+        self.stats.scan_zones_pruned += pruned
+        self.stats.scan_rows_pruned += data.num_rows - kept_rows
+        from ..metrics import SCAN_ZONES_PRUNED
+        SCAN_ZONES_PRUNED.inc(pruned)
+        self.strategy_decisions[
+            f"TableScan[{node.table}]"] = \
+            f"zone-pruned:{pruned}/{zm.num_zones}"
+        return arrays, valids, kept_rows
 
     def run_window(self, node: L.WindowNode) -> Batch:
         from ..ops.window import WinSpec, window_compute

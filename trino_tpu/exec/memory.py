@@ -317,7 +317,8 @@ class MemoryPool:
             return {"pool": self.name, "limit": self.limit,
                     "reserved": self.reserved,
                     "revocable": self.revocable, "peak": self.peak,
-                    "holders": dict(self.holder_bytes)}
+                    "holders": dict(self.holder_bytes),
+                    "revocable_holders": dict(self.holder_revocable)}
 
     def close(self) -> None:
         """End-of-life check: every byte must have been freed. A leak is

@@ -111,6 +111,15 @@ SESSION_PROPERTY_DEFAULTS = {
     "merge_join": (True, _bool),
     # device bytes the scan cache may pin before LRU eviction
     "scan_cache_max_mb": (24 << 10, int),
+    # zone-map scan pruning (exec/zonemap.py): skip decoding row ranges
+    # the pushed-down predicate provably cannot match. Conservative-only;
+    # the residual filter always re-runs, so off is bit-exact with on
+    "enable_zone_map_pruning": (True, _bool),
+    # zone granularity in rows (split-level pruning quantum)
+    "zone_map_rows": (65536, int),
+    # chunked-driver prefetch pipeline: how many decoded+staged chunks
+    # may run ahead of the device (0 = today's serial loop, exactly)
+    "prefetch_depth": (2, int),
     # distributed runtime knobs (execution/scheduler tier)
     "split_rows": (250_000, int),
     "task_retries": (2, int),
@@ -217,6 +226,10 @@ class Session:
         ex.enable_merge_join = self.properties["merge_join"]
         ex.scan_cache_max_bytes = \
             self.properties["scan_cache_max_mb"] << 20
+        ex.enable_zone_map_pruning = \
+            self.properties["enable_zone_map_pruning"]
+        ex.zone_map_rows = max(1, self.properties["zone_map_rows"])
+        ex.prefetch_depth = max(0, self.properties["prefetch_depth"])
         max_s = self.properties["query_max_run_time_s"]
         ex.deadline = (t0 + max_s) if max_s else None
         kb = self.properties["stream_build_min_kb"]
@@ -287,6 +300,10 @@ class Session:
 
         annotate = estimate
         if stmt.analyze:
+            # ANALYZE really executes: apply session properties the same
+            # way execute_query would, so knobs like zone_map_rows shape
+            # what the profile (and the scan verdicts below) report
+            self._apply_executor_properties(t0)
             saved = self.executor.profile
             self.executor.profile = True
             self.executor.node_stats = {}
@@ -319,6 +336,17 @@ class Session:
                 rows.append((line,))
         except Exception:    # noqa: BLE001 — EXPLAIN must never fail
             pass             # on a strategy estimate
+        # scan-path verdicts after ANALYZE: how many zones/chunks each
+        # table scan pruned against its pushed-down predicate
+        if stmt.analyze:
+            for op, dec in sorted(self.executor.strategy_decisions.items()):
+                if not op.startswith("TableScan["):
+                    continue
+                kind, _, frac = dec.partition(":")
+                pruned, _, total = frac.partition("/")
+                unit = "zones" if kind == "zone-pruned" else "chunks"
+                rows.append((f"scan {op[10:-1]}: {total} {unit}, "
+                             f"{pruned} pruned by zone maps",))
         # CPU/TPU co-routing verdict (exec/router.py): what the serving
         # layer would do with this plan, and why
         try:

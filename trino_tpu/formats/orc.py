@@ -94,6 +94,12 @@ def _zz(v: int) -> int:
     return (v >> 1) ^ -(v & 1)
 
 
+def _pb_double(v: int) -> float:
+    """Reinterpret a pb_decode wire-type-1 value (read as int64) as the
+    IEEE double it actually is (DoubleStatistics min/max)."""
+    return struct.unpack("<d", struct.pack("<q", v))[0]
+
+
 def pb_ints(msg: Dict[int, list], fid: int) -> List[int]:
     """Repeated integer field, handling proto2 packed encoding (the
     values arrive as one length-delimited blob of varints)."""
@@ -299,20 +305,103 @@ def int_rle_v1(data: bytes, count: int, signed: bool) -> np.ndarray:
 # --------------------------------------------------------------------------
 
 class OrcFile:
-    def __init__(self, names, columns, valids, logicals):
+    def __init__(self, names, columns, valids, logicals,
+                 skipped_stripes: int = 0, total_stripes: int = 0):
         self.names = names
         self.columns = columns
         self.valids = valids
         self.logicals = logicals
+        self.skipped_stripes = skipped_stripes
+        self.total_stripes = total_stripes
 
 
-def read_orc(path: str):
-    """Read an ORC file -> (names, columns, valids, logicals)."""
-    f = read_orc_file(path)
+def read_orc(path: str, predicates: Optional[dict] = None):
+    """Read an ORC file -> (names, columns, valids, logicals).
+
+    `predicates` maps column name -> (lo, hi) inclusive bounds in the
+    engine's physical representation (dates as epoch days, decimals as
+    scaled integers); stripes whose StripeStatistics prove no row can
+    match are skipped without decoding (the caller's residual filter
+    keeps results exact)."""
+    f = read_orc_file(path, predicates)
     return f.names, f.columns, f.valids, f.logicals
 
 
-def read_orc_file(path: str) -> OrcFile:
+def _stats_range(cs: Dict[int, list], kind: int, tmeta) \
+        -> Tuple[Optional[object], Optional[object]]:
+    """(min, max) of one ColumnStatistics message in engine physical
+    values, or (None, None) when absent/unusable."""
+    if kind in (K_BYTE, K_SHORT, K_INT, K_LONG):
+        m = cs.get(2)
+        if m:
+            st = pb_decode(m[0])
+            lo, hi = st.get(1, [None])[0], st.get(2, [None])[0]
+            return (None if lo is None else _zz(lo),
+                    None if hi is None else _zz(hi))
+    elif kind == K_DATE:
+        m = cs.get(7)
+        if m:
+            st = pb_decode(m[0])
+            lo, hi = st.get(1, [None])[0], st.get(2, [None])[0]
+            return (None if lo is None else _zz(lo),
+                    None if hi is None else _zz(hi))
+    elif kind in (K_FLOAT, K_DOUBLE):
+        m = cs.get(3)
+        if m:
+            st = pb_decode(m[0])
+            lo, hi = st.get(1, [None])[0], st.get(2, [None])[0]
+            return (None if lo is None else _pb_double(lo),
+                    None if hi is None else _pb_double(hi))
+    elif kind == K_DECIMAL:
+        m = cs.get(6)
+        if m:
+            from decimal import Decimal
+            st = pb_decode(m[0])
+            scale = tmeta.get(6, [0])[0]
+            lo, hi = st.get(1, [None])[0], st.get(2, [None])[0]
+            try:
+                return (None if lo is None else
+                        int(Decimal(lo.decode()).scaleb(scale)),
+                        None if hi is None else
+                        int(Decimal(hi.decode()).scaleb(scale)))
+            except Exception:   # noqa: BLE001 — malformed decimal stat
+                return None, None
+    elif kind in (K_STRING, K_VARCHAR, K_CHAR):
+        m = cs.get(4)
+        if m:
+            st = pb_decode(m[0])
+            lo, hi = st.get(1, [None])[0], st.get(2, [None])[0]
+            return (None if lo is None else lo.decode("utf-8", "replace"),
+                    None if hi is None else hi.decode("utf-8", "replace"))
+    return None, None
+
+
+def _stripe_excluded(col_stats, child_ids, names, types,
+                     predicates: dict) -> bool:
+    """True when some predicate column's stripe statistics prove the
+    stripe empty under (lo, hi) inclusive bounds (parquet's
+    _group_excluded, for ORC StripeStatistics)."""
+    for j, cid in enumerate(child_ids):
+        if j >= len(names) or cid >= len(col_stats):
+            continue
+        rng = predicates.get(names[j])
+        if rng is None:
+            continue
+        kind = types[cid].get(1, [None])[0]
+        cmin, cmax = _stats_range(col_stats[cid], kind, types[cid])
+        lo, hi = rng
+        try:
+            if cmin is not None and hi is not None and cmin > hi:
+                return True
+            if cmax is not None and lo is not None and cmax < lo:
+                return True
+        except TypeError:       # incomparable stat/bound types: keep
+            continue
+    return False
+
+
+def read_orc_file(path: str,
+                  predicates: Optional[dict] = None) -> OrcFile:
     with open(path, "rb") as f:
         blob = f.read()
     ps_len = blob[-1]
@@ -324,6 +413,17 @@ def read_orc_file(path: str) -> OrcFile:
         raise ValueError("not an ORC file")
     footer_raw = blob[-1 - ps_len - footer_len:-1 - ps_len]
     footer = pb_decode(_decompress_stream(comp, footer_raw))
+    # Metadata section (StripeStatistics) sits just before the footer;
+    # PostScript field 5 carries its length
+    metadata_len = ps.get(5, [0])[0]
+    stripe_stats: List[list] = []
+    if metadata_len:
+        meta_raw = blob[-1 - ps_len - footer_len - metadata_len:
+                        -1 - ps_len - footer_len]
+        meta = pb_decode(_decompress_stream(comp, meta_raw))
+        for ss in meta.get(1, []):
+            stripe_stats.append(
+                [pb_decode(cs) for cs in pb_decode(ss).get(1, [])])
 
     types = [pb_decode(t) for t in footer.get(4, [])]
     root = types[0]
@@ -339,7 +439,12 @@ def read_orc_file(path: str) -> OrcFile:
     stripes = [pb_decode(s) for s in footer.get(3, [])]
     col_parts: Dict[int, list] = {cid: [] for cid in child_ids}
     val_parts: Dict[int, list] = {cid: [] for cid in child_ids}
-    for st in stripes:
+    skipped = 0
+    for si, st in enumerate(stripes):
+        if predicates and si < len(stripe_stats) and _stripe_excluded(
+                stripe_stats[si], child_ids, names, types, predicates):
+            skipped += 1
+            continue
         offset = st.get(1, [0])[0]
         index_len = st.get(2, [0])[0]
         data_len = st.get(3, [0])[0]
@@ -372,12 +477,19 @@ def read_orc_file(path: str) -> OrcFile:
             col_parts[cid].append(vals)
             val_parts[cid].append(valid)
 
+    # dtype of an all-stripes-pruned column must still follow its ORC
+    # kind, or the connector's schema inference flips with the predicate
+    _empty_dtype = {K_BOOLEAN: np.bool_, K_FLOAT: np.float64,
+                    K_DOUBLE: np.float64, K_STRING: object,
+                    K_VARCHAR: object, K_CHAR: object}
     columns, valids, logicals = [], [], []
     for cid in child_ids:
         parts = col_parts[cid]
         vparts = val_parts[cid]
+        kind0 = types[cid].get(1, [None])[0]
         columns.append(np.concatenate(parts) if len(parts) > 1 else
-                       (parts[0] if parts else np.zeros(0, np.int64)))
+                       (parts[0] if parts else
+                        np.zeros(0, _empty_dtype.get(kind0, np.int64))))
         if any(v is not None for v in vparts):
             vs = [v if v is not None else np.ones(len(p), np.bool_)
                   for v, p in zip(vparts, parts)]
@@ -395,7 +507,8 @@ def read_orc_file(path: str) -> OrcFile:
             logicals.append(("timestamp",))
         else:
             logicals.append(None)
-    return OrcFile(names, columns, valids, logicals)
+    return OrcFile(names, columns, valids, logicals,
+                   skipped_stripes=skipped, total_stripes=len(stripes))
 
 
 def timestamp_micros(secs: np.ndarray, nraw: np.ndarray) -> np.ndarray:
@@ -526,8 +639,9 @@ def _pb_varint_enc(v: int) -> bytes:
 
 
 def pb_encode(fields: Dict[int, list]) -> bytes:
-    """Inverse of pb_decode: {field id: [int | bytes, ...]} -> proto2
-    wire bytes (varint for ints, length-delimited for bytes)."""
+    """Inverse of pb_decode: {field id: [int | bytes | float, ...]} ->
+    proto2 wire bytes (varint for ints, length-delimited for bytes,
+    fixed64 for Python floats — DoubleStatistics)."""
     out = bytearray()
     for fid in sorted(fields):
         for v in fields[fid]:
@@ -535,10 +649,74 @@ def pb_encode(fields: Dict[int, list]) -> bytes:
                 out += _pb_varint_enc((fid << 3) | 2)
                 out += _pb_varint_enc(len(v))
                 out += v
+            elif isinstance(v, float):
+                out += _pb_varint_enc((fid << 3) | 1)
+                out += struct.pack("<d", v)
             else:
                 out += _pb_varint_enc((fid << 3) | 0)
                 out += _pb_varint_enc(int(v))
     return bytes(out)
+
+
+def _compress_stream(kind: int, data: bytes, block: int = 262144) -> bytes:
+    """Writer-side inverse of _decompress_stream: ORC 3-byte chunk
+    framing (header = len << 1 | isOriginal). A chunk that deflate does
+    not shrink is stored original, per spec."""
+    if kind == C_NONE:
+        return data
+    if kind != C_ZLIB:
+        raise ValueError(f"unsupported ORC write compression kind {kind}")
+    out = bytearray()
+    for i in range(0, len(data), block):
+        chunk = data[i:i + block]
+        co = zlib.compressobj(6, zlib.DEFLATED, -15)   # raw deflate
+        comp = co.compress(chunk) + co.flush()
+        if len(comp) < len(chunk):
+            out += (len(comp) << 1).to_bytes(3, "little") + comp
+        else:
+            out += ((len(chunk) << 1) | 1).to_bytes(3, "little") + chunk
+    return bytes(out)
+
+
+def _dec_str(v: int, scale: int) -> str:
+    """Scaled-int64 decimal -> ORC DecimalStatistics string ("‑1.23")."""
+    if scale <= 0:
+        return str(v)
+    sign = "-" if v < 0 else ""
+    v = abs(v)
+    return f"{sign}{v // 10 ** scale}.{v % 10 ** scale:0{scale}d}"
+
+
+def _col_stats(kind: int, present: np.ndarray, has_null: bool,
+               logical) -> bytes:
+    """ColumnStatistics proto for one column's stripe slice: value
+    count, hasNull, and a kind-appropriate min/max message (sint64
+    zigzag for integers/dates, IEEE doubles, decimal strings, UTF-8
+    strings) — what _stats_range/_stripe_excluded prune against."""
+    msg: Dict[int, list] = {1: [len(present)]}
+    if has_null:
+        msg[10] = [1]
+    if len(present):
+        if kind in (K_BYTE, K_SHORT, K_INT, K_LONG, K_DATE):
+            lo, hi = int(np.min(present)), int(np.max(present))
+            fid = 7 if kind == K_DATE else 2
+            msg[fid] = [pb_encode({1: [_zz_enc(lo)], 2: [_zz_enc(hi)]})]
+        elif kind == K_DOUBLE:
+            a = np.asarray(present, dtype=np.float64)
+            if not np.isnan(a).any():
+                msg[3] = [pb_encode({1: [float(a.min())],
+                                     2: [float(a.max())]})]
+        elif kind == K_DECIMAL:
+            scale = logical[2] if logical else 0
+            lo, hi = int(np.min(present)), int(np.max(present))
+            msg[6] = [pb_encode({1: [_dec_str(lo, scale).encode()],
+                                 2: [_dec_str(hi, scale).encode()]})]
+        elif kind == K_STRING:
+            ss = [("" if s is None else str(s)) for s in present]
+            msg[4] = [pb_encode({1: [min(ss).encode()],
+                                 2: [max(ss).encode()]})]
+        # K_BOOLEAN: counts only (BucketStatistics adds nothing here)
+    return pb_encode(msg)
 
 
 def _zz_enc(v: int) -> int:
@@ -574,10 +752,18 @@ def _bool_rle_enc(bits: np.ndarray) -> bytes:
 
 
 def write_orc(path: str, names, columns, valids=None, logicals=None,
-              stripe_rows: int = 1 << 20) -> None:
+              stripe_rows: int = 1 << 20,
+              compression: str = "none") -> None:
     """Write columns to an ORC file. Types map from numpy dtypes unless
     `logicals[i]` overrides: ("decimal", p, s) or ("date",). Strings
-    pass as object/str arrays. NULLs via `valids` boolean masks."""
+    pass as object/str arrays. NULLs via `valids` boolean masks.
+    `compression` is "none" or "zlib" (raw deflate inside ORC's 3-byte
+    chunk framing, applied to streams and metadata sections alike).
+    Every stripe's min/max/null statistics are recorded in the file's
+    Metadata section for reader-side stripe pruning."""
+    comp = {"none": C_NONE, "zlib": C_ZLIB}.get(compression.lower())
+    if comp is None:
+        raise ValueError(f"unsupported ORC compression: {compression!r}")
     n = len(columns[0]) if columns else 0
     valids = valids or [None] * len(columns)
     logicals = logicals or [None] * len(columns)
@@ -602,12 +788,14 @@ def write_orc(path: str, names, columns, valids=None, logicals=None,
 
     body = bytearray(b"ORC")
     stripe_infos = []
+    stripe_stat_msgs = []       # one StripeStatistics message per stripe
     for start in range(0, max(n, 1), stripe_rows):
         count = min(stripe_rows, n - start)
         if count <= 0 and n > 0:
             break
         streams = []        # (kind, col_id, bytes)
         encodings = [{1: [E_DIRECT]}]          # root struct
+        col_stat_blobs = [pb_encode({1: [count]})]     # root struct stats
         for ci, arr in enumerate(columns):
             cid = ci + 1
             a = arr[start:start + count]
@@ -620,6 +808,8 @@ def write_orc(path: str, names, columns, valids=None, logicals=None,
                 sel = np.ones(count, dtype=bool)
                 v = None
             present_vals = a[sel] if v is not None else a
+            col_stat_blobs.append(_col_stats(
+                kinds[ci], present_vals, v is not None, logicals[ci]))
             k = kinds[ci]
             enc = {1: [E_DIRECT]}
             if k == K_BOOLEAN:
@@ -651,18 +841,20 @@ def write_orc(path: str, names, columns, valids=None, logicals=None,
         data_len = 0
         stream_msgs = []
         for skind, cid, blob in streams:
-            body += blob
-            data_len += len(blob)
+            framed = _compress_stream(comp, blob)
+            body += framed
+            data_len += len(framed)
             stream_msgs.append(pb_encode(
-                {1: [skind], 2: [cid], 3: [len(blob)]}))
-        sfooter = pb_encode({
+                {1: [skind], 2: [cid], 3: [len(framed)]}))
+        sfooter = _compress_stream(comp, pb_encode({
             1: [bytes(m) for m in stream_msgs],
             2: [pb_encode(e) for e in encodings],
-        })
+        }))
         body += sfooter
         stripe_infos.append(pb_encode({
             1: [offset], 2: [0], 3: [data_len], 4: [len(sfooter)],
             5: [count]}))
+        stripe_stat_msgs.append(pb_encode({1: col_stat_blobs}))
         if n == 0:
             break
 
@@ -676,21 +868,26 @@ def write_orc(path: str, names, columns, valids=None, logicals=None,
             t[5] = [logicals[ci][1]]
             t[6] = [logicals[ci][2]]
         types.append(pb_encode(t))
-    footer = pb_encode({
+    content_len = len(body)
+    # Metadata section (StripeStatistics): between the stripes and the
+    # footer; readers prune stripes against it without touching data
+    metadata = _compress_stream(comp, pb_encode({1: stripe_stat_msgs}))
+    body += metadata
+    footer = _compress_stream(comp, pb_encode({
         1: [3],                                # headerLength: "ORC" magic
-        2: [len(body)],                        # contentLength
+        2: [content_len],                      # contentLength
         3: stripe_infos,
         4: types,
         6: [n],                                # numberOfRows
         8: [10000],                            # rowIndexStride
-    })
+    }))
     body += footer
     ps = pb_encode({
         1: [len(footer)],
-        2: [C_NONE],
+        2: [comp],
         3: [262144],
         4: [0, 12],                            # version 0.12
-        5: [0],                                # metadataLength
+        5: [len(metadata)],                    # metadataLength
         6: [6],                                # writerVersion
         8000: [b"ORC"],
     })

@@ -408,25 +408,29 @@ def _plain_encode(phys: int, arr: np.ndarray) -> bytes:
 CONV_UTF8, CONV_DECIMAL, CONV_DATE = 0, 5, 6
 
 
-def _stats_encode(phys: int, present: np.ndarray) -> Optional[bytes]:
-    """Statistics struct (min_value/max_value, fields 6/5) for row-group
-    pruning; None when the column has no present values or no ordering
-    worth recording."""
+def _stats_encode(phys: int, present: np.ndarray,
+                  null_count: int = 0) -> Optional[bytes]:
+    """Statistics struct (null_count field 3, min_value/max_value fields
+    6/5) for row-group pruning; None when the column has no present
+    values or no ordering worth recording."""
     if len(present) == 0:
         return None
     tw = ThriftWriter()
     if phys in (T_INT32, T_INT64):
         lo, hi = int(present.min()), int(present.max())
         fmt = "<i" if phys == T_INT32 else "<q"
-        return tw.struct([(5, CT_BINARY, struct.pack(fmt, hi)),
+        return tw.struct([(3, CT_I64, null_count),
+                          (5, CT_BINARY, struct.pack(fmt, hi)),
                           (6, CT_BINARY, struct.pack(fmt, lo))])
     if phys == T_DOUBLE:
         lo, hi = float(present.min()), float(present.max())
-        return tw.struct([(5, CT_BINARY, struct.pack("<d", hi)),
+        return tw.struct([(3, CT_I64, null_count),
+                          (5, CT_BINARY, struct.pack("<d", hi)),
                           (6, CT_BINARY, struct.pack("<d", lo))])
     if phys == T_BYTE_ARRAY:
         ss = [s if isinstance(s, str) else str(s) for s in present]
-        return tw.struct([(5, CT_BINARY, max(ss).encode()),
+        return tw.struct([(3, CT_I64, null_count),
+                          (5, CT_BINARY, max(ss).encode()),
                           (6, CT_BINARY, min(ss).encode())])
     return None
 
@@ -511,7 +515,7 @@ def write_parquet(path: str, names: List[str], arrays: List[np.ndarray],
                 (7, CT_I64, len(page_header) + len(wire)),
                 (9, CT_I64, offset),
             ]
-            stats = _stats_encode(phys, present)
+            stats = _stats_encode(phys, present, g_n - len(present))
             if stats is not None:
                 meta_fields.append((12, CT_STRUCT, stats))
             col_meta = tw.struct(meta_fields)

@@ -407,6 +407,25 @@ MESH_REPARTITION_BYTES = REGISTRY.counter(
     "Bytes moved through all_to_all repartition exchanges by "
     "mesh-partitioned joins")
 
+# scan-path acceleration (exec/zonemap.py + exec/chunked.py prefetch):
+# zone-map split/zone pruning and the double-buffered chunk pipeline
+SCAN_SPLITS_PRUNED = REGISTRY.counter(
+    "trino_tpu_scan_splits_pruned_total",
+    "Row-range splits dropped by zone-map pruning before dispatch "
+    "(server/scheduler.py)")
+SCAN_ZONES_PRUNED = REGISTRY.counter(
+    "trino_tpu_scan_zones_pruned_total",
+    "Zone-map row ranges skipped at scan materialization "
+    "(exec/zonemap.py)")
+SCAN_PREFETCH_BUFFERS = REGISTRY.gauge(
+    "trino_tpu_scan_prefetch_buffers_in_use",
+    "Decoded+staged chunks currently held by the chunked-driver "
+    "prefetch pipeline (revocable reservations)")
+SCAN_PREFETCH_STALL_SECONDS = REGISTRY.counter(
+    "trino_tpu_scan_prefetch_stall_seconds",
+    "Seconds the chunked-driver consumer spent waiting on a chunk the "
+    "prefetch worker had not staged yet")
+
 # query history + latency-regression detection (server/history.py)
 LATENCY_REGRESSIONS = REGISTRY.counter(
     "trino_tpu_query_latency_regressions_total",

@@ -132,8 +132,7 @@ class MeshExecutor(Executor):
             # instead of silently staying single-device — the live mask
             # keeps padding invisible to every kernel
             batch = pad_to_multiple(batch, self.n_shards * 8)
-        key = (node.catalog, node.schema_name, node.table,
-               node.column_indices)
+        key = self._scan_key(node)
         sharded = jax.tree_util.tree_map(
             lambda x: jax.device_put(x, self._row_sharding), batch)
         self._scan_cache[key] = sharded   # keep the sharded placement

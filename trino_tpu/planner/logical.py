@@ -32,6 +32,12 @@ class ScanNode(PlanNode):
     table_schema: Schema              # full connector schema
     column_indices: Tuple[int, ...]   # which connector columns we read
     output: Tuple                     # ((name, DataType), ...)
+    # conjunctive single-column predicate pushed down by the optimizer
+    # (TupleDomain pushdown in the reference). Advisory only: execution
+    # may use it to skip zones/splits that provably cannot match, but the
+    # residual FilterNode above always re-applies the full predicate, so
+    # dropping it is always safe. References are scan OUTPUT positions.
+    predicate: Optional[ir.Expr] = None
 
 
 @dataclass(frozen=True)
@@ -243,6 +249,8 @@ def explain_text(node: PlanNode, indent: int = 0, annotate=None) -> str:
         cols = ", ".join(n for n, _ in node.output)
         line = (f"{pad}TableScan[{node.catalog}.{node.schema_name}."
                 f"{node.table}] -> [{cols}]")
+        if node.predicate is not None:
+            line += f", pushdown=[{node.predicate}]"
     elif isinstance(node, FilterNode):
         line = f"{pad}Filter[{node.predicate}]"
     elif isinstance(node, ProjectNode):

@@ -31,7 +31,78 @@ def prune_plan(root: L.OutputNode) -> L.OutputNode:
             tuple(ir.ColumnRef(mapping[i], root.child.output[i][1])
                   for i in range(n)),
             tuple(root.child.output))
+    child = push_scan_predicates(child)
     return L.OutputNode(child, root.names, tuple(root.child.output))
+
+
+def pushable_conjuncts(predicate: ir.Expr):
+    """Split a predicate into top-level AND conjuncts and keep the ones a
+    zone map can evaluate: single-column range/equality/IN/IS [NOT] NULL
+    with literal bounds (TupleDomain extraction,
+    DomainTranslator.getExtractionResult in the reference). NOT / OR /
+    casts / multi-column shapes are skipped — they stay residual-only."""
+    out = []
+    stack = [predicate]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, ir.Logical) and e.op == "and":
+            stack.extend(e.args)
+            continue
+        if isinstance(e, ir.Compare):
+            lc = isinstance(e.left, ir.ColumnRef) and \
+                isinstance(e.right, ir.Literal)
+            rc = isinstance(e.right, ir.ColumnRef) and \
+                isinstance(e.left, ir.Literal)
+            if lc:
+                out.append(e)
+            elif rc:
+                flip = {"=": "=", "<>": "<>", "<": ">", "<=": ">=",
+                        ">": "<", ">=": "<="}
+                out.append(ir.Compare(flip[e.op], e.right, e.left))
+        elif isinstance(e, ir.Between):
+            if isinstance(e.arg, ir.ColumnRef) and \
+                    isinstance(e.low, ir.Literal) and \
+                    isinstance(e.high, ir.Literal):
+                out.append(e)
+        elif isinstance(e, ir.InList):
+            if isinstance(e.arg, ir.ColumnRef) and \
+                    all(isinstance(v, ir.Literal) for v in e.values):
+                out.append(e)
+        elif isinstance(e, ir.IsNull):
+            if isinstance(e.arg, ir.ColumnRef):
+                out.append(e)
+        elif isinstance(e, ir.DictPredicate):
+            # varchar =/range/LIKE/IN lower to a code->bool LUT; pools are
+            # sorted, so zone [min_code, max_code] bounds evaluate it
+            if isinstance(e.arg, ir.ColumnRef):
+                out.append(e)
+    return out
+
+
+def push_scan_predicates(node: L.PlanNode) -> L.PlanNode:
+    """Copy the zone-map-evaluable conjuncts of every Filter sitting
+    directly above a ScanNode into the scan's advisory `predicate` slot.
+    The Filter itself is untouched: it is the residual that guarantees
+    bit-exact results whether or not execution skips anything."""
+    import dataclasses as _dc
+    if isinstance(node, L.FilterNode) and \
+            isinstance(node.child, L.ScanNode) and \
+            node.child.catalog not in ("system", "information_schema"):
+        conj = pushable_conjuncts(node.predicate)
+        if conj:
+            pushed = conj[0] if len(conj) == 1 else \
+                ir.Logical("and", tuple(conj))
+            return _dc.replace(
+                node, child=_dc.replace(node.child, predicate=pushed))
+        return node
+    changes = {}
+    for f in _dc.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, L.PlanNode):
+            nv = push_scan_predicates(v)
+            if nv is not v:
+                changes[f.name] = nv
+    return _dc.replace(node, **changes) if changes else node
 
 
 def _identity(n: int) -> Dict[int, int]:
@@ -61,10 +132,20 @@ def _prune(node: L.PlanNode, needed: frozenset):
     if isinstance(node, L.ScanNode):
         keep = sorted(needed) if needed else [0]
         mapping = {old: new for new, old in enumerate(keep)}
+        predicate = node.predicate
+        if predicate is not None:
+            refs = ir.referenced_columns(predicate)
+            if refs <= set(keep):
+                predicate = ir.remap_columns(predicate, mapping)
+            else:
+                # a referenced column was pruned away: dropping the
+                # pushdown is always safe (it only enables skipping)
+                predicate = None
         return L.ScanNode(
             node.catalog, node.schema_name, node.table, node.table_schema,
             tuple(node.column_indices[i] for i in keep),
-            tuple(node.output[i] for i in keep)), mapping
+            tuple(node.output[i] for i in keep),
+            predicate=predicate), mapping
 
     if isinstance(node, L.FilterNode):
         child_needed = needed | ir.referenced_columns(node.predicate)

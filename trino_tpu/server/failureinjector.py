@@ -43,10 +43,12 @@ EXCHANGE_DRAIN = "EXCHANGE_DRAIN"      # coordinator pulling result pages
 SPOOL_READ = "SPOOL_READ"              # durable exchange get()
 SPOOL_WRITE = "SPOOL_WRITE"            # durable exchange put()
 HEARTBEAT_PING = "HEARTBEAT_PING"      # failure detector /v1/status probe
+SCAN_PREFETCH = "SCAN_PREFETCH"        # chunked-driver prefetch worker,
+                                       # per staged chunk (exec/chunked.py)
 
 POINTS = (DISPATCH, EXECUTION, STAGE_BOUNDARY, WORKER_TASK_CREATE,
           WORKER_TASK_RUN, EXCHANGE_DRAIN, SPOOL_READ, SPOOL_WRITE,
-          HEARTBEAT_PING)
+          HEARTBEAT_PING, SCAN_PREFETCH)
 
 # Fault types.
 RAISE = "RAISE"

@@ -31,8 +31,8 @@ from urllib.request import Request, urlopen
 import numpy as np
 
 from ..exec.chunked import ChunkAnalysis, analyze, merge_partials
-from ..metrics import (SCHED_HEDGE_WINS, SCHED_HEDGES, SCHED_TASK_RETRIES,
-                       SCHED_TASKS)
+from ..metrics import (SCAN_SPLITS_PRUNED, SCHED_HEDGE_WINS, SCHED_HEDGES,
+                       SCHED_TASK_RETRIES, SCHED_TASKS)
 from ..planner import logical as L
 from ..planner.fragmenter import Fragment, fragment_plan
 from ..planner.optimizer import prune_plan
@@ -299,7 +299,8 @@ class StageScheduler:
         self.stats: Dict[str, int] = {"queries": 0, "tasks": 0,
                                       "task_retries": 0, "spool_hits": 0,
                                       "hedged_tasks": 0, "hedge_wins": 0,
-                                      "checksum_failures": 0}
+                                      "checksum_failures": 0,
+                                      "splits_pruned": 0}
         # observability: per-query stage/task rollup (reset each execute;
         # read by the dispatcher into TrackedQuery.stage_stats), recent
         # task records for system.runtime.tasks, and per-(query, operator)
@@ -350,6 +351,16 @@ class StageScheduler:
         lq["stages"] = self.stats.get("stages", 0) - snap.get("stages", 0)
         lq["faults_survived"] = lq["task_retries"] + \
             lq["checksum_failures"]
+        if lq.get("splits_pruned"):
+            # surface split pruning on the TableScan rollup row so
+            # system.runtime.operator_stats carries the verdict
+            acc = lq["operators"].setdefault(
+                "TableScan", {"rows": 0, "wall_ms": 0.0, "calls": 0,
+                              "device_ms": 0.0, "host_ms": 0.0,
+                              "compile_ms": 0.0, "strategy": "",
+                              "distribution": ""})
+            acc["strategy"] = (f"zone-pruned:{lq['splits_pruned']}/"
+                               f"{lq.get('splits_total', 0)} splits")
         with self._lock:
             for op, d in lq["operators"].items():
                 self.operator_history.append(
@@ -562,7 +573,9 @@ class StageScheduler:
                       f"{len(lq['tasks'])} tasks, "
                       f"{lq['bytes_shuffled']} bytes shuffled, "
                       f"{lq['task_retries']} task retries, "
-                      f"{lq['hedged_tasks']} hedged"]
+                      f"{lq['hedged_tasks']} hedged",
+                  f"scan: {lq.get('splits_total', 0)} splits, "
+                  f"{lq.get('splits_pruned', 0)} pruned by zone maps"]
         for name in sorted(stages):
             n, splits, rows, wall = stages[name]
             lines.append(f"Stage {name}: tasks={n}, splits={splits}, "
@@ -657,10 +670,45 @@ class StageScheduler:
 
     def _make_splits(self, analysis: ChunkAnalysis) -> List[Split]:
         d = analysis.driver
-        return [Split(d.catalog, d.schema_name, d.table, start,
-                      min(self.split_rows, analysis.driver_rows - start))
-                for start in range(0, analysis.driver_rows,
-                                   self.split_rows)]
+        splits = [Split(d.catalog, d.schema_name, d.table, start,
+                        min(self.split_rows, analysis.driver_rows - start))
+                  for start in range(0, analysis.driver_rows,
+                                     self.split_rows)]
+        total = len(splits)
+        # zone-map split pruning: drop row-range splits whose zones
+        # provably cannot match the scan's pushed-down predicate — the
+        # dispatch never happens (vs. the worker decoding the range and
+        # filtering it to nothing). Advisory: the fragment's residual
+        # filter makes dropping a MAY-match split unnecessary and keeping
+        # a cannot-match split harmless.
+        props = getattr(self.session, "properties", {})
+        pred = getattr(d, "predicate", None)
+        if pred is not None and props.get("enable_zone_map_pruning", True):
+            try:
+                from ..exec import zonemap
+                data = self.session.catalog.get_table(
+                    d.catalog, d.schema_name, d.table)
+                zm = zonemap.zone_map_for(
+                    data, props.get("zone_map_rows",
+                                    zonemap.DEFAULT_ZONE_ROWS))
+                kept = [s for s in splits
+                        if zonemap.range_may_match(
+                            zm, pred, d.column_indices, s.start, s.count)]
+                # keep one split so every downstream merge path sees at
+                # least one page; its residual filter drops all rows
+                splits = kept or splits[:1]
+            except Exception:   # noqa: BLE001 — pruning is best-effort
+                pass
+        pruned = total - len(splits)
+        if pruned:
+            self.stats["splits_pruned"] = \
+                self.stats.get("splits_pruned", 0) + pruned
+            SCAN_SPLITS_PRUNED.inc(pruned)
+        lq = self.last_query
+        if lq is not None:
+            lq["splits_total"] = lq.get("splits_total", 0) + total
+            lq["splits_pruned"] = lq.get("splits_pruned", 0) + pruned
+        return splits
 
     def _run_source_stage(self, workers, analysis: ChunkAnalysis,
                           root: L.OutputNode) -> List[dict]:

@@ -266,6 +266,10 @@ class TaskManager:
         # the lock serializes device use within this worker
         from ..exec.executor import Executor
         self._executor = Executor(catalog)
+        # executor-side chaos points (e.g. SCAN_PREFETCH in the chunked
+        # driver's prefetch worker) share this worker's injector, so the
+        # same seeded schedule covers threads the task manager spawns
+        self._executor.failure_injector = injector
         self._exec_lock = threading.Lock()
 
     def create_or_update(self, task_id: str, fragment_blob: str,
