@@ -1165,6 +1165,308 @@ def concurrency_soak(n_clients=None, queries_per_client=None,
     return rec
 
 
+def elastic_soak(duration_s=None, out_path="BENCH_soak.json"):
+    """Sustained elastic-membership soak (round-15 acceptance): a
+    minutes-long mixed workload — point + cached + scan-heavy + writes
+    across >= 3 tenants — with chaos injection, per-tenant soft memory
+    limits, and CPU/TPU routing all ON simultaneously, while a worker
+    is admin-drained (PUT /v1/info/state) and a fresh worker joins
+    mid-run. Gated on: 0 wrong answers (every read bit-exact vs a
+    pre-server oracle, every write accounted for in a final count), 0
+    failed queries, 0 orphaned splits on the drained worker, the drain
+    reaching LEFT, the joiner actually receiving splits, and per-tenant
+    p99 SLOs — the fair-share acceptance is that beta (the saturating
+    scan tenant) cannot push alpha's point p99 past its SLO, because
+    alpha's host-eligible queries overflow to the lock-free host tier
+    under device contention. Emits BENCH_soak.json; the smoke path
+    (TRINO_TPU_SOAK_DURATION_S of a few seconds) runs in tier-1."""
+    import tempfile
+    import threading as _th
+    from urllib.request import Request as _Req
+    from urllib.request import urlopen as _uo
+
+    from trino_tpu.client.client import Client
+    from trino_tpu.metrics import REGISTRY, SOAK_SLO_VIOLATIONS
+    from trino_tpu.exec.session import Session
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.failuredetector import HeartbeatFailureDetector
+    from trino_tpu.server.failureinjector import FailureInjector
+    from trino_tpu.server.resourcegroups import tenant_tree
+    from trino_tpu.server.security import internal_headers
+    from trino_tpu.server.worker import WorkerServer
+
+    dur = duration_s if duration_s is not None else \
+        float(os.environ.get("TRINO_TPU_SOAK_DURATION_S", 180))
+    per_tenant = int(os.environ.get("TRINO_TPU_SOAK_CLIENTS", 3))
+    slo_ms = {
+        "alpha": float(os.environ.get("TRINO_TPU_SOAK_SLO_ALPHA_MS",
+                                      5000)),
+        "beta": float(os.environ.get("TRINO_TPU_SOAK_SLO_BETA_MS",
+                                     60000)),
+        "gamma": float(os.environ.get("TRINO_TPU_SOAK_SLO_GAMMA_MS",
+                                      5000)),
+    }
+    t_start = time.monotonic()
+    # fresh history file (same reason as concurrency_soak: stale
+    # medians would bias the router baseline)
+    hist = tempfile.NamedTemporaryFile(prefix="soak_hist_",
+                                       suffix=".jsonl", delete=False)
+    saved_hist_env = os.environ.get("TRINO_TPU_HISTORY_PATH")
+    os.environ["TRINO_TPU_HISTORY_PATH"] = hist.name
+
+    session = Session(default_schema="tiny")
+    session.execute(
+        "CREATE TABLE memory.s.soak_log (k bigint, v bigint)")
+
+    # tenant mixes: (sql, unordered, is_write). alpha = interactive
+    # point/cached traffic (host tier), beta = scan-heavy distributed
+    # saturator (device tier + cluster), gamma = cached reads + writes
+    # (writes also bump the catalog version, which keeps invalidating
+    # the result cache so beta's scans stay honest distributed work)
+    mixes = {
+        "alpha": [(f"SELECT n_name FROM nation WHERE n_nationkey = {k}",
+                   False, False) for k in range(12)] +
+                 [("SELECT r_name FROM region ORDER BY r_name",
+                   False, False)],
+        "beta": [(q, unordered, False)
+                 for q, unordered in CHAOS_QUERIES.values()],
+        "gamma": [("INSERT INTO memory.s.soak_log VALUES (1, 1)",
+                   False, True),
+                  ("SELECT count(*) FROM supplier", False, False),
+                  ("SELECT min(s_suppkey), max(s_suppkey) FROM supplier",
+                   False, False)],
+    }
+    oracle = {}
+    for qs in mixes.values():
+        for q, unordered, is_write in qs:
+            if not is_write:
+                rows = _chaos_rows(session.execute(q).rows)
+                oracle[q] = sorted(rows) if unordered else rows
+
+    session.properties["enable_result_cache"] = True
+    session.properties["enable_microbatch"] = True
+    # keep the host tier for genuinely small queries only: beta's
+    # lineitem scans (~60k rows) must stay device/cluster work so the
+    # drain/join path is exercised by real split placement, while
+    # alpha's point lookups remain host-eligible for fair-share
+    # overflow under contention
+    session.properties["router_host_max_rows"] = 4096
+    coord = CoordinatorServer(session, max_concurrency=16,
+                              retry_policy="QUERY").start()
+    if saved_hist_env is None:
+        os.environ.pop("TRINO_TPU_HISTORY_PATH", None)
+    else:
+        os.environ["TRINO_TPU_HISTORY_PATH"] = saved_hist_env
+    # per-tenant isolation: one resource group per tenant with a soft
+    # memory limit (round-9 admission gate), fair-share routing reads
+    # the tenant off each query
+    coord.state.dispatcher.resource_groups = tenant_tree(
+        {"alpha": {"hard_concurrency_limit": 8},
+         "beta": {"hard_concurrency_limit": 4,
+                  "soft_memory_limit_bytes": 1 << 31},
+         "gamma": {"hard_concurrency_limit": 4}},
+        max_queued=100_000)
+    sched = coord.state.scheduler
+    sched.split_rows = 8192
+    sched.max_task_retries = 8
+    sched.hedge_min_s, sched.hedge_multiplier = 0.5, 2.0
+    workers = [WorkerServer(f"soak-w{i}", coord.uri,
+                            announce_interval_s=0.1,
+                            catalog=session.catalog,
+                            drain_timeout_s=60.0).start()
+               for i in range(3)]
+    detector = HeartbeatFailureDetector(coord.state,
+                                        interval_s=0.2).start()
+    coord.state.memory_manager.start()
+
+    def wait_active(k, timeout=10.0):
+        deadline = time.time() + timeout
+        while len(coord.state.active_nodes()) < k and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        return len(coord.state.active_nodes()) >= k
+
+    wait_active(3)
+    stats0 = dict(sched.stats)
+    reg0 = REGISTRY.snapshot()
+    lock = _th.Lock()
+    latencies = {t: [] for t in mixes}
+    rec = {"metric": "soak", "duration_s": dur, "queries": 0,
+           "wrong_answers": 0, "failed_queries": 0, "writes_ok": 0,
+           "chaos_schedules": 0, "injected_total": 0}
+    stop_at = time.monotonic() + dur
+    mismatches = []
+
+    def one(tenant: str, i: int) -> None:
+        qs = mixes[tenant]
+        client = Client(coord.uri, user=f"{tenant}-{i}", timeout_s=180,
+                        poll_interval_s=0.005)
+        j = 0
+        while time.monotonic() < stop_at:
+            q, unordered, is_write = qs[(i + j) % len(qs)]
+            j += 1
+            t0 = time.monotonic()
+            try:
+                rows = client.execute(q).rows
+            except Exception as e:  # noqa: BLE001 — any failure counts
+                with lock:
+                    rec["failed_queries"] += 1
+                    if len(mismatches) < 5:
+                        mismatches.append(f"{tenant}: {q[:60]}: {e}")
+                continue
+            ms = (time.monotonic() - t0) * 1000
+            with lock:
+                rec["queries"] += 1
+                latencies[tenant].append(ms)
+                if is_write:
+                    rec["writes_ok"] += 1
+                else:
+                    got = _chaos_rows(rows)
+                    if unordered:
+                        got = sorted(got)
+                    if got != oracle[q]:
+                        rec["wrong_answers"] += 1
+                        if len(mismatches) < 5:
+                            mismatches.append(f"{tenant}: {q[:60]}")
+
+    threads = [_th.Thread(target=one, args=(t, i), daemon=True)
+               for t in mixes for i in range(per_tenant)]
+    t_soak = time.monotonic()
+    for t in threads:
+        t.start()
+
+    # --- the orchestrated membership events, chaos rotating throughout
+    drain_at = t_soak + dur * 0.30
+    join_at = t_soak + dur * 0.45
+    next_chaos = t_soak
+    w0, w3 = workers[0], None
+    drain_requested = False
+    seed = 0
+    last_inj = None
+    while time.monotonic() < stop_at:
+        now = time.monotonic()
+        if now >= next_chaos:
+            inj = FailureInjector.from_seed(seed, max_delay_s=0.5)
+            seed += 1
+            sched.failure_injector = inj
+            detector.injector = inj
+            for w in workers:
+                w.task_manager.injector = inj
+            # drop spooled stage outputs so repeat fingerprints dispatch
+            # REAL tasks: the soak must exercise live split placement
+            # (and the drain/join membership), not replay the durable
+            # spool's dedup of identical (fragment, splits) work
+            sched.spool.clear()
+            rec["chaos_schedules"] += 1
+            if last_inj is not None:
+                rec["injected_total"] += last_inj.injected_count
+            last_inj = inj
+            next_chaos = now + max(2.0, dur / 12.0)
+        if not drain_requested and now >= drain_at:
+            req = _Req(f"{w0.uri}/v1/info/state",
+                       data=json.dumps({"state": "DRAINING"}).encode(),
+                       method="PUT",
+                       headers={"Content-Type": "application/json",
+                                **internal_headers()})
+            with _uo(req, timeout=10) as resp:
+                assert resp.status == 200, resp.status
+            drain_requested = True
+        if w3 is None and now >= join_at:
+            w3 = WorkerServer("soak-w3", coord.uri,
+                              announce_interval_s=0.1,
+                              catalog=session.catalog).start()
+            workers.append(w3)
+            sched.spool.clear()   # next scans place splits on the joiner
+        time.sleep(0.05)
+    if last_inj is not None:
+        rec["injected_total"] += last_inj.injected_count
+    for t in threads:
+        t.join(timeout=300)
+    soak_s = time.monotonic() - t_soak
+    sched.failure_injector = None
+    detector.injector = None
+    for w in workers:
+        w.task_manager.injector = None
+
+    # --- drain postconditions: w0 deregistered with nothing orphaned
+    deadline = time.time() + 60
+    while not w0.drained() and time.time() < deadline:
+        time.sleep(0.05)
+    rec["drain_completed"] = w0.drained()
+    with coord.state.nodes_lock:
+        rec["drained_node_deregistered"] = \
+            w0.node_id not in coord.state.nodes
+    rec["orphaned_splits"] = len(w0.task_manager.inflight()) + \
+        len(w0.task_manager.unflushed())
+    rec["join_received_splits"] = any(
+        t.get("node") == "soak-w3" for t in sched.task_history)
+    # write accounting: every acknowledged INSERT must be visible
+    final = Client(coord.uri, user="gamma-audit").execute(
+        "SELECT count(*) FROM memory.s.soak_log").rows
+    rec["writes_visible"] = int(final[0][0]) == rec["writes_ok"]
+
+    after = REGISTRY.snapshot()
+
+    def delta(*key):
+        return int(after.get(tuple(key), 0) - reg0.get(tuple(key), 0))
+
+    rec["throughput_qps"] = round(rec["queries"] / max(soak_s, 1e-9), 1)
+    rec["soak_seconds"] = round(soak_s, 2)
+    rec["splits_migrated"] = sched.stats["splits_migrated"] - \
+        stats0.get("splits_migrated", 0)
+    rec["task_retries"] = sched.stats["task_retries"] - \
+        stats0["task_retries"]
+    rec["hedged_tasks"] = sched.stats["hedged_tasks"] - \
+        stats0["hedged_tasks"]
+    rec["lifecycle_transitions"] = {
+        st: delta("trino_tpu_node_lifecycle_transitions_total", st)
+        for st in ("ACTIVE", "DRAINING", "DRAINED", "LEFT", "FAILED")}
+    rec["membership_rearbitrations"] = \
+        coord.state.memory_manager.membership_rearbitrations
+    rec["router_host"] = delta("trino_tpu_router_decisions_total",
+                               "host")
+    rec["router_device"] = delta("trino_tpu_router_decisions_total",
+                                 "device")
+    rec["tenants"] = {}
+    slo_ok = True
+    for tname in mixes:
+        vals = sorted(latencies[tname])
+        p99 = round(_percentile(vals, 0.99), 1) if vals else 0.0
+        ok = bool(vals) and p99 <= slo_ms[tname]
+        if not ok:
+            SOAK_SLO_VIOLATIONS.inc()
+            slo_ok = False
+        rec["tenants"][tname] = {
+            "queries": len(vals),
+            "p50_ms": round(_percentile(vals, 0.50), 1) if vals else 0.0,
+            "p99_ms": p99, "slo_ms": slo_ms[tname], "slo_ok": ok}
+    # the fair-share acceptance, stated explicitly: the saturating scan
+    # tenant did not push the point tenant past its SLO
+    rec["fair_share_held"] = rec["tenants"]["alpha"]["slo_ok"]
+    if mismatches:
+        rec["sample_failures"] = mismatches
+    rec["elapsed_s"] = round(time.monotonic() - t_start, 1)
+    rec["passed"] = (rec["wrong_answers"] == 0 and
+                     rec["failed_queries"] == 0 and
+                     rec["orphaned_splits"] == 0 and
+                     rec["drain_completed"] and
+                     rec["drained_node_deregistered"] and
+                     rec["join_received_splits"] and
+                     rec["writes_visible"] and
+                     rec["queries"] > 0 and
+                     slo_ok)
+    detector.stop()
+    coord.state.memory_manager.stop()
+    for w in workers:
+        w.stop()
+    coord.stop()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 # ---------------------------------------------------------------------------
 # --check-regressions: history-based latency gate over BENCH_r*.json
 # ---------------------------------------------------------------------------
@@ -1208,6 +1510,19 @@ def load_bench_round(path):
         for depth, d in (doc.get("prefetch") or {}).items():
             if isinstance(d, dict) and "wall_ms" in d:
                 out[f"scan_micro_prefetch_{depth}"] = float(d["wall_ms"])
+        return out or None
+    if str(doc.get("metric", "")) == "soak":
+        # --soak rounds gate on per-tenant p99s (the SLO surface) plus
+        # overall throughput inverted into a wall-like number so a
+        # throughput collapse reads as a regression under the same
+        # bigger-is-worse median+MAD rule
+        out = {}
+        for tname, d in (doc.get("tenants") or {}).items():
+            if isinstance(d, dict) and "p99_ms" in d:
+                out[f"soak_{tname}_p99"] = float(d["p99_ms"])
+        qps = doc.get("throughput_qps")
+        if qps:
+            out["soak_ms_per_query"] = 1000.0 / float(qps)
         return out or None
     if str(doc.get("metric", "")).startswith("agg_micro"):
         # --agg-micro rounds gate on the strategy the gate would pick
@@ -1383,6 +1698,14 @@ def build_parser():
                       help="high-concurrency serving soak (plan/result "
                            "caches, CPU/TPU routing, micro-batching) -> "
                            "BENCH_concurrency.json")
+    mode.add_argument("--soak", action="store_true",
+                      help="sustained elastic-membership soak: mixed "
+                           "multi-tenant load + chaos + drain/join "
+                           "mid-run -> BENCH_soak.json")
+    soak = p.add_argument_group("--soak options")
+    soak.add_argument("--duration", type=float, default=None,
+                      help="soak duration seconds (default: 180 or "
+                           "TRINO_TPU_SOAK_DURATION_S)")
     conc = p.add_argument_group("--concurrency options")
     conc.add_argument("--clients", type=int, default=None,
                       help="concurrent clients (default: 120 or "
@@ -1421,6 +1744,9 @@ def main(argv=None):
         rec = concurrency_soak(n_clients=args.clients,
                                queries_per_client=args.queries_per_client)
         return 0 if rec["passed"] else 1
+    if args.soak:
+        rec = elastic_soak(duration_s=args.duration)
+        return 0 if rec["passed"] else 1
     if args.check_regressions:
         import glob as _glob
         ok, report = check_regressions(
@@ -1445,6 +1771,16 @@ def main(argv=None):
                                              mad_k=args.mad_k)
             report["scan_micro"] = report4
             ok = ok and ok4
+        # the elastic soak gates as its own series (BENCH_soak.json +
+        # later rounds' BENCH_soak_r*.json): a per-tenant p99 SLO
+        # blowout or a throughput collapse in a later round fails here
+        soak_paths = sorted(_glob.glob("BENCH_soak*.json"))
+        if soak_paths:
+            ok5, report5 = check_regressions(soak_paths,
+                                             ratio=args.ratio,
+                                             mad_k=args.mad_k)
+            report["soak"] = report5
+            ok = ok and ok5
         # the multichip trajectory gates as its own series too: each
         # driver round lands a MULTICHIP_r*.json whose tail carries the
         # dryrun's emitted JSON line (rounds before the partitioned-join

@@ -121,6 +121,12 @@ REQUIRED_FAMILIES = (
     "trino_tpu_scan_zones_pruned_total",
     "trino_tpu_scan_prefetch_buffers_in_use",
     "trino_tpu_scan_prefetch_stall_seconds",
+    # round-15 elastic-membership / tenancy surface: lifecycle
+    # transitions, drain handoffs, per-tenant accounting, soak SLOs
+    "trino_tpu_node_lifecycle_transitions_total",
+    "trino_tpu_splits_migrated_total",
+    "trino_tpu_tenant_queries_total",
+    "trino_tpu_soak_slo_violations_total",
 )
 
 

@@ -26,6 +26,7 @@ class QueryCreatedEvent:
     user: str
     sql: str
     create_time: float
+    tenant: str = "default"       # resource-group tenant (audit label)
 
 
 @dataclass(frozen=True)
@@ -47,6 +48,7 @@ class QueryCompletedEvent:
     hedges_fired: int = 0
     spills: int = 0               # spill-tier activations (history +
                                   # regression-detector input)
+    tenant: str = "default"       # resource-group tenant (audit label)
 
 
 class EventListener:
@@ -85,7 +87,8 @@ class EventListenerManager:
 
     def query_created(self, tq) -> None:
         ev = QueryCreatedEvent(tq.query_id, tq.session_user, tq.sql,
-                               time.time())
+                               time.time(),
+                               tenant=getattr(tq, "tenant", "default"))
         self._dispatch("query_created", ev)
 
     def query_completed(self, tq) -> None:
@@ -99,5 +102,6 @@ class EventListenerManager:
             bytes_shuffled=int(st.get("bytes_shuffled", 0)),
             faults_survived=int(st.get("faults_survived", 0)),
             hedges_fired=int(st.get("hedged_tasks", 0)),
-            spills=int(getattr(tq, "spills", 0)))
+            spills=int(getattr(tq, "spills", 0)),
+            tenant=getattr(tq, "tenant", "default"))
         self._dispatch("query_completed", ev)

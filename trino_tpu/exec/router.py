@@ -138,8 +138,50 @@ def plan_scan_rows(planner, root: L.PlanNode) -> float:
     return total
 
 
+class TenantFairShare:
+    """Per-tenant device-contention tracker for the router.
+
+    The device tier serializes behind the coordinator's exec lock, so
+    "contended" means: some OTHER tenant's query currently holds (or
+    waits for) the device. Under contention a tenant's host-eligible
+    queries overflow to the host tier instead of queueing behind a
+    neighbor's scan — the co-processing split from "Revisiting
+    Co-Processing for Hash Joins on the Coupled CPU-GPU Architecture":
+    keep the accelerator for the work that amortizes it, and keep small
+    tenants' latency off the contention path entirely. A tenant is
+    never overflowed by ITS OWN in-flight device work (its queries
+    serializing behind each other is its own fair queue)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight: dict = {}
+
+    def device_begin(self, tenant: str) -> None:
+        with self._lock:
+            self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+
+    def device_end(self, tenant: str) -> None:
+        with self._lock:
+            n = self._inflight.get(tenant, 0) - 1
+            if n <= 0:
+                self._inflight.pop(tenant, None)
+            else:
+                self._inflight[tenant] = n
+
+    def contended_by_others(self, tenant: str) -> bool:
+        with self._lock:
+            return any(n > 0 for t, n in self._inflight.items()
+                       if t != tenant)
+
+    def inflight(self) -> dict:
+        with self._lock:
+            return dict(self._inflight)
+
+
 def decide_route(planner, root: L.PlanNode, properties,
-                 history=None, fingerprint: Optional[str] = None
+                 history=None, fingerprint: Optional[str] = None,
+                 tenant: Optional[str] = None,
+                 fair_share: Optional[TenantFairShare] = None
                  ) -> RouteDecision:
     """Pick the execution target for a pruned local plan."""
     mode = str(properties.get("routing_mode", "auto")).lower()
@@ -153,6 +195,20 @@ def decide_route(planner, root: L.PlanNode, properties,
         return RouteDecision("host", "forced by routing_mode")
     if unsupported is not None:
         return RouteDecision("device", unsupported)
+    # per-tenant fair share: under device contention from OTHER tenants,
+    # a host-eligible plan overflows to the host tier even when history
+    # would have preferred the device — bounded at 4x the host row gate
+    # so a genuinely scan-heavy plan still waits for the device rather
+    # than grinding the host interpreter
+    if fair_share is not None and tenant is not None and \
+            fair_share.contended_by_others(tenant):
+        rows = plan_scan_rows(planner, root)
+        limit = int(properties.get("router_host_max_rows", 200_000))
+        if rows <= limit * 4:
+            return RouteDecision(
+                "host", "fair-share overflow: device contended by "
+                        f"other tenants, ~{rows:,.0f} scanned rows "
+                        "host-eligible", rows)
     # per-fingerprint history baseline: observed latency beats estimates
     if history is not None and fingerprint:
         try:

@@ -426,6 +426,28 @@ SCAN_PREFETCH_STALL_SECONDS = REGISTRY.counter(
     "Seconds the chunked-driver consumer spent waiting on a chunk the "
     "prefetch worker had not staged yet")
 
+# elastic cluster membership (server/worker.py lifecycle state machine,
+# server/coordinator.py announce protocol, server/scheduler.py drain
+# handoff) + per-tenant serving (server/resourcegroups.py tenant tree,
+# exec/router.py fair share) + the sustained soak harness (bench --soak)
+NODE_LIFECYCLE_TRANSITIONS = REGISTRY.counter(
+    "trino_tpu_node_lifecycle_transitions_total",
+    "Worker lifecycle transitions observed by the coordinator's node "
+    "inventory, by the state entered (ACTIVE | DRAINING | DRAINED | "
+    "LEFT | FAILED)", ("state",))
+SPLITS_MIGRATED = REGISTRY.counter(
+    "trino_tpu_splits_migrated_total",
+    "Splits handed off a DRAINING node and reassigned to survivors — "
+    "counted as migrations, never as task-retry failures")
+TENANT_QUERIES = REGISTRY.counter(
+    "trino_tpu_tenant_queries_total",
+    "Queries reaching a terminal state, by resource-group tenant",
+    ("tenant",))
+SOAK_SLO_VIOLATIONS = REGISTRY.counter(
+    "trino_tpu_soak_slo_violations_total",
+    "Per-tenant p99 SLO violations observed by the sustained-soak "
+    "harness (bench.py --soak)")
+
 # query history + latency-regression detection (server/history.py)
 LATENCY_REGRESSIONS = REGISTRY.counter(
     "trino_tpu_query_latency_regressions_total",
@@ -456,3 +478,6 @@ for _s in ("dense-lut", "hybrid-hash", "sort-merge", "sorted", "expand"):
     JOIN_STRATEGY_DECISIONS.init_labels(strategy=_s)
 for _m in ("broadcast", "partitioned"):
     JOIN_DISTRIBUTION_DECISIONS.init_labels(mode=_m)
+for _ls in ("ACTIVE", "DRAINING", "DRAINED", "LEFT", "FAILED"):
+    NODE_LIFECYCLE_TRANSITIONS.init_labels(state=_ls)
+TENANT_QUERIES.init_labels(tenant="default")

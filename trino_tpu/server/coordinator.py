@@ -106,7 +106,9 @@ class RegisteredNode:
         self.node_id = node_id
         self.uri = uri
         self.last_announce = time.time()
-        self.state = "ACTIVE"        # ACTIVE | SHUTTING_DOWN | FAILED
+        # lifecycle: ACTIVE | DRAINING | DRAINED | FAILED (a LEFT
+        # announce removes the entry from the inventory entirely)
+        self.state = "ACTIVE"
         # last heartbeat-reported memory pool snapshot (cluster
         # arbitration input; scheduler placement prefers low-memory nodes)
         self.memory: Optional[dict] = None
@@ -167,13 +169,17 @@ class Dispatcher:
         qid = self.tracker.next_query_id()
         tq = TrackedQuery(qid, sql, user, QueryStateMachine(qid),
                           traceparent=traceparent)
+        # tenant = the principal's resource-group leaf; labels metrics,
+        # history records, and audit events for per-tenant isolation
+        tq.tenant = self.resource_groups.tenant_of(user)
         self.tracker.register(tq)
         self.event_listeners.query_created(tq)
 
         def on_terminal(state):
             if state in ("FINISHED", "FAILED", "CANCELED"):
-                from ..metrics import QUERIES, QUERY_SECONDS
+                from ..metrics import QUERIES, QUERY_SECONDS, TENANT_QUERIES
                 QUERIES.inc(state=state)
+                TENANT_QUERIES.inc(tenant=tq.tenant)
                 QUERY_SECONDS.observe(tq.elapsed_s)
                 self.event_listeners.query_completed(tq)
 
@@ -355,6 +361,13 @@ class Dispatcher:
             # cluster path: fragment + dispatch to workers; None = not
             # eligible (coordinator executes locally)
             from .scheduler import TaskFailedError
+            # distributed execution occupies the exec lock like a device
+            # run: register it with the tenant fair-share tracker so a
+            # scan-heavy tenant's cluster queries count as device
+            # contention for everyone else's routing decisions
+            fair = getattr(serving, "fair_share", None)
+            if fair is not None:
+                fair.device_begin(getattr(tq, "tenant", "default"))
             try:
                 with self.exec_lock:
                     result = self.scheduler.execute(tq.sql,
@@ -364,6 +377,9 @@ class Dispatcher:
             except TaskFailedError as te:
                 result = None   # degrade to local execution
                 tq.fallback_reason = f"task failure: {te}"
+            finally:
+                if fair is not None:
+                    fair.device_end(getattr(tq, "tenant", "default"))
             tq.distributed = result is not None
             if tq.distributed:
                 # per-query stage/task rollup for events +
@@ -433,16 +449,48 @@ class CoordinatorState:
         from .system_connector import SystemConnector
         session.catalog.register("system", SystemConnector(self))
 
-    def announce(self, node_id: str, uri: str) -> None:
+    def announce(self, node_id: str, uri: str,
+                 state: str = "ACTIVE") -> None:
+        """Register/refresh a worker, honoring its reported lifecycle
+        state. LEFT deregisters (the graceful mirror of a failure-
+        detector eviction); DRAINING/DRAINED pull the node out of
+        placement without the detector penalty; ACTIVE restores a node
+        from a canceled drain (FAILED→ACTIVE recovery still goes
+        through the detector-ratio gate). Any membership or state
+        change triggers an immediate cluster-memory re-arbitration."""
+        from ..metrics import NODE_LIFECYCLE_TRANSITIONS
+        changed = False
         with self.nodes_lock:
             node = self.nodes.get(node_id)
-            if node is None or node.uri != uri:
+            if state == "LEFT":
+                if node is not None:
+                    del self.nodes[node_id]
+                    changed = True
+            elif node is None or node.uri != uri:
                 self.nodes[node_id] = RegisteredNode(node_id, uri)
+                self.nodes[node_id].state = \
+                    state if state in ("DRAINING", "DRAINED") else "ACTIVE"
+                changed = True
+                state = self.nodes[node_id].state
             else:
                 node.last_announce = time.time()
-                if node.state == "FAILED" and \
+                if state in ("DRAINING", "DRAINED"):
+                    # drain overrides FAILED: the worker is reachable
+                    # and winding down, not dead
+                    if node.state != state:
+                        node.state = state
+                        changed = True
+                elif node.state in ("DRAINING", "DRAINED"):
+                    node.state = "ACTIVE"    # drain canceled
+                    changed = True
+                elif node.state == "FAILED" and \
                         self._recovery_allowed(node_id):
                     node.state = "ACTIVE"    # recovered
+                    changed = True
+        if changed:
+            NODE_LIFECYCLE_TRANSITIONS.inc(state=state)
+            # outside nodes_lock: tick() re-reads the inventory itself
+            self.memory_manager.on_membership_change()
 
     def _recovery_allowed(self, node_id: str) -> bool:
         """A FAILED node may only rejoin on announce when the failure
@@ -615,7 +663,8 @@ class _Handler(BaseHTTPRequestHandler):
     def _post_announce(self, parts, user):
         body = json.loads(self._read_body() or "{}")
         self.state.announce(body.get("nodeId", "unknown"),
-                            body.get("uri", ""))
+                            body.get("uri", ""),
+                            state=body.get("state", "ACTIVE"))
         self._send(202, {"ok": True})
 
     def _get_info(self, parts, user):

@@ -77,6 +77,7 @@ class HeartbeatFailureDetector:
             ok = False
             memory = None
             device = None
+            reported = None
             try:
                 if self.injector is not None:
                     # chaos: RAISE/DROP -> failed probe sample; DELAY ->
@@ -93,6 +94,7 @@ class HeartbeatFailureDetector:
                         payload = json.loads(resp.read().decode())
                         memory = payload.get("memory")
                         device = payload.get("device")
+                        reported = payload.get("state")
                     except Exception:    # noqa: BLE001 — old workers
                         memory = None
             except Exception:
@@ -107,9 +109,19 @@ class HeartbeatFailureDetector:
                 if ok and device is not None:
                     live.device = device
                 if st.failure_ratio > self.threshold:
+                    # an unreachable node is FAILED even mid-drain: the
+                    # crash path (retry machinery) takes over from the
+                    # graceful one
                     live.state = "FAILED"
+                elif ok and reported in ("DRAINING", "DRAINED"):
+                    # lifecycle propagation: a healthy draining worker
+                    # leaves placement/hedging without a detector penalty
+                    live.state = reported
                 elif live.state == "FAILED":
                     live.state = "ACTIVE"
+                elif ok and reported == "ACTIVE" and \
+                        live.state in ("DRAINING", "DRAINED"):
+                    live.state = "ACTIVE"    # drain canceled
 
     def _loop(self) -> None:
         while not self._stop.is_set():

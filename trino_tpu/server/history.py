@@ -234,6 +234,7 @@ class QueryHistoryStore:
                 "query_id": tq.query_id,
                 "sql": tq.sql,
                 "user": tq.session_user,
+                "tenant": getattr(tq, "tenant", "default"),
                 "state": tq.state,
                 "elapsed_s": float(tq.elapsed_s),
                 "rows": int(tq.rows_returned),
@@ -275,6 +276,7 @@ class HistoryEventListener:
             "query_id": event.query_id,
             "sql": event.sql,
             "user": event.user,
+            "tenant": getattr(event, "tenant", "default"),
             "state": event.state,
             "elapsed_s": float(event.elapsed_s),
             "rows": int(event.rows),

@@ -40,6 +40,12 @@ class ClusterMemoryManager:
         self.kill_after_ticks = kill_after_ticks
         self.queries_killed = 0
         self.revocations = 0
+        # elastic membership: announce() calls on_membership_change()
+        # whenever a node joins/drains/leaves so arbitration re-runs
+        # against the new node set immediately instead of waiting out
+        # the polling interval
+        self.membership_rearbitrations = 0
+        self._membership_sig: tuple = ()
         self._pressure_ticks = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -95,7 +101,28 @@ class ClusterMemoryManager:
 
     # -- arbitration -------------------------------------------------------
 
+    def on_membership_change(self) -> None:
+        """Immediate re-arbitration on a membership/lifecycle change
+        (worker joined, started draining, or left): the cluster's
+        capacity just moved, so the resource-group tree and the
+        over-limit check must see the new node set now — a query
+        admitted against capacity that left with a drained worker
+        would otherwise run straight into the killer."""
+        try:
+            self.tick()
+        except Exception:    # noqa: BLE001 — arbitration must not fail
+            pass             # the announce that triggered it
+
+    def _note_membership(self) -> None:
+        with self.state.nodes_lock:
+            sig = tuple(sorted((n.node_id, n.state)
+                               for n in self.state.nodes.values()))
+        if sig != self._membership_sig:
+            self._membership_sig = sig
+            self.membership_rearbitrations += 1
+
     def tick(self) -> dict:
+        self._note_membership()
         snap = self.snapshot()
         total = snap["reserved"] + snap["revocable"]
         # memory-aware admission: the resource-group tree sees the

@@ -101,6 +101,34 @@ class ResourceGroup:
             g = g.parent
 
 
+def tenant_tree(tenants: Dict[str, dict],
+                hard_concurrency_limit: int = 4,
+                max_queued: int = 100) -> "ResourceGroupManager":
+    """Build a per-tenant manager: one sub-group per tenant under root,
+    each with its own concurrency/queue/soft-memory knobs, and a
+    selector routing `<tenant>` and `<tenant>-*` principals to it.
+    `tenants` maps tenant name -> overrides (any ResourceGroupConfig
+    field). The elastic soak uses this shape; production configs build
+    the same tree from whatever config source they like."""
+    subs = tuple(
+        ResourceGroupConfig(
+            name,
+            hard_concurrency_limit=ov.get("hard_concurrency_limit",
+                                          hard_concurrency_limit),
+            max_queued=ov.get("max_queued", max_queued),
+            soft_memory_limit_bytes=ov.get("soft_memory_limit_bytes"))
+        for name, ov in tenants.items())
+    selectors = [Selector(rf"{re.escape(name)}(-.*)?", f"root.{name}")
+                 for name in tenants]
+    root = ResourceGroupConfig(
+        "root",
+        hard_concurrency_limit=max(
+            hard_concurrency_limit,
+            sum(s.hard_concurrency_limit for s in subs)),
+        sub_groups=subs)
+    return ResourceGroupManager(root, selectors)
+
+
 class ResourceGroupManager:
     """Routes queries to leaf groups and gates execution: run now, queue,
     or reject (Too many queued queries)."""
@@ -134,6 +162,14 @@ class ResourceGroupManager:
             if re.fullmatch(sel.user_pattern, user):
                 return self._find(sel.group)
         return self.root
+
+    def tenant_of(self, user: str) -> str:
+        """The principal's tenant label: the leaf name of its selected
+        group ('default' for unselected users landing on root). Labels
+        per-tenant metrics, history records, and audit events."""
+        group = self.select(user)
+        return "default" if group is self.root \
+            else group.config.name
 
     def submit(self, user: str, run: Callable[[], None]) -> str:
         """Admit or queue `run`; returns the chosen group path. Raises

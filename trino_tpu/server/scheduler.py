@@ -32,7 +32,7 @@ import numpy as np
 
 from ..exec.chunked import ChunkAnalysis, analyze, merge_partials
 from ..metrics import (SCAN_SPLITS_PRUNED, SCHED_HEDGE_WINS, SCHED_HEDGES,
-                       SCHED_TASK_RETRIES, SCHED_TASKS)
+                       SCHED_TASK_RETRIES, SCHED_TASKS, SPLITS_MIGRATED)
 from ..planner import logical as L
 from ..planner.fragmenter import Fragment, fragment_plan
 from ..planner.optimizer import prune_plan
@@ -53,6 +53,13 @@ class PageIntegrityError(TaskFailedError):
     """A drained page failed its CRC32C check: corruption detected on the
     wire/buffer and converted into a retryable task failure (the split
     re-runs on a survivor) instead of silently wrong results."""
+
+
+class NodeDrainingError(TaskFailedError):
+    """A worker refused new work because it is DRAINING/DRAINED (HTTP
+    409 on the task POST). The splits migrate to survivors — counted as
+    migrations, never as task-retry failures, and the node keeps its
+    clean failure-detector record (it is winding down, not broken)."""
 
 
 def _merge_sorted_runs(sort_node, pages):
@@ -114,8 +121,8 @@ class _HedgedUnit:
     once by the first successful attempt (first-success-wins dedup)."""
 
     __slots__ = ("first_node", "splits", "key", "pages", "live", "hedged",
-                 "nodes_used", "failed_nodes", "started", "tasks",
-                 "winner")
+                 "nodes_used", "failed_nodes", "drained_nodes", "started",
+                 "tasks", "winner")
 
     def __init__(self, first_node: str, splits: List[Split], key: str):
         self.first_node = first_node
@@ -126,6 +133,10 @@ class _HedgedUnit:
         self.hedged = False
         self.nodes_used: Set[str] = set()
         self.failed_nodes: Set[str] = set()
+        # subset of failed_nodes that 409'd the task POST (drain
+        # handoff): a unit whose failures are ALL drain handoffs is a
+        # migration, not a failure
+        self.drained_nodes: Set[str] = set()
         self.started = time.monotonic()
         self.tasks: List["RemoteTask"] = []
         self.winner: Optional["RemoteTask"] = None
@@ -300,7 +311,8 @@ class StageScheduler:
                                       "task_retries": 0, "spool_hits": 0,
                                       "hedged_tasks": 0, "hedge_wins": 0,
                                       "checksum_failures": 0,
-                                      "splits_pruned": 0}
+                                      "splits_pruned": 0,
+                                      "splits_migrated": 0}
         # observability: per-query stage/task rollup (reset each execute;
         # read by the dispatcher into TrackedQuery.stage_stats), recent
         # task records for system.runtime.tasks, and per-(query, operator)
@@ -346,7 +358,7 @@ class StageScheduler:
         lq["_final"] = True
         snap = getattr(self, "_stats_snap", {})
         for k in ("task_retries", "hedged_tasks", "hedge_wins",
-                  "checksum_failures", "spool_hits"):
+                  "checksum_failures", "spool_hits", "splits_migrated"):
             lq[k] = self.stats.get(k, 0) - snap.get(k, 0)
         lq["stages"] = self.stats.get("stages", 0) - snap.get("stages", 0)
         lq["faults_survived"] = lq["task_retries"] + \
@@ -765,6 +777,7 @@ class StageScheduler:
                       backoff) -> List[bytes]:
         pages: List[bytes] = []
         retries = 0
+        migration_rounds = 0
         while pending:
             units: List[_HedgedUnit] = []
             for nid, sp in list(pending.items()):
@@ -777,20 +790,35 @@ class StageScheduler:
                     self.stats["spool_hits"] += 1
                     continue
                 units.append(_HedgedUnit(nid, sp, key))
-            failed_splits, failed_nodes = self._drain_units(
+            failed_splits, failed_nodes, migrated = self._drain_units(
                 units, by_id, blob, use_spool, pages)
             if not failed_splits:
                 break
-            # task retry: reassign failed nodes' splits to survivors
-            # (EventDrivenFaultTolerantQueryScheduler's per-task retry)
-            retries += 1
-            self.stats["task_retries"] += 1
-            SCHED_TASK_RETRIES.inc()
-            if retries > self.max_task_retries:
-                raise TaskFailedError(
-                    "task retries exhausted: " +
-                    ", ".join(sorted(failed_nodes)))
-            time.sleep(next(backoff, self.retry_backoff_max_s))
+            if migrated:
+                self.stats["splits_migrated"] += migrated
+                SPLITS_MIGRATED.inc(migrated)
+            if migrated == len(failed_splits):
+                # pure drain handoff: the splits move to survivors
+                # without burning retry budget, backoff, or the nodes'
+                # detector records — the cluster is healthy, just
+                # smaller. Bounded so a cluster draining faster than the
+                # inventory updates cannot ping-pong forever.
+                migration_rounds += 1
+                if migration_rounds > 16:
+                    raise TaskFailedError(
+                        "drain handoff did not converge: " +
+                        ", ".join(sorted(failed_nodes)))
+            else:
+                # task retry: reassign failed nodes' splits to survivors
+                # (EventDrivenFaultTolerantQueryScheduler's per-task retry)
+                retries += 1
+                self.stats["task_retries"] += 1
+                SCHED_TASK_RETRIES.inc()
+                if retries > self.max_task_retries:
+                    raise TaskFailedError(
+                        "task retries exhausted: " +
+                        ", ".join(sorted(failed_nodes)))
+                time.sleep(next(backoff, self.retry_backoff_max_s))
             survivors = [w for w in self.state.active_nodes()
                          if w.node_id not in failed_nodes]
             if not survivors:
@@ -805,11 +833,14 @@ class StageScheduler:
 
     def _drain_units(self, units: List["_HedgedUnit"], by_id, blob: str,
                      use_spool: bool, pages: List[bytes]
-                     ) -> Tuple[List[Split], Set[str]]:
+                     ) -> Tuple[List[Split], Set[str], int]:
         """Dispatch and drain one round of work units CONCURRENTLY with
         straggler hedging. Successful units' pages append to `pages`
         (and spool, when eligible); returns (failed splits, failed node
-        ids) for the caller's retry round.
+        ids, migrated-split count) for the caller's retry round — a
+        unit whose failures were ALL drain handoffs (409s from
+        DRAINING workers) contributes to the migrated count and its
+        nodes keep clean detector records.
 
         Hedging: once enough units complete to establish a median drain
         time, any unit still running past max(hedge_min_s, multiplier *
@@ -820,7 +851,7 @@ class StageScheduler:
         (the spool's work-key dedup gives later query attempts the same
         guarantee) — so hedging can duplicate WORK but never RESULTS."""
         if not units:
-            return [], set()
+            return [], set(), 0
         deadline = time.time() + self.task_timeout_s
         lock = threading.Lock()
         durations: List[float] = []
@@ -846,6 +877,16 @@ class StageScheduler:
                 drained = task.drain(deadline)
             except (TaskFailedError, InjectedFailure, URLError,
                     HTTPError, OSError) as e:
+                if isinstance(e, HTTPError) and e.code == 409:
+                    # drain handoff: the worker refused the POST because
+                    # it is winding down. No _mark_failed (the node is
+                    # healthy), no detector sample — the splits simply
+                    # migrate to a survivor in the next round.
+                    with lock:
+                        unit.failed_nodes.add(node.node_id)
+                        unit.drained_nodes.add(node.node_id)
+                        unit.live -= 1
+                    return
                 if isinstance(e, PageIntegrityError):
                     self.stats["checksum_failures"] += 1
                 task.cancel()
@@ -888,15 +929,27 @@ class StageScheduler:
                 if not unresolved:
                     break
                 med = statistics.median(durations) if durations else None
-            if med is not None and self.hedge_multiplier > 0:
+            # drain-aware hedging: a unit whose attempt is running on a
+            # node the inventory now shows DRAINING hedges immediately —
+            # the drain deadline may cut that attempt off, so a
+            # survivor copy starts NOW instead of after the straggler
+            # threshold (first success still wins either way)
+            with self.state.nodes_lock:
+                draining = {nid for nid, n in self.state.nodes.items()
+                            if n.state in ("DRAINING", "DRAINED")}
+            if self.hedge_multiplier > 0 and \
+                    (med is not None or draining):
                 threshold = max(self.hedge_min_s,
-                                self.hedge_multiplier * med)
+                                self.hedge_multiplier * med) \
+                    if med is not None else float("inf")
                 now = time.monotonic()
                 for u in unresolved:
                     candidate = None
                     with lock:
+                        urgent = bool(u.nodes_used & draining)
                         if u.hedged or u.pages is not None or \
-                                now - u.started < threshold:
+                                (not urgent and
+                                 now - u.started < threshold):
                             continue
                         for w in self.state.active_nodes():
                             if w.node_id not in u.nodes_used:
@@ -912,6 +965,7 @@ class StageScheduler:
 
         failed_splits: List[Split] = []
         failed_nodes: Set[str] = set()
+        migrated = 0
         with lock:
             resolved = [(u, u.pages, u.winner) for u in units]
         for u, got, winner in resolved:
@@ -928,7 +982,10 @@ class StageScheduler:
             else:
                 failed_splits.extend(u.splits)
                 failed_nodes.update(u.failed_nodes or {u.first_node})
-        return failed_splits, failed_nodes
+                if u.failed_nodes and \
+                        u.failed_nodes <= u.drained_nodes:
+                    migrated += len(u.splits)
+        return failed_splits, failed_nodes, migrated
 
     def _mark_failed(self, node_id: str, err: Exception) -> None:
         with self.state.nodes_lock:

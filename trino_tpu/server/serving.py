@@ -43,8 +43,8 @@ import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
-from ..exec.router import (HostUnsupported, decide_route, host_supported,
-                           run_host)
+from ..exec.router import (HostUnsupported, TenantFairShare, decide_route,
+                           host_supported, run_host)
 from ..planner import logical as L
 from ..planner.optimizer import prune_plan
 from ..sql import ast_nodes as A
@@ -467,6 +467,10 @@ class ServingLayer:
         self.result_cache = ResultCache()
         self.microbatcher = MicroBatcher(self)
         self.history = None            # QueryHistoryStore (coordinator)
+        # per-tenant device-contention tracker (exec/router.py): under
+        # contention from other tenants, host-eligible queries overflow
+        # to the host tier instead of queueing on the exec lock
+        self.fair_share = TenantFairShare()
         # fingerprints the serving layer does not own: non-query
         # statements (DDL/SET/SHOW) and volatile system-table queries
         # both execute through the legacy session path; remembering them
@@ -616,14 +620,19 @@ class ServingLayer:
 
     def run_routed(self, rel, root, tq, fingerprint=None):
         """Route one pruned plan and execute it (host: lock-free numpy;
-        device: the session executor under the exec lock)."""
+        device: the session executor under the exec lock). The tenant
+        fair-share tracker sees every device occupancy so a contended
+        device overflows other tenants' small queries to the host."""
         from ..metrics import ROUTER_DECISIONS
         session = self.session
         t0 = time.monotonic()
         planner = session.planner()
+        tenant = getattr(tq, "tenant", None) if tq is not None else None
         decision = decide_route(planner, root, session.properties,
                                 history=self.history,
-                                fingerprint=fingerprint)
+                                fingerprint=fingerprint,
+                                tenant=tenant,
+                                fair_share=self.fair_share)
         if tq is not None:
             tq.route = decision.target
             tq.route_reason = decision.reason
@@ -639,8 +648,12 @@ class ServingLayer:
                     tq.route = "device"
                     tq.route_reason = f"host fallback: {e}"
         ROUTER_DECISIONS.inc(target="device")
-        with self.exec_lock:
-            return session.execute_planned(rel, root, t0)
+        self.fair_share.device_begin(tenant or "default")
+        try:
+            with self.exec_lock:
+                return session.execute_planned(rel, root, t0)
+        finally:
+            self.fair_share.device_end(tenant or "default")
 
     def info(self) -> dict:
         return {

@@ -127,6 +127,10 @@ class TrackedQuery:
     # reasoning — surfaced in /v1/query info
     route: Optional[str] = None
     route_reason: Optional[str] = None
+    # resource-group tenant (the principal's selected leaf group):
+    # labels metrics, history records, and audit events so per-tenant
+    # isolation is observable, not just enforced
+    tenant: str = "default"
 
     @property
     def state(self) -> str:

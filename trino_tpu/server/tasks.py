@@ -305,6 +305,40 @@ class TaskManager:
                 # wake a producer paused on a full output buffer
                 task.cond.notify_all()
 
+    def inflight(self) -> List[str]:
+        """Ids of tasks still PENDING/RUNNING (drain bookkeeping)."""
+        with self._lock:
+            return [t.task_id for t in self.tasks.values()
+                    if t.state in ("PENDING", "RUNNING")]
+
+    def unflushed(self) -> List[str]:
+        """Ids of finished tasks whose output buffers still hold
+        un-acked pages — a draining worker keeps serving these until its
+        downstream consumers pull them (or the drain deadline passes and
+        the scheduler's retry machinery re-runs the work elsewhere)."""
+        out = []
+        with self._lock:
+            tasks = list(self.tasks.values())
+        for t in tasks:
+            with t.cond:
+                if t.state == "FINISHED" and any(t.buffers.values()):
+                    out.append(t.task_id)
+        return out
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Bounded graceful drain: wait for every in-flight task to reach
+        a terminal state, then for every finished task's output buffers
+        to be fully pulled/acked by their consumers. Returns True when
+        the worker quiesced cleanly (no orphaned splits, no unflushed
+        pages) within the budget. The caller stops accepting NEW task
+        POSTs before calling this; existing buffers stay pullable
+        throughout and after."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while (self.inflight() or self.unflushed()) and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        return not self.inflight() and not self.unflushed()
+
     def memory_info(self) -> dict:
         """Pool snapshot + staged output bytes, reported on /v1/status so
         heartbeats carry this worker's memory to the coordinator's

@@ -38,6 +38,7 @@ SERVER_NAME = "worker"
 ROUTES = (
     ("GET", ("v1", "status"), "_get_status", False),
     ("GET", ("v1", "info"), "_get_info", False),
+    ("GET", ("v1", "info", "state"), "_get_state", False),
     ("GET", ("v1", "metrics"), "_get_metrics", False),
     ("GET", ("v1", "task", STAR), "_get_task", "internal"),
     ("GET", ("v1", "task", STAR, "results", STAR), "_get_results",
@@ -127,7 +128,14 @@ class _WorkerHandler(BaseHTTPRequestHandler):
 
     def _get_info(self, parts, user):
         self._send(200, {"nodeVersion": {"version": "trino-tpu-0.1"},
-                         "coordinator": False})
+                         "coordinator": False,
+                         "state": self.worker.state})
+
+    # GET /v1/info/state — the read side of the drain request (the
+    # reference's NodeState resource); open like the other liveness
+    # routes so operators can watch a drain without the secret
+    def _get_state(self, parts, user):
+        self._send(200, {"state": self.worker.state})
 
     def _get_metrics(self, parts, user):
         from ..metrics import REGISTRY
@@ -212,6 +220,13 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         if self.worker.fail_tasks:           # fault injection hook
             self._send(500, {"error": "injected task failure"})
             return
+        if self.worker.state != "ACTIVE":
+            # a draining/drained worker accepts NO new work; 409 tells
+            # the scheduler this is a lifecycle handoff (the splits
+            # migrate to survivors), not a node failure
+            self._send(409, {"error": f"node is {self.worker.state}",
+                             "errorName": "NODE_DRAINING"})
+            return
         n = int(self.headers.get("Content-Length", 0))
         body = json.loads(self.rfile.read(n).decode())
         from .failureinjector import InjectedFailure
@@ -236,21 +251,50 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         self.worker.task_manager.cancel(parts[2])
         self._send(204, {})
 
-    def _put_state(self, parts, user):       # graceful shutdown / drain
+    # PUT /v1/info/state — the admin drain request
+    # (server/ServerInfoResource.java updateState's SHUTTING_DOWN path):
+    # "DRAINING" starts the graceful-drain sequence asynchronously;
+    # "ACTIVE" cancels a not-yet-completed drain (the node resumes
+    # accepting work and re-announces).
+    def _put_state(self, parts, user):
         n = int(self.headers.get("Content-Length", 0))
-        state = json.loads(self.rfile.read(n).decode())
-        self.worker.state = state
+        body = json.loads(self.rfile.read(n).decode())
+        requested = body.get("state") if isinstance(body, dict) else body
+        if requested not in ("DRAINING", "ACTIVE"):
+            self._send(400, {"error": f"cannot request state "
+                                      f"{requested!r} (valid: DRAINING, "
+                                      f"ACTIVE)"})
+            return
+        if requested == "DRAINING":
+            self.worker.request_drain()
+        else:
+            self.worker.cancel_drain()
         self._send(200, {"state": self.worker.state})
 
 
 class WorkerServer:
-    """One worker process stand-in: HTTP status endpoint + announcer loop."""
+    """One worker process stand-in: HTTP status endpoint + announcer loop.
+
+    Lifecycle: ACTIVE -> DRAINING -> DRAINED -> LEFT. A drain (admin
+    `PUT /v1/info/state` or a graceful `stop()`) stops task intake,
+    finishes in-flight splits, keeps output buffers pullable until
+    consumers drain them, then deregisters with a final LEFT announce.
+    Every announce carries the state, so the coordinator's scheduler
+    stops placing splits here the moment DRAINING lands."""
 
     def __init__(self, node_id: str, coordinator_uri: str, port: int = 0,
-                 announce_interval_s: float = 1.0, catalog=None):
+                 announce_interval_s: float = 1.0, catalog=None,
+                 drain_timeout_s: float = 30.0,
+                 flush_grace_s: float = 1.0):
         self.node_id = node_id
         self.coordinator_uri = coordinator_uri
         self.state = "ACTIVE"
+        self.drain_timeout_s = drain_timeout_s
+        # bounded wait for FINISHED tasks' unpulled output buffers
+        # before DRAINED: consumers normally drain within this; buffers
+        # abandoned by failed/hedge-lost queries must not hold the
+        # drain hostage (they stay pullable until the process stops)
+        self.flush_grace_s = flush_grace_s
         self.fail_status = False
         self.fail_tasks = False          # inject: task creation fails
         self.fail_results = False        # inject: result fetch fails
@@ -267,6 +311,9 @@ class WorkerServer:
         self.uri = f"http://127.0.0.1:{self.port}"
         self.announce_interval_s = announce_interval_s
         self._stop = threading.Event()
+        self._state_lock = threading.Lock()
+        self._drain_thread: Optional[threading.Thread] = None
+        self._drain_cancel = threading.Event()
         self._threads = []
 
     def start(self) -> "WorkerServer":
@@ -279,17 +326,21 @@ class WorkerServer:
         self._threads = [t1, t2]
         return self
 
-    def announce_once(self, attempts: int = 5) -> None:
+    def announce_once(self, attempts: int = 5,
+                      state: Optional[str] = None) -> None:
         """Announce to the coordinator, retrying transient failures with
         backoff + decorrelated jitter — a worker that boots before the
         coordinator (or across a coordinator restart) must not fail its
-        announcement permanently on one refused connection."""
+        announcement permanently on one refused connection. The announce
+        body carries the lifecycle state so membership transitions reach
+        the coordinator without waiting for a heartbeat round trip."""
         from .retrypolicy import RetryPolicy
 
         def post():
             from .security import internal_headers
             body = json.dumps({"nodeId": self.node_id,
-                               "uri": self.uri}).encode()
+                               "uri": self.uri,
+                               "state": state or self.state}).encode()
             req = Request(f"{self.coordinator_uri}/v1/announce", data=body,
                           headers={"Content-Type": "application/json",
                                    **internal_headers()})
@@ -310,7 +361,108 @@ class WorkerServer:
                 pass                      # coordinator down: keep trying
             self._stop.wait(self.announce_interval_s)
 
-    def stop(self) -> None:
+    # -- lifecycle state machine -------------------------------------------
+
+    def _transition(self, new_state: str) -> bool:
+        """ACTIVE -> DRAINING -> DRAINED -> LEFT (DRAINING may revert to
+        ACTIVE when an admin cancels the drain). Invalid edges no-op."""
+        allowed = {"ACTIVE": ("DRAINING",),
+                   "DRAINING": ("DRAINED", "ACTIVE"),
+                   "DRAINED": ("LEFT",),
+                   "LEFT": ()}
+        with self._state_lock:
+            if new_state not in allowed.get(self.state, ()):
+                return False
+            self.state = new_state
+        from ..metrics import NODE_LIFECYCLE_TRANSITIONS
+        NODE_LIFECYCLE_TRANSITIONS.inc(state=new_state)
+        return True
+
+    def request_drain(self) -> bool:
+        """Start the graceful-drain sequence asynchronously: stop
+        accepting task POSTs now (state flips before this returns),
+        finish/flush in flight, then deregister."""
+        if not self._transition("DRAINING"):
+            return self.state in ("DRAINING", "DRAINED", "LEFT")
+        self._drain_cancel.clear()
+        self._drain_thread = threading.Thread(
+            target=self._drain_sequence, name=f"drain-{self.node_id}",
+            daemon=True)
+        self._drain_thread.start()
+        return True
+
+    def cancel_drain(self) -> bool:
+        """Abort a DRAINING worker back to ACTIVE (no-op once DRAINED:
+        the handoff already happened, rejoining takes a fresh announce
+        anyway — which `_transition` forbids to keep the ratchet
+        one-way per drain request)."""
+        self._drain_cancel.set()
+        if self._transition("ACTIVE"):
+            self._announce_now()
+            return True
+        return False
+
+    def _announce_now(self, state: Optional[str] = None) -> None:
+        try:
+            self.announce_once(attempts=2, state=state)
+        except Exception:     # noqa: BLE001 — coordinator may be gone
+            pass
+
+    def _drain_sequence(self) -> None:
+        """The drain body: announce DRAINING immediately, finish every
+        in-flight task (bounded by drain_timeout_s), give finished
+        tasks' output buffers a flush grace for downstream consumers to
+        pull, then DRAINED, then the deregistering LEFT announce.
+        Anything the deadline cuts off re-runs on survivors via the
+        scheduler's retry machinery (durable-spool dedup keeps that
+        bit-exact); buffers stay pullable even after LEFT, until the
+        process actually stops — hedge losers and failed queries
+        abandon FINISHED buffers nobody will ever pull, so the flush
+        wait is a grace period, not a completion requirement."""
+        self._announce_now()
+        deadline = time.monotonic() + self.drain_timeout_s
+        while self.task_manager.inflight() and \
+                time.monotonic() < deadline and \
+                not self._drain_cancel.is_set():
+            time.sleep(0.02)
+        flush_deadline = min(deadline,
+                             time.monotonic() + self.flush_grace_s)
+        while self.task_manager.unflushed() and \
+                time.monotonic() < flush_deadline and \
+                not self._drain_cancel.is_set():
+            time.sleep(0.02)
+        if self._drain_cancel.is_set():
+            return                        # admin reverted to ACTIVE
+        if self._transition("DRAINED"):
+            self._announce_now()
+        if self._transition("LEFT"):
+            self._announce_now()
+
+    def drained(self) -> bool:
+        """True once the drain sequence fully quiesced (no in-flight
+        tasks, no unflushed buffers) and the worker deregistered."""
+        return self.state == "LEFT"
+
+    def stop(self, graceful: bool = True,
+             timeout_s: Optional[float] = None) -> None:
+        """Graceful by default: run the same bounded drain an admin
+        `PUT /v1/info/state` triggers (SIGTERM in the soak harness is
+        indistinguishable from an admin drain), then shut the HTTP
+        server down. `graceful=False` is the hard-crash path tests use
+        to simulate worker death."""
+        if graceful and self.state == "ACTIVE":
+            budget = self.drain_timeout_s if timeout_s is None \
+                else timeout_s
+            if self.request_drain():
+                deadline = time.monotonic() + budget
+                while self.state != "LEFT" and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.02)
         self._stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()
+
+    def kill(self) -> None:
+        """Ungraceful death (no drain, no deregister) — the crash the
+        failure detector and retry machinery exist for."""
+        self.stop(graceful=False)
