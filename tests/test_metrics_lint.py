@@ -127,6 +127,12 @@ REQUIRED_FAMILIES = (
     "trino_tpu_splits_migrated_total",
     "trino_tpu_tenant_queries_total",
     "trino_tpu_soak_slo_violations_total",
+    # round-16 cold-start surface: AOT prewarm accounting + the
+    # shape-canonicalization distinct-shape gauge
+    "trino_tpu_prewarm_compiles_total",
+    "trino_tpu_prewarm_hits_total",
+    "trino_tpu_compile_seconds_saved_total",
+    "trino_tpu_jit_distinct_shapes",
 )
 
 

@@ -182,6 +182,7 @@ class Executor:
         # chunked-driver prefetch pipeline depth: how many decoded+staged
         # chunks may sit ahead of the device (0 = the serial loop)
         self.prefetch_depth = 2
+        self.prewarm_chunks = False
         # seeded FailureInjector (server/failureinjector.py) for chaos
         # coverage of executor-side worker threads; None outside tests
         self.failure_injector = None
@@ -1222,7 +1223,7 @@ class Executor:
         straddle partitions, so per-partition results concatenate
         exactly."""
         from ..batch import batch_from_numpy, batch_to_numpy, \
-            pad_capacity
+            bucket_capacity
         from ..ops import pallas_hash as ph
         from ..ops.aggregate import sort_group_aggregate
         from .spill import _partition_ids
@@ -1250,7 +1251,7 @@ class Executor:
                     # the sort kernel finishes it — groups are disjoint
                     # across partitions either way
                     out = sort_group_aggregate(
-                        pb, keys, aggs, pad_capacity(int(m.sum())),
+                        pb, keys, aggs, bucket_capacity(int(m.sum())),
                         self.gather_mode())
                 oa, ov = batch_to_numpy(out)
                 if oa and len(oa[0]):
@@ -1829,7 +1830,7 @@ class Executor:
             # row-count round trip)
             live = self.fetch_ints(node, "dflive",
                                    jnp.sum(probe.live))[0]
-            new_cap = pad_capacity(live)
+            new_cap = bucket_capacity(live)
             if new_cap * 4 <= probe.capacity:
                 self.stats.dynamic_filter_compactions += 1
                 probe = compact_batch(probe, new_cap)
@@ -1923,7 +1924,7 @@ class Executor:
                 continue
             if total <= cap:
                 break
-            cap = pad_capacity(total)
+            cap = bucket_capacity(total)
             self.stats.join_expansion_retries += 1
         live = probe.live & (mark if node.kind == "semi" else ~mark)
         return probe.with_live(live)

@@ -1,0 +1,359 @@
+"""Cold-start elimination: AOT plan pre-warming + compile-aware routing.
+
+The bench trajectory's cold-start wall (cold q5 SF100 ~297s vs ~12s
+steady) is almost entirely XLA compilation. The reference engine never
+pays an analogous penalty because its long-lived JVM keeps generated
+PageProcessor bytecode warm across queries; the XLA analog needs three
+composable pieces, and this module is the conductor for all of them:
+
+1. **AOT pre-warming** — at coordinator start, rank the top-N
+   historical plan fingerprints (`server/history.py
+   top_fingerprints`), re-plan their SQL, and execute each once in a
+   background thread under `CompileRecorder.prewarm_context()`. Every
+   jit site along the path compiles off the query path; the first
+   query-path hit on a prewarmed program claims its compile wall as
+   `compile_seconds_saved_total`. Bounded by TRINO_TPU_PREWARM_BUDGET_S.
+2. **Shape canonicalization** — data-dependent capacities land on the
+   `bucket_capacity` lattice ({2^k, 1.5*2^k}, min 1024), so the
+   canonical shape set is enumerable: `canonical_lattice()` is what the
+   warm-manifest ships to joining workers and what `warm_shapes`
+   compiles against.
+3. **Compile-aware routing** — while a fingerprint's device program is
+   cold (no warm completed, or a prewarm in flight), `decide_route`
+   sends host-eligible queries to the bit-exact numpy interpreter and
+   the serving layer kicks a background device warm; once warm, the
+   same fingerprint routes to device. No user-facing query blocks on a
+   multi-second compile.
+
+The engine is OFF unless TRINO_TPU_PREWARM is set truthy (or a caller
+enables it explicitly); disabled, every surface returns the pre-prewarm
+behavior exactly — `decide_route` never sees a cold signal and no
+background threads start.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+log = logging.getLogger("trino_tpu.prewarm")
+
+DEFAULT_TOP_N = 8
+DEFAULT_BUDGET_S = 60.0
+# canonical-shape warm ceiling: lattice points above this are rare
+# enough (and expensive enough to compile) that only a real plan warm
+# should pay for them
+DEFAULT_MAX_SHAPE = 1 << 20
+
+
+def _env_truthy(name: str, default: str = "0") -> bool:
+    return os.environ.get(name, default).strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+def prewarm_enabled_by_env() -> bool:
+    return _env_truthy("TRINO_TPU_PREWARM")
+
+
+def canonical_lattice(max_cap: int = DEFAULT_MAX_SHAPE) -> List[int]:
+    """Every bucket_capacity lattice point in [1024, max_cap] — the
+    enumerable canonical shape set that shape canonicalization buys.
+    Two points per octave: 2^k and 1.5*2^k."""
+    out = []
+    cap = 1024
+    while cap <= max_cap:
+        out.append(cap)
+        half = (cap * 3) // 2
+        if half <= max_cap:
+            out.append(half)
+        cap <<= 1
+    return out
+
+
+def compile_cache_stats() -> dict:
+    """Persistent compile-cache stats for the /v1/status heartbeat:
+    whether the JAX persistent cache is active, where, and how much it
+    holds. File counting is best-effort and bounded."""
+    from .. import COMPILE_CACHE_DIR
+    out = {"active": COMPILE_CACHE_DIR is not None,
+           "dir": COMPILE_CACHE_DIR, "files": 0, "bytes": 0}
+    if COMPILE_CACHE_DIR and os.path.isdir(COMPILE_CACHE_DIR):
+        try:
+            with os.scandir(COMPILE_CACHE_DIR) as it:
+                for i, ent in enumerate(it):
+                    if i >= 10000:
+                        break
+                    if ent.is_file():
+                        out["files"] += 1
+                        try:
+                            out["bytes"] += ent.stat().st_size
+                        except OSError:
+                            pass
+        except OSError:
+            pass
+    return out
+
+
+# representative canonical-shape program: a masked reduction over one
+# padded column — what every operator's epilogue looks like to XLA at a
+# given capacity. A joining worker compiles this per lattice point so
+# the device allocator, the dialect pipelines, and (when shared) the
+# persistent cache are warm at every canonical shape before the first
+# fragment lands.
+def _make_warm_kernel():
+    from .profiler import recorded_jit
+
+    @recorded_jit(site="prewarm.shape")
+    def _warm_kernel(data, valid, live):
+        import jax.numpy as jnp
+        ok = valid & live
+        return (jnp.sum(jnp.where(ok, data, 0)),
+                jnp.sum(ok.astype(jnp.int32)))
+
+    return _warm_kernel
+
+
+_WARM_KERNEL = None
+_WARM_KERNEL_LOCK = threading.Lock()
+
+
+def _warm_kernel():
+    global _WARM_KERNEL
+    with _WARM_KERNEL_LOCK:
+        if _WARM_KERNEL is None:
+            _WARM_KERNEL = _make_warm_kernel()
+        return _WARM_KERNEL
+
+
+class PrewarmEngine:
+    """Coordinator/worker-side prewarm conductor.
+
+    Coordinator wiring (server/coordinator.py CoordinatorState): the
+    engine gets the session, the history store, and the dispatcher's
+    exec lock; `maybe_start()` launches the AOT warm thread when the
+    engine is enabled; `ServingLayer.run_routed` consults
+    `device_cold()` through `decide_route` and calls `ensure_warming` /
+    `mark_warm` around device runs. Worker wiring (server/worker.py):
+    a joining worker builds a detached engine, pulls the coordinator's
+    `manifest()` over GET /v1/prewarm, and runs `warm_shapes` before
+    its first ACTIVE announce."""
+
+    def __init__(self, session=None, history=None,
+                 exec_lock: Optional[threading.Lock] = None,
+                 enabled: Optional[bool] = None,
+                 top_n: Optional[int] = None,
+                 budget_s: Optional[float] = None,
+                 run_sql: Optional[Callable[[str], object]] = None):
+        self.session = session
+        self.history = history
+        self.exec_lock = exec_lock
+        self.enabled = prewarm_enabled_by_env() if enabled is None \
+            else bool(enabled)
+        self.top_n = int(os.environ.get("TRINO_TPU_PREWARM_TOP_N",
+                                        DEFAULT_TOP_N)) \
+            if top_n is None else int(top_n)
+        self.budget_s = float(os.environ.get("TRINO_TPU_PREWARM_BUDGET_S",
+                                             DEFAULT_BUDGET_S)) \
+            if budget_s is None else float(budget_s)
+        self._run_sql = run_sql
+        self._lock = threading.Lock()
+        self._warmed: set = set()          # fingerprints with a warm program
+        self._inflight: set = set()        # fingerprints compiling right now
+        self._sql_by_fp: Dict[str, str] = {}
+        self._threads: List[threading.Thread] = []
+        self._deadline: Optional[float] = None
+        self.warm_rounds = 0               # completed warm_all passes
+        self.shape_warms = 0               # canonical shapes compiled
+        self.started_at: Optional[float] = None
+        if self.enabled and self.session is not None:
+            # the chunked-driver fused-compile warm (exec/chunked.py)
+            # rides the same opt-in as the engine itself
+            self.session.properties["prewarm_chunks"] = True
+
+    # -- cold/warm state (the router's signal) ------------------------------
+
+    def device_cold(self, fingerprint: Optional[str]) -> bool:
+        """True while this fingerprint's device program has not been
+        warmed (by prewarm OR by a completed device run). The router
+        sends host-eligible queries to the host interpreter for exactly
+        this window; `mark_warm` closes it."""
+        if not self.enabled or not fingerprint:
+            return False
+        with self._lock:
+            return fingerprint not in self._warmed
+
+    def is_warm(self, fingerprint: str) -> bool:
+        with self._lock:
+            return fingerprint in self._warmed
+
+    def is_inflight(self, fingerprint: str) -> bool:
+        with self._lock:
+            return fingerprint in self._inflight
+
+    def mark_warm(self, fingerprint: Optional[str]) -> None:
+        """A device program for this fingerprint exists now — either a
+        prewarm finished or a query-path device run completed (which
+        compiled it on-path)."""
+        if not fingerprint:
+            return
+        with self._lock:
+            self._warmed.add(fingerprint)
+            self._inflight.discard(fingerprint)
+
+    # -- warming ------------------------------------------------------------
+
+    def _budget_left(self) -> float:
+        if self._deadline is None:
+            return self.budget_s
+        return self._deadline - time.monotonic()
+
+    def warm_fingerprint(self, fingerprint: str, sql: str) -> bool:
+        """Compile this statement's device programs off the query path:
+        execute it once under prewarm_context (every jit site along the
+        plan records an off-path prewarm compile), then mark the
+        fingerprint warm. Returns False when the warm failed or was
+        skipped (already warm / in flight / no runner)."""
+        if not sql:
+            return False
+        with self._lock:
+            if fingerprint in self._warmed or \
+                    fingerprint in self._inflight:
+                return False
+            self._inflight.add(fingerprint)
+            self._sql_by_fp.setdefault(fingerprint, sql)
+        from .profiler import RECORDER
+        ok = False
+        try:
+            runner = self._run_sql
+            if runner is None and self.session is not None:
+                runner = self.session.execute
+            if runner is None:
+                return False
+            with RECORDER.prewarm_context():
+                if self.exec_lock is not None:
+                    with self.exec_lock:
+                        runner(sql)
+                else:
+                    runner(sql)
+            ok = True
+        except Exception as e:    # noqa: BLE001 — warming is best-effort
+            log.warning("prewarm of %s failed: %s", fingerprint, e)
+        finally:
+            with self._lock:
+                self._inflight.discard(fingerprint)
+                if ok:
+                    self._warmed.add(fingerprint)
+        return ok
+
+    def ensure_warming(self, fingerprint: str, sql: str) -> None:
+        """Kick a background warm for a cold fingerprint the serving
+        layer just routed to host. Dedup'd: one warm per fingerprint.
+        When the warm completes the fingerprint routes to device."""
+        if not self.enabled or not fingerprint or not sql:
+            return
+        with self._lock:
+            if fingerprint in self._warmed or \
+                    fingerprint in self._inflight:
+                return
+        t = threading.Thread(
+            target=self.warm_fingerprint, args=(fingerprint, sql),
+            name=f"prewarm-{fingerprint[:8]}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def warm_all(self) -> int:
+        """One AOT pass over the top-N historical fingerprints, bounded
+        by the budget. Returns how many statements warmed."""
+        if self.history is None:
+            return 0
+        self._deadline = time.monotonic() + self.budget_s
+        warmed = 0
+        for ent in self.history.top_fingerprints(self.top_n):
+            if self._budget_left() <= 0:
+                log.info("prewarm budget exhausted after %d statements",
+                         warmed)
+                break
+            if self.warm_fingerprint(ent["fingerprint"], ent["sql"]):
+                warmed += 1
+        self.warm_rounds += 1
+        return warmed
+
+    def warm_shapes(self, capacities: Optional[List[int]] = None,
+                    max_cap: int = DEFAULT_MAX_SHAPE) -> int:
+        """Compile the representative canonical-shape kernel at each
+        lattice capacity (joining-worker handshake path). Bounded by
+        the budget; returns how many shapes compiled."""
+        import numpy as np
+        caps = capacities if capacities is not None \
+            else canonical_lattice(max_cap)
+        if self._deadline is None:
+            self._deadline = time.monotonic() + self.budget_s
+        kern = _warm_kernel()
+        from .profiler import RECORDER
+        done = 0
+        for cap in caps:
+            if self._budget_left() <= 0:
+                break
+            try:
+                import jax
+                import jax.numpy as jnp
+                data = jnp.zeros(int(cap), dtype=jnp.int64)
+                mask = jnp.zeros(int(cap), dtype=bool)
+                with RECORDER.prewarm_context():
+                    jax.block_until_ready(kern(data, mask, mask))
+                done += 1
+            except Exception as e:  # noqa: BLE001 — best-effort
+                log.warning("shape warm at %d failed: %s", cap, e)
+                break
+        self.shape_warms += done
+        return done
+
+    def maybe_start(self) -> bool:
+        """Launch the startup AOT warm in the background when enabled.
+        Returns whether a warm thread started."""
+        if not self.enabled or self.history is None:
+            return False
+        self.started_at = time.time()
+        t = threading.Thread(target=self.warm_all, name="prewarm-aot",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        return True
+
+    def wait(self, timeout_s: float = 30.0) -> None:
+        """Join outstanding warm threads (tests + the worker handshake)."""
+        deadline = time.monotonic() + timeout_s
+        for t in list(self._threads):
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+    # -- read surface -------------------------------------------------------
+
+    def manifest(self) -> dict:
+        """The warm-manifest a joining worker pulls before announcing
+        ACTIVE: top historical fingerprints (with the SQL to re-plan
+        and rank scores) plus the canonical shape lattice."""
+        fps = self.history.top_fingerprints(self.top_n) \
+            if self.history is not None else []
+        return {"enabled": self.enabled,
+                "fingerprints": fps,
+                "shapes": canonical_lattice(),
+                "budget_s": self.budget_s}
+
+    def stats(self) -> dict:
+        from .profiler import RECORDER
+        with self._lock:
+            warmed = len(self._warmed)
+            inflight = len(self._inflight)
+        t = RECORDER.totals()
+        return {"enabled": self.enabled,
+                "warmedFingerprints": warmed,
+                "inflight": inflight,
+                "warmRounds": self.warm_rounds,
+                "shapeWarms": self.shape_warms,
+                "prewarmedPrograms": t["prewarmedPrograms"],
+                "prewarmHits": t["prewarmHits"],
+                "compileSecondsSaved": t["compileSecondsSaved"],
+                "compileCache": compile_cache_stats()}

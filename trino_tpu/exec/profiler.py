@@ -90,6 +90,16 @@ class CompileRecorder:
         self.total_compiles = 0
         self.total_hits = 0
         self.total_compile_s = 0.0
+        # shape-canonicalization signal: every fingerprint ever seen per
+        # site (survives the entry LRU — the lint cares about distinct
+        # shapes produced, not about what is still cached)
+        self._site_shapes: Dict[str, set] = {}
+        # (site, fingerprint) -> off-path compile seconds, pending the
+        # first query-path hit that claims the saving
+        self._prewarm_pending: Dict[tuple, float] = {}
+        self.total_prewarmed = 0
+        self.total_prewarm_hits = 0
+        self.total_saved_s = 0.0
         self._tl = threading.local()
 
     # -- per-thread attribution --------------------------------------------
@@ -118,6 +128,20 @@ class CompileRecorder:
         finally:
             self._tl.site_prefix = prev
 
+    @contextmanager
+    def prewarm_context(self):
+        """Mark every compile recorded on this thread inside the block
+        as an OFF-PATH prewarm compile (exec/prewarm.py): it counts as
+        prewarm_compiles_total instead of charging the thread-bound
+        ExecStats, and the first later query-path hit on the same
+        (site, fingerprint) claims its wall as compile seconds saved."""
+        prev = getattr(self._tl, "prewarm", False)
+        self._tl.prewarm = True
+        try:
+            yield
+        finally:
+            self._tl.prewarm = prev
+
     # -- recording ---------------------------------------------------------
 
     def record(self, site: str, fingerprint: str, duration_s: float,
@@ -125,10 +149,16 @@ class CompileRecorder:
         prefix = getattr(self._tl, "site_prefix", None)
         if prefix:
             site = f"{prefix}:{site}"
-        from ..metrics import (JIT_CACHE_HITS, JIT_COMPILES,
-                               JIT_COMPILE_SECONDS)
+        from ..metrics import (COMPILE_SECONDS_SAVED, JIT_CACHE_HITS,
+                               JIT_COMPILES, JIT_COMPILE_SECONDS,
+                               JIT_DISTINCT_SHAPES, PREWARM_COMPILES,
+                               PREWARM_HITS)
+        prewarming = getattr(self._tl, "prewarm", False)
         ev = CompileEvent(site, fingerprint, duration_s if not hit
                           else 0.0, hit, time.time())
+        shape_count = None
+        saved_s = None
+        prewarm_hit = False
         with self._lock:
             self.events.append(ev)
             key = (site, fingerprint)
@@ -139,22 +169,48 @@ class CompileRecorder:
                 e = self._entries[key] = {
                     "site": site, "fingerprint": fingerprint,
                     "compiles": 0, "hits": 0, "compile_ms": 0.0,
-                    "last_compile_ms": 0.0, "last_used": 0.0}
+                    "last_compile_ms": 0.0, "last_used": 0.0,
+                    "prewarmed": False, "prewarm_hits": 0}
+            shapes = self._site_shapes.setdefault(site, set())
+            if fingerprint not in shapes:
+                shapes.add(fingerprint)
+                shape_count = len(shapes)
             e["last_used"] = ev.when
             if hit:
                 e["hits"] += 1
                 self.total_hits += 1
+                if e.get("prewarmed") and not prewarming:
+                    prewarm_hit = True
+                    e["prewarm_hits"] += 1
+                    self.total_prewarm_hits += 1
+                    # the first query-path hit claims the avoided
+                    # compile wall; later hits were free anyway
+                    saved_s = self._prewarm_pending.pop(key, None)
+                    if saved_s is not None:
+                        self.total_saved_s += saved_s
             else:
                 e["compiles"] += 1
                 e["compile_ms"] += duration_s * 1000
                 e["last_compile_ms"] = duration_s * 1000
                 self.total_compiles += 1
                 self.total_compile_s += duration_s
+                if prewarming:
+                    e["prewarmed"] = True
+                    self._prewarm_pending[key] = duration_s
+                    self.total_prewarmed += 1
+        if shape_count is not None:
+            JIT_DISTINCT_SHAPES.set(shape_count, site=site)
         if hit:
             JIT_CACHE_HITS.inc(site=site)
+            if prewarm_hit:
+                PREWARM_HITS.inc()
+            if saved_s is not None:
+                COMPILE_SECONDS_SAVED.inc(saved_s)
         else:
             JIT_COMPILES.inc(site=site)
             JIT_COMPILE_SECONDS.observe(duration_s)
+            if prewarming:
+                PREWARM_COMPILES.inc()
             # per-thread attribution: the executor whose dispatch thread
             # triggered the compile owns it
             self._tl.compile_s = getattr(self._tl, "compile_s", 0.0) \
@@ -176,15 +232,33 @@ class CompileRecorder:
             return {"compiles": self.total_compiles,
                     "hits": self.total_hits,
                     "compileSeconds": round(self.total_compile_s, 6),
-                    "entries": len(self._entries)}
+                    "entries": len(self._entries),
+                    "prewarmedPrograms": self.total_prewarmed,
+                    "prewarmHits": self.total_prewarm_hits,
+                    "compileSecondsSaved": round(self.total_saved_s, 6)}
+
+    def site_shape_counts(self) -> Dict[str, int]:
+        """Distinct fingerprints ever recorded per site — what the
+        shape-canonicalization lint asserts ceilings over."""
+        with self._lock:
+            return {s: len(fps) for s, fps in self._site_shapes.items()}
 
     def clear(self) -> None:
+        from ..metrics import JIT_DISTINCT_SHAPES
         with self._lock:
             self.events.clear()
             self._entries.clear()
             self.total_compiles = 0
             self.total_hits = 0
             self.total_compile_s = 0.0
+            sites = list(self._site_shapes)
+            self._site_shapes.clear()
+            self._prewarm_pending.clear()
+            self.total_prewarmed = 0
+            self.total_prewarm_hits = 0
+            self.total_saved_s = 0.0
+        for s in sites:
+            JIT_DISTINCT_SHAPES.set(0, site=s)
 
 
 RECORDER = CompileRecorder()

@@ -181,8 +181,8 @@ class TenantFairShare:
 def decide_route(planner, root: L.PlanNode, properties,
                  history=None, fingerprint: Optional[str] = None,
                  tenant: Optional[str] = None,
-                 fair_share: Optional[TenantFairShare] = None
-                 ) -> RouteDecision:
+                 fair_share: Optional[TenantFairShare] = None,
+                 prewarm=None) -> RouteDecision:
     """Pick the execution target for a pruned local plan."""
     mode = str(properties.get("routing_mode", "auto")).lower()
     unsupported = host_supported(root)
@@ -195,6 +195,20 @@ def decide_route(planner, root: L.PlanNode, properties,
         return RouteDecision("host", "forced by routing_mode")
     if unsupported is not None:
         return RouteDecision("device", unsupported)
+    # compile-aware routing (exec/prewarm.py): while this fingerprint's
+    # device program is cold — a prewarm is still compiling it, or no
+    # device run has compiled it yet — a host-eligible query runs on
+    # the bit-exact numpy interpreter instead of blocking on a
+    # multi-second XLA compile; the serving layer kicks a background
+    # warm and the fingerprint swaps to device once it lands. A None /
+    # disabled engine never reaches here, so prewarm-off behavior is
+    # byte-identical to the pre-prewarm router.
+    if prewarm is not None and fingerprint and \
+            prewarm.device_cold(fingerprint):
+        return RouteDecision(
+            "host", "device program cold (prewarm in flight)"
+            if prewarm.is_inflight(fingerprint)
+            else "device program cold")
     # per-tenant fair share: under device contention from OTHER tenants,
     # a host-eligible plan overflows to the host tier even when history
     # would have preferred the device — bounded at 4x the host row gate

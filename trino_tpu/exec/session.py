@@ -120,6 +120,10 @@ SESSION_PROPERTY_DEFAULTS = {
     # chunked-driver prefetch pipeline: how many decoded+staged chunks
     # may run ahead of the device (0 = today's serial loop, exactly)
     "prefetch_depth": (2, int),
+    # chunked-driver compile warm: overlap the fused program's XLA compile
+    # with chunk-0 decode via a discarded zero-row call (exec/prewarm.py
+    # turns this on cluster-wide when TRINO_TPU_PREWARM is set)
+    "prewarm_chunks": (False, _bool),
     # distributed runtime knobs (execution/scheduler tier)
     "split_rows": (250_000, int),
     "task_retries": (2, int),
@@ -230,6 +234,7 @@ class Session:
             self.properties["enable_zone_map_pruning"]
         ex.zone_map_rows = max(1, self.properties["zone_map_rows"])
         ex.prefetch_depth = max(0, self.properties["prefetch_depth"])
+        ex.prewarm_chunks = self.properties["prewarm_chunks"]
         max_s = self.properties["query_max_run_time_s"]
         ex.deadline = (t0 + max_s) if max_s else None
         kb = self.properties["stream_build_min_kb"]

@@ -35,7 +35,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..batch import Batch, batch_from_numpy, batch_to_numpy, pad_capacity
+from ..batch import Batch, batch_from_numpy, batch_to_numpy, bucket_capacity
 from ..planner import logical as L
 
 
@@ -640,7 +640,7 @@ def merge_partial_pages(executor, node: L.AggregateNode,
     # (hash-strategy operators merge through the hash-partial path)
     if executor.pool.available() >= 3 * total:
         merged = batch_from_numpy(arrs, valids=vals)
-        capacity = max(node.out_capacity, pad_capacity(len(arrs[0])))
+        capacity = max(node.out_capacity, bucket_capacity(len(arrs[0])))
         return executor.merge_group_aggregate(node, merged, merge_aggs,
                                               capacity)
     count = _pick_partitions(executor, total)
@@ -655,7 +655,7 @@ def merge_partial_pages(executor, node: L.AggregateNode,
         executor.pool.reserve(batch_bytes(pb))
         try:
             out = executor.merge_group_aggregate(
-                node, pb, merge_aggs, pad_capacity(int(m.sum())))
+                node, pb, merge_aggs, bucket_capacity(int(m.sum())))
             oa, ov = batch_to_numpy(out)
         finally:
             executor.pool.free(batch_bytes(pb))

@@ -448,6 +448,26 @@ SOAK_SLO_VIOLATIONS = REGISTRY.counter(
     "Per-tenant p99 SLO violations observed by the sustained-soak "
     "harness (bench.py --soak)")
 
+# cold-start elimination (exec/prewarm.py + exec/profiler.py): AOT
+# pre-warming of historical plan shapes, canonicalized-shape compile
+# reuse, and the compile-aware host routing window
+PREWARM_COMPILES = REGISTRY.counter(
+    "trino_tpu_prewarm_compiles_total",
+    "Programs compiled off the query path by the prewarm engine "
+    "(historical fingerprints + staged chunk shapes)")
+PREWARM_HITS = REGISTRY.counter(
+    "trino_tpu_prewarm_hits_total",
+    "Query-path jit calls served by a program the prewarm engine had "
+    "already compiled")
+COMPILE_SECONDS_SAVED = REGISTRY.counter(
+    "trino_tpu_compile_seconds_saved_total",
+    "Estimated query-path compile seconds avoided by prewarm hits "
+    "(the off-path compile wall of each program, counted once per hit)")
+JIT_DISTINCT_SHAPES = REGISTRY.gauge(
+    "trino_tpu_jit_distinct_shapes",
+    "Distinct (fingerprint) program shapes recorded per jit site — the "
+    "shape-canonicalization regression signal", ("site",))
+
 # query history + latency-regression detection (server/history.py)
 LATENCY_REGRESSIONS = REGISTRY.counter(
     "trino_tpu_query_latency_regressions_total",
@@ -467,6 +487,7 @@ MEMORY_REVOCABLE.init_labels(pool="general")
 for _site in ("exec.fused_chunk", "exec.slice_widen"):
     JIT_COMPILES.init_labels(site=_site)
     JIT_CACHE_HITS.init_labels(site=_site)
+    JIT_DISTINCT_SHAPES.init_labels(site=_site)
 for _op in ("ScanNode", "JoinNode", "AggregateNode"):
     OPERATOR_DEVICE_MS.init_labels(operator=_op)
     OPERATOR_COMPILE_MS.init_labels(operator=_op)

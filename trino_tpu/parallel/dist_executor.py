@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..batch import Batch, pad_capacity
+from ..batch import Batch, bucket_capacity
 from ..catalog import Catalog
 from ..exec.executor import Executor, compact_batch
 from ..exec.profiler import recorded_jit
@@ -164,7 +164,7 @@ class MeshExecutor(Executor):
         if probe.capacity >= (1 << 16) and not self.chunk_mode:
             live = self.fetch_ints(node, "dflive",
                                    jnp.sum(probe.live))[0]
-            new_cap = pad_capacity(live)
+            new_cap = bucket_capacity(live)
             if new_cap * 4 <= probe.capacity:
                 self.stats.dynamic_filter_compactions += 1
                 probe = compact_batch(probe, new_cap)

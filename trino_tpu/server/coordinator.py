@@ -51,6 +51,10 @@ ROUTES = (
     ("GET", ("v1", "status"), "_get_status", False),
     ("GET", ("v1", "metrics"), "_get_metrics", False),
     ("GET", ("v1", "jit"), "_get_jit", False),
+    # warm-manifest for joining workers (exec/prewarm.py): top
+    # historical fingerprints + the canonical shape lattice. Internal:
+    # it exposes query text
+    ("GET", ("v1", "prewarm"), "_get_prewarm", "internal"),
     ("GET", ("v1", "spooled", "segments", STAR), "_get_segment", True),
     ("GET", ("v1", "resourceGroup"), "_get_resource_group", True),
     ("GET", ("v1", "memory"), "_get_memory", True),
@@ -444,6 +448,15 @@ class CoordinatorState:
         # annotation both read per-fingerprint medians from this store
         self.dispatcher.serving.history = self.history
         session.history_store = self.history
+        # cold-start elimination (exec/prewarm.py): AOT-warm the top
+        # historical fingerprints at startup and feed the router's
+        # compile-aware cold signal. Off unless TRINO_TPU_PREWARM is
+        # set — disabled, serving/routing behave exactly as before.
+        from ..exec.prewarm import PrewarmEngine
+        self.prewarm = PrewarmEngine(session, history=self.history,
+                                     exec_lock=self.dispatcher.exec_lock)
+        self.dispatcher.serving.prewarm = self.prewarm
+        self.prewarm.maybe_start()
         # system.runtime.{queries,nodes,tasks,operator_stats,jit_cache,
         # query_history} backed by this coordinator's state
         from .system_connector import SystemConnector
@@ -676,9 +689,12 @@ class _Handler(BaseHTTPRequestHandler):
     def _get_status(self, parts, user):
         # liveness for load balancers / the failure detector: open
         # even on a secured cluster (no query data exposed)
+        from ..exec.prewarm import compile_cache_stats
         from ..exec.profiler import device_memory_stats
         self._send(200, {"nodeId": "coordinator", "state": "ACTIVE",
-                         "device": device_memory_stats()})
+                         "device": device_memory_stats(),
+                         "compileCache": compile_cache_stats(),
+                         "prewarm": self.state.prewarm.stats()})
 
     def _get_metrics(self, parts, user):
         from ..metrics import REGISTRY
@@ -691,7 +707,17 @@ class _Handler(BaseHTTPRequestHandler):
         # stays open like /v1/metrics)
         from ..exec.profiler import RECORDER
         self._send(200, {"totals": RECORDER.totals(),
-                         "entries": RECORDER.snapshot()})
+                         "entries": RECORDER.snapshot(),
+                         # shape-canonicalization signal + prewarm view:
+                         # entries carry prewarmed/prewarm_hits columns,
+                         # distinctShapes is the per-site shape count
+                         "distinctShapes": RECORDER.site_shape_counts(),
+                         "prewarm": self.state.prewarm.stats()})
+
+    def _get_prewarm(self, parts, user):
+        # the joining-worker warm-manifest handshake (server/worker.py
+        # pulls this before its first ACTIVE announce)
+        self._send(200, self.state.prewarm.manifest())
 
     def _get_segment(self, parts, user):
         data = self.state.spooling.read(parts[3])

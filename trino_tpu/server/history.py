@@ -251,6 +251,45 @@ class QueryHistoryStore:
         with self._lock:
             return [dict(r) for r in self.records]
 
+    def top_fingerprints(self, n: int = 8) -> List[dict]:
+        """Rank fingerprints by frequency x recency over the ring — the
+        prewarm engine's pick list (exec/prewarm.py) and the rank/score
+        columns of system.runtime.query_history.
+
+        Score: each FINISHED record contributes 2^(-age/half_life)
+        with a one-hour half life, so a shape run 50 times yesterday
+        still outranks a one-off from a minute ago, but dead shapes
+        decay out of the top-N instead of pinning prewarm budget
+        forever. Returns [{fingerprint, sql, count, last_end_time,
+        score}] best-first; `sql` is the most recent FINISHED text for
+        the shape (what prewarm re-plans)."""
+        half_life_s = 3600.0
+        now = time.time()
+        agg: Dict[str, dict] = {}
+        with self._lock:
+            for r in self.records:
+                if r.get("state") != "FINISHED":
+                    continue
+                fp = r.get("fingerprint", "")
+                if not fp or not r.get("sql"):
+                    continue
+                end = float(r.get("end_time", now) or now)
+                ent = agg.get(fp)
+                if ent is None:
+                    ent = agg[fp] = {"fingerprint": fp, "sql": r["sql"],
+                                     "count": 0, "last_end_time": 0.0,
+                                     "score": 0.0}
+                ent["count"] += 1
+                ent["score"] += 2.0 ** (-max(0.0, now - end) /
+                                        half_life_s)
+                if end >= ent["last_end_time"]:
+                    ent["last_end_time"] = end
+                    ent["sql"] = r["sql"]
+        ranked = sorted(agg.values(),
+                        key=lambda e: (-e["score"], -e["count"],
+                                       e["fingerprint"]))
+        return ranked[:max(0, int(n))]
+
     def for_fingerprint(self, fingerprint: str) -> List[dict]:
         with self._lock:
             return [dict(r) for r in self._by_fp.get(fingerprint, ())]

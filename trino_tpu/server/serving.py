@@ -467,6 +467,7 @@ class ServingLayer:
         self.result_cache = ResultCache()
         self.microbatcher = MicroBatcher(self)
         self.history = None            # QueryHistoryStore (coordinator)
+        self.prewarm = None            # PrewarmEngine (exec/prewarm.py)
         # per-tenant device-contention tracker (exec/router.py): under
         # contention from other tenants, host-eligible queries overflow
         # to the host tier instead of queueing on the exec lock
@@ -632,11 +633,19 @@ class ServingLayer:
                                 history=self.history,
                                 fingerprint=fingerprint,
                                 tenant=tenant,
-                                fair_share=self.fair_share)
+                                fair_share=self.fair_share,
+                                prewarm=self.prewarm)
         if tq is not None:
             tq.route = decision.target
             tq.route_reason = decision.reason
         if decision.target == "host":
+            if self.prewarm is not None and fingerprint and \
+                    decision.reason.startswith("device program cold"):
+                # compile-aware window: this query is served host-side;
+                # warm the device program in the background so the NEXT
+                # submission of the fingerprint routes to device
+                self.prewarm.ensure_warming(
+                    fingerprint, getattr(tq, "sql", None) or "")
             try:
                 result = run_host(session, rel, root, t0)
                 ROUTER_DECISIONS.inc(target="host")
@@ -651,9 +660,14 @@ class ServingLayer:
         self.fair_share.device_begin(tenant or "default")
         try:
             with self.exec_lock:
-                return session.execute_planned(rel, root, t0)
+                result = session.execute_planned(rel, root, t0)
         finally:
             self.fair_share.device_end(tenant or "default")
+        if self.prewarm is not None:
+            # a completed device run compiled this fingerprint's
+            # programs on-path: it is warm from here on
+            self.prewarm.mark_warm(fingerprint)
+        return result
 
     def info(self) -> dict:
         return {

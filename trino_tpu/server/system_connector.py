@@ -219,14 +219,21 @@ class SystemConnector:
                             dtype=np.float64)
         last_ms = np.array([r["last_compile_ms"] for r in recs],
                            dtype=np.float64)
+        prewarmed = np.array([int(bool(r.get("prewarmed"))) for r in recs],
+                             dtype=np.int64)
+        prewarm_hits = np.array([int(r.get("prewarm_hits", 0))
+                                 for r in recs], dtype=np.int64)
         return TableData(
             "jit_cache",
             Schema(base.schema.fields +
                    (Field("compiles", BIGINT),
                     Field("cache_hits", BIGINT),
                     Field("compile_ms", DOUBLE),
-                    Field("last_compile_ms", DOUBLE))),
-            base.columns + [compiles, hits, total_ms, last_ms])
+                    Field("last_compile_ms", DOUBLE),
+                    Field("prewarmed", BIGINT),
+                    Field("prewarm_hits", BIGINT))),
+            base.columns + [compiles, hits, total_ms, last_ms,
+                            prewarmed, prewarm_hits])
 
     def _plan_cache_table(self) -> TableData:
         """The serving layer's logical-plan cache (server/serving.py):
@@ -263,6 +270,12 @@ class SystemConnector:
         store = getattr(self.state, "history", None) if self.state \
             else None
         recs = store.snapshot() if store is not None else []
+        # prewarm ranking surface: the same (rank, score) the AOT warm
+        # pass orders fingerprints by (history.top_fingerprints)
+        ranked = store.top_fingerprints(len(recs) or 1) \
+            if store is not None else []
+        rank_by_fp = {e["fingerprint"]: (i + 1, e["score"])
+                      for i, e in enumerate(ranked)}
         base = _strings_table(
             "query_history",
             [("query_id", [r.get("query_id", "") for r in recs]),
@@ -279,6 +292,12 @@ class SystemConnector:
                           dtype=np.int64)
         regressed = np.array([int(bool(r.get("regressed")))
                               for r in recs], dtype=np.int64)
+        prewarm_rank = np.array(
+            [rank_by_fp.get(r.get("fingerprint", ""), (0, 0.0))[0]
+             for r in recs], dtype=np.int64)
+        prewarm_score = np.array(
+            [rank_by_fp.get(r.get("fingerprint", ""), (0, 0.0))[1]
+             for r in recs], dtype=np.float64)
         return TableData(
             "query_history",
             Schema(base.schema.fields +
@@ -286,5 +305,8 @@ class SystemConnector:
                     Field("rows", BIGINT),
                     Field("bytes_shuffled", BIGINT),
                     Field("spills", BIGINT),
-                    Field("regressed", BIGINT))),
-            base.columns + [elapsed, rows, shuffled, spills, regressed])
+                    Field("regressed", BIGINT),
+                    Field("prewarm_rank", BIGINT),
+                    Field("prewarm_score", DOUBLE))),
+            base.columns + [elapsed, rows, shuffled, spills, regressed,
+                            prewarm_rank, prewarm_score])

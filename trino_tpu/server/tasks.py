@@ -455,7 +455,7 @@ class TaskManager:
         self._executor.flush_metrics()
 
     def _run(self, task: WorkerTask) -> None:
-        from ..batch import batch_from_numpy, batch_to_numpy, pad_capacity
+        from ..batch import batch_from_numpy, batch_to_numpy, bucket_capacity
         with task.lock:
             if task.state != "PENDING":   # canceled before the thread ran
                 return
@@ -481,7 +481,7 @@ class TaskManager:
                 return
             fragment = decode_fragment(task.fragment_blob)
             root, driver_scan = fragment["root"], fragment["driver"]
-            cap = pad_capacity(max(s.count for s in task.splits)) \
+            cap = bucket_capacity(max(s.count for s in task.splits)) \
                 if task.splits else 1024
             # per-operator profiling: on for traced tasks AND for
             # fragments flagged by the coordinator (EXPLAIN ANALYZE) —

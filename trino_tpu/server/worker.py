@@ -113,18 +113,25 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         if self.worker.fail_status:          # fault injection hook
             self._send(500, {"error": "injected failure"})
             return
+        from ..exec.prewarm import compile_cache_stats
         from ..exec.profiler import device_memory_stats
-        self._send(200, {"nodeId": self.worker.node_id,
-                         "state": self.worker.state,
-                         "uptime": time.time() - self.worker.started_at,
-                         # heartbeat memory report: the failure
-                         # detector's pings carry this to the
-                         # coordinator's ClusterMemoryManager
-                         "memory":
-                             self.worker.task_manager.memory_info(),
-                         # live accelerator/HBM allocator stats (zeros
-                         # off-TPU) — surfaced in system.runtime.nodes
-                         "device": device_memory_stats()})
+        payload = {"nodeId": self.worker.node_id,
+                   "state": self.worker.state,
+                   "uptime": time.time() - self.worker.started_at,
+                   # heartbeat memory report: the failure
+                   # detector's pings carry this to the
+                   # coordinator's ClusterMemoryManager
+                   "memory":
+                       self.worker.task_manager.memory_info(),
+                   # live accelerator/HBM allocator stats (zeros
+                   # off-TPU) — surfaced in system.runtime.nodes
+                   "device": device_memory_stats(),
+                   # persistent compile-cache report: operators verify
+                   # cache-dir sharing across workers from here
+                   "compileCache": compile_cache_stats()}
+        if self.worker.prewarm is not None:
+            payload["prewarm"] = self.worker.prewarm.stats()
+        self._send(200, payload)
 
     def _get_info(self, parts, user):
         self._send(200, {"nodeVersion": {"version": "trino-tpu-0.1"},
@@ -299,6 +306,15 @@ class WorkerServer:
         self.fail_tasks = False          # inject: task creation fails
         self.fail_results = False        # inject: result fetch fails
         self.started_at = time.time()
+        # joining-worker prewarm handshake (exec/prewarm.py): with
+        # TRINO_TPU_PREWARM set, the announcer thread first pulls the
+        # coordinator's warm-manifest and compiles the canonical shape
+        # lattice, so the node is warm BEFORE its first ACTIVE announce
+        # puts it in the scheduler's placement set
+        from ..exec.prewarm import prewarm_enabled_by_env
+        self.prewarm_enabled = prewarm_enabled_by_env()
+        self.prewarm = None              # PrewarmEngine after handshake
+        self.prewarm_manifest: Optional[dict] = None
         from ..catalog import default_catalog
         from .tasks import TaskManager
         self.catalog = catalog if catalog is not None else default_catalog()
@@ -353,7 +369,33 @@ class WorkerServer:
             post, retry_on=(OSError,),
             sleep=lambda d: self._stop.wait(d))
 
+    def prewarm_handshake(self) -> bool:
+        """Pull the coordinator's warm-manifest and compile the
+        canonical shape lattice before this node announces ACTIVE.
+        Best-effort: a missing/denied manifest must never keep a worker
+        out of the cluster."""
+        from ..exec.prewarm import PrewarmEngine
+        from .security import internal_headers
+        try:
+            req = Request(f"{self.coordinator_uri}/v1/prewarm",
+                          headers=internal_headers())
+            with urlopen(req, timeout=5) as r:
+                manifest = json.loads(r.read().decode())
+        except Exception:     # noqa: BLE001 — handshake is best-effort
+            return False
+        self.prewarm_manifest = manifest
+        if self.prewarm is None:
+            self.prewarm = PrewarmEngine(enabled=True)
+        shapes = [int(c) for c in manifest.get("shapes", ())]
+        self.prewarm.warm_shapes(shapes)
+        return True
+
     def _announce_loop(self) -> None:
+        if self.prewarm_enabled:
+            try:
+                self.prewarm_handshake()
+            except Exception:
+                pass                      # warm-up is best-effort
         while not self._stop.is_set():
             try:
                 self.announce_once()
