@@ -524,6 +524,106 @@ def agg_micro(cardinalities=None, rows=None, runs=3,
 
 
 # ---------------------------------------------------------------------------
+# --star-micro: fused multiway star probe vs the pairwise join ladder
+# ---------------------------------------------------------------------------
+
+def _star_tables(k, fact_rows, dim_rows, hit_rate, seed=40231):
+    """Synthetic star: one fact with k FK columns + a value, k unique-
+    keyed dims each carrying one payload column. `hit_rate` sets the
+    per-dim probe match fraction (fact keys drawn past the dim's key
+    range miss, so the inner join drops 1-hit_rate of rows per hop)."""
+    from trino_tpu.batch import Field, Schema
+    from trino_tpu.connectors.tpch.datagen import TableData
+    from trino_tpu.types import BIGINT
+    rng = np.random.default_rng(seed + k)
+    t = {}
+    span = max(1, int(dim_rows / max(hit_rate, 1e-9)))
+    fact_cols = [rng.integers(0, span, fact_rows).astype(np.int64)
+                 for _ in range(k)]
+    fact_cols.append(rng.integers(0, 1 << 20, fact_rows).astype(np.int64))
+    t["fact"] = TableData(
+        "fact",
+        Schema.of(*[Field(f"f_d{i}key", BIGINT) for i in range(k)],
+                  Field("f_value", BIGINT)),
+        fact_cols)
+    for i in range(k):
+        t[f"dim{i}"] = TableData(
+            f"dim{i}",
+            Schema.of(Field(f"d{i}_key", BIGINT),
+                      Field(f"d{i}_attr", BIGINT)),
+            [np.arange(dim_rows, dtype=np.int64),
+             rng.integers(0, 1000, dim_rows).astype(np.int64)],
+            primary_key=(f"d{i}_key",))
+    return t
+
+
+def star_micro(shapes=None, fact_rows=None, dim_rows=None, runs=3,
+               out_path="BENCH_star_micro.json"):
+    """Microbenchmark the fused multiway star probe (ops/pallas_hash.py
+    multiway_probe, one Pallas pass over every VMEM-resident dimension
+    table) against the pairwise join ladder it replaces, across star
+    widths and probe selectivities. Emits one JSON artifact so the
+    ISSUE-13 claim (fused >= 2x pairwise at >= 3 dims on TPU) is
+    measurable round over round and gated by --check-regressions.
+
+    Under JAX_PLATFORMS=cpu this drops to a tiny smoke shape in Pallas
+    interpret mode (numbers meaningless — the run exists so tier-1
+    exercises the harness and the bit-exactness assert end to end)."""
+    import jax
+
+    from trino_tpu.catalog import Catalog
+    from trino_tpu.exec.session import Session
+    from trino_tpu.metrics import MULTIJOIN_FUSED_PROBES
+
+    on_tpu = jax.default_backend() == "tpu"
+    mode = "device" if on_tpu else "interpret"
+    if shapes is None:
+        shapes = [(2, 0.9), (3, 0.9), (3, 0.2), (5, 0.9)] if on_tpu \
+            else [(2, 0.9), (3, 0.5)]
+    if fact_rows is None:
+        fact_rows = (1 << 22) if on_tpu else (1 << 12)
+    if dim_rows is None:
+        dim_rows = 4096 if on_tpu else 256
+
+    records = []
+    for k, hit_rate in shapes:
+        tables = _star_tables(k, fact_rows, dim_rows, hit_rate)
+        cat = Catalog()
+        cat.register("bench", BenchConnector(tables, "star"))
+        s = Session(catalog=cat, default_cat="bench",
+                    default_schema="star")
+        sql = ("SELECT sum(f_value"
+               + "".join(f" + d{i}_attr" for i in range(k))
+               + ") FROM fact "
+               + " ".join(f"JOIN dim{i} ON f_d{i}key = d{i}_key"
+                          for i in range(k)))
+        rec = {"dims": k, "hit_rate": hit_rate,
+               "fact_rows": fact_rows, "dim_rows": dim_rows}
+
+        s.execute("SET SESSION enable_multiway_join = 'true'")
+        before = MULTIJOIN_FUSED_PROBES.value()
+        fused_res, _, fused_ms = run_config(s, sql, runs=runs, prewarm=2)
+        rec["fused_engaged"] = \
+            MULTIJOIN_FUSED_PROBES.value() > before
+        s.execute("SET SESSION enable_multiway_join = 'false'")
+        pair_res, _, pair_ms = run_config(s, sql, runs=runs, prewarm=2)
+        assert fused_res.rows == pair_res.rows, \
+            (k, hit_rate, fused_res.rows, pair_res.rows)
+        rec["fused_ms"] = round(fused_ms, 3)
+        rec["pairwise_ms"] = round(pair_ms, 3)
+        rec["fused_vs_pairwise"] = round(
+            pair_ms / max(fused_ms, 1e-6), 2)
+        records.append(rec)
+
+    out = {"metric": "star_micro_ms", "device": str(jax.devices()[0]),
+           "mode": mode, "smoke": not on_tpu, "records": records}
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out), flush=True)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # --scan-micro: zone-map pruning + prefetch-pipeline scan-path microbench
 # ---------------------------------------------------------------------------
 
@@ -1675,6 +1775,18 @@ def load_bench_round(path):
             if r.get("ratio") is not None:
                 out[f"cold_{r['query']}_ratio"] = float(r["ratio"])
         return out or None
+    if str(doc.get("metric", "")).startswith("star_micro"):
+        # --star-micro rounds gate on BOTH walls per star shape: a
+        # slower fused kernel OR a slower pairwise ladder in a later
+        # round reads as a regressed star_micro_* config
+        out = {}
+        for r in doc.get("records", ()):
+            tag = f"star_micro_k{r['dims']}_h{r['hit_rate']}"
+            if r.get("fused_ms") is not None:
+                out[f"{tag}_fused"] = float(r["fused_ms"])
+            if r.get("pairwise_ms") is not None:
+                out[f"{tag}_pairwise"] = float(r["pairwise_ms"])
+        return out or None
     if str(doc.get("metric", "")).startswith("agg_micro"):
         # --agg-micro rounds gate on the strategy the gate would pick
         # (hash where present, else sort): a slower kernel in a later
@@ -1837,6 +1949,10 @@ def build_parser():
                       help="hash vs sort vs direct aggregation "
                            "microbench across group cardinalities -> "
                            "BENCH_agg_micro.json")
+    mode.add_argument("--star-micro", action="store_true",
+                      help="fused multiway star probe vs the pairwise "
+                           "join ladder across star widths and probe "
+                           "selectivities -> BENCH_star_micro.json")
     mode.add_argument("--scan-micro", action="store_true",
                       help="zone-map pruning + prefetch pipeline "
                            "scan-path microbench across predicate "
@@ -1901,6 +2017,9 @@ def main(argv=None):
     if args.agg_micro:
         agg_micro()
         return 0
+    if args.star_micro:
+        star_micro()
+        return 0
     if args.scan_micro:
         scan_micro()
         return 0
@@ -1926,6 +2045,15 @@ def main(argv=None):
                                              mad_k=args.mad_k)
             report["agg_micro"] = report2
             ok = ok and ok2
+        # the star-join trajectory gates as its own series the same way
+        # (BENCH_star_micro.json + later rounds' BENCH_star_micro_r*.json)
+        star_paths = sorted(_glob.glob("BENCH_star_micro*.json"))
+        if star_paths:
+            ok7, report7 = check_regressions(star_paths,
+                                             ratio=args.ratio,
+                                             mad_k=args.mad_k)
+            report["star_micro"] = report7
+            ok = ok and ok7
         # the scan-path trajectory gates as its own series the same way
         # (BENCH_scan_micro.json + later rounds' BENCH_scan_micro_r*.json)
         scan_paths = sorted(_glob.glob("BENCH_scan_micro*.json"))

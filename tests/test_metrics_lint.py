@@ -133,6 +133,10 @@ REQUIRED_FAMILIES = (
     "trino_tpu_prewarm_hits_total",
     "trino_tpu_compile_seconds_saved_total",
     "trino_tpu_jit_distinct_shapes",
+    # round-17 fused multiway star join: kernel launches + per-reason
+    # dim degrades back to the pairwise ladder
+    "trino_tpu_multijoin_fused_probes_total",
+    "trino_tpu_multijoin_degrades_total",
 )
 
 

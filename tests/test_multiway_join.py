@@ -1,0 +1,419 @@
+"""Fused multiway star-join tests (ops/pallas_hash.multiway_probe +
+planner star detector + exec run_multijoin), interpret mode on CPU so
+tier-1 exercises the real kernel logic.
+
+Property: the fused single-pass star probe must be bit-exact vs the
+pairwise join ladder it replaces — across TPC-DS star queries (vs the
+sqlite oracle), TPC-H join spines, partial and full VMEM-budget
+degrades, duplicate build keys, crafted probe-chain escapes, and the
+mesh executor's wholesale degrade. The EXPLAIN surface prints the star
+verdict whether or not the kernel is on, and every degrade is counted
+by reason.
+
+Shapes stay small (<= 4k fact rows, 1k-4k table slots): the interpreter
+runs the per-row probe loop in XLA CPU, so cost scales with rows x dims.
+"""
+
+import numpy as np
+import pytest
+
+from oracle import assert_rows_match, load_oracle, oracle_query
+from tpcds_queries import ORACLE, QUERIES as DS_QUERIES
+from tpch_full import QUERIES as H_QUERIES
+from trino_tpu.connectors.tpcds.connector import TABLE_NAMES
+from trino_tpu.exec.session import Session
+from trino_tpu.metrics import (MULTIJOIN_DEGRADES,
+                               MULTIJOIN_FUSED_PROBES)
+from trino_tpu.ops import pallas_hash as ph
+
+
+def _np_splitmix64(x):
+    with np.errstate(over="ignore"):
+        z = x + np.uint64(0x9E3779B97F4A7C15)
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return z ^ (z >> np.uint64(31))
+
+
+def _degrades():
+    return {r: MULTIJOIN_DEGRADES.value(reason=r)
+            for r in ("kernel_off", "vmem", "dup", "escape", "dtype",
+                      "mesh", "spill")}
+
+
+def _delta(before):
+    return {k: v - before[k] for k, v in _degrades().items()
+            if v != before[k]}
+
+
+# ---- synthetic star harness ----------------------------------------------
+
+def star_session(tables):
+    import bench
+    from trino_tpu.catalog import Catalog
+    cat = Catalog()
+    cat.register("bench", bench.BenchConnector(tables, "star"))
+    return Session(catalog=cat, default_cat="bench",
+                   default_schema="star")
+
+
+def star_sql(k, agg=False):
+    joins = " ".join(f"JOIN dim{i} ON f_d{i}key = d{i}_key"
+                     for i in range(k))
+    if agg:
+        exprs = "".join(f" + d{i}_attr" for i in range(k))
+        return f"SELECT sum(f_value{exprs}) FROM fact {joins}"
+    cols = ", ".join(f"d{i}_attr" for i in range(k))
+    return f"SELECT f_value, {cols} FROM fact {joins}"
+
+
+def default_star(k=3, fact_rows=1 << 12, dim_rows=256, hit_rate=0.7):
+    import bench
+    return bench._star_tables(k, fact_rows, dim_rows, hit_rate)
+
+
+def on_off(s, sql):
+    """Run fused-on then fused-off; return both row lists."""
+    s.execute("SET SESSION enable_multiway_join = 'true'")
+    on = s.execute(sql).rows
+    s.execute("SET SESSION enable_multiway_join = 'false'")
+    off = s.execute(sql).rows
+    return on, off
+
+
+# ---- TPC-DS star corpus vs the sqlite oracle -----------------------------
+
+@pytest.fixture(scope="module")
+def ds_session():
+    return Session(default_cat="tpcds", default_schema="tiny")
+
+
+@pytest.fixture(scope="module")
+def ds_oracle(ds_session):
+    conn = ds_session.catalog.connector("tpcds")
+    return load_oracle([conn.get_table("tiny", t) for t in TABLE_NAMES])
+
+
+def _ds_check(ds_session, ds_oracle, qid):
+    sql = DS_QUERIES[qid]
+    on, off = on_off(ds_session, sql)
+    want = oracle_query(ds_oracle, ORACLE.get(qid, sql))
+    assert_rows_match(on, want, rel_tol=1e-9, abs_tol=0.02,
+                      ordered=True)
+    assert_rows_match(off, want, rel_tol=1e-9, abs_tol=0.02,
+                      ordered=True)
+
+
+def test_tpcds_q7_fused_bitexact(ds_session, ds_oracle):
+    """q7 is the canonical 4-dim star: the fused kernel must engage
+    and match both the pairwise ladder and the oracle."""
+    before = MULTIJOIN_FUSED_PROBES.value()
+    _ds_check(ds_session, ds_oracle, 7)
+    assert MULTIJOIN_FUSED_PROBES.value() > before
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("qid", [19, 26])
+def test_tpcds_star_fused_bitexact(ds_session, ds_oracle, qid):
+    _ds_check(ds_session, ds_oracle, qid)
+
+
+# ---- TPC-H spines: fused on == off ---------------------------------------
+
+@pytest.fixture(scope="module")
+def h_session():
+    return Session(default_schema="tiny")
+
+
+@pytest.mark.parametrize("qid", [3, 10])
+def test_tpch_star_on_off(h_session, qid):
+    on, off = on_off(h_session, H_QUERIES[qid])
+    assert on == off
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("qid", sorted(H_QUERIES))
+def test_tpch_full_sweep_on_off(h_session, qid):
+    """Acceptance: the fused path (with every degrade it takes) is
+    bit-exact vs the pairwise ladder on all 22 TPC-H queries."""
+    on, off = on_off(h_session, H_QUERIES[qid])
+    assert on == off
+
+
+# ---- VMEM-budget degrades ------------------------------------------------
+
+def test_partial_fuse_vmem_degrade():
+    """A 3-dim star whose largest dim blows the VMEM budget: the big
+    dim degrades to the pairwise path (reason=vmem), the other two
+    still fuse, and the output is bit-exact vs the full ladder."""
+    from trino_tpu.connectors.tpch.datagen import TableData
+    tables = default_star(k=3)
+    # re-key dim2 to 2048 rows -> table_slots 4096 vs 1024 for the rest
+    rng = np.random.default_rng(5)
+    tables["dim2"] = TableData(
+        "dim2", tables["dim2"].schema,
+        [np.arange(2048, dtype=np.int64),
+         rng.integers(0, 1000, 2048).astype(np.int64)],
+        primary_key=("d2_key",))
+    s = star_session(tables)
+    # dims pad to capacity 1024 -> 2048 slots (24 KB each); dim2 pads
+    # to 2048 -> 4096 slots. 56 KB holds the two small tables (48 KB)
+    # but not the 4096-slot stack (3 x 48 KB), so only dim2 sheds
+    s.execute("SET SESSION multiway_vmem_kb = 56")
+    before = _degrades()
+    s.execute("SET SESSION enable_multiway_join = 'true'")
+    on = s.execute(star_sql(3)).rows
+    assert s.executor.strategy_decisions.get("MultiJoinNode") == \
+        "multiway[k=2]"
+    assert _delta(before) == {"vmem": 1}
+    s.execute("SET SESSION enable_multiway_join = 'false'")
+    off = s.execute(star_sql(3)).rows
+    assert on == off
+
+
+def test_vmem_full_ladder_degrade():
+    """Budget too small for even one resident table: every dim sheds
+    (reason=vmem), the node runs as the reconstructed ladder, and the
+    output still matches."""
+    s = star_session(default_star(k=3))
+    s.execute("SET SESSION multiway_vmem_kb = 8")
+    before = _degrades()
+    s.execute("SET SESSION enable_multiway_join = 'true'")
+    on = s.execute(star_sql(3)).rows
+    assert s.executor.strategy_decisions.get("MultiJoinNode") == "ladder"
+    assert _delta(before) == {"vmem": 3}
+    s.execute("SET SESSION enable_multiway_join = 'false'")
+    off = s.execute(star_sql(3)).rows
+    assert on == off
+
+
+def test_kernel_off_counts_degrades():
+    from trino_tpu.sql.parser import parse
+    s = star_session(default_star(k=3))
+    # plan with the kernel ON so a MultiJoinNode exists, then flip the
+    # executor knob off underneath it: the wholesale kernel_off degrade
+    s.execute("SET SESSION enable_multiway_join = 'true'")
+    ref = s.execute(star_sql(3)).rows
+    rel = s.planner().plan_query(parse(star_sql(3)))
+    before = _degrades()
+    s.executor.enable_multiway_join = "false"
+    try:
+        s.executor.execute(rel.node)
+    finally:
+        s.executor.enable_multiway_join = "true"
+    assert _delta(before) == {"kernel_off": 3}
+    assert ref  # the fused reference run produced rows
+
+
+# ---- duplicate build keys + crafted escapes ------------------------------
+
+def test_dup_dim_degrades_bitexact():
+    """A dim whose primary_key metadata lies (duplicated keys): the
+    planner fuses on the metadata, the executor detects the dup at
+    build time, degrades that dim to the pairwise expand path
+    (reason=dup), and the expansion matches the full ladder's."""
+    from trino_tpu.connectors.tpch.datagen import TableData
+    tables = default_star(k=3, hit_rate=0.9)
+    dup = tables["dim1"]
+    keys = np.asarray(dup.columns[0]).copy()
+    keys[1::2] = keys[0::2]                      # every key twice
+    tables["dim1"] = TableData("dim1", dup.schema,
+                               [keys, np.asarray(dup.columns[1])],
+                               primary_key=("d1_key",))
+    s = star_session(tables)
+    before = _degrades()
+    on, off = on_off(s, star_sql(3))
+    assert on == off
+    assert _delta(before) == {"dup": 1}
+
+
+def test_escape_dim_degrades_bitexact():
+    """Keys crafted so > MAX_PROBES distinct dim keys share one home
+    slot: the build's insert chain escapes, the dim degrades
+    (reason=escape), and results still match the ladder."""
+    from trino_tpu.connectors.tpch.datagen import TableData
+    # every dim here pads to the batch lattice floor (capacity 1024),
+    # so the SHARED table the stack builds with has
+    # join_table_slots(1024) slots — craft the collisions against that
+    slots, fits = ph.join_table_slots(1024)
+    assert fits
+    cands = np.arange(1, 500_000, dtype=np.int64)
+    home = (_np_splitmix64(cands.view(np.uint64) + ph._SLOT_SEED)
+            % np.uint64(slots)).astype(np.int64)
+    target = home[0]
+    colliders = cands[home == target]
+    assert len(colliders) > ph.MAX_PROBES + 2   # the craft collided
+    colliders = colliders[:ph.MAX_PROBES + 4]
+    tables = default_star(k=3, dim_rows=64, hit_rate=0.9)
+    esc = tables["dim2"]
+    rng = np.random.default_rng(7)
+    tables["dim2"] = TableData(
+        "dim2", esc.schema,
+        [colliders,
+         rng.integers(0, 1000, len(colliders)).astype(np.int64)],
+        primary_key=("d2_key",))
+    # fact keys for dim2 must reference the crafted key space
+    fact = tables["fact"]
+    fcols = [np.asarray(c) for c in fact.columns]
+    fcols[2] = rng.choice(colliders, len(fcols[2]))
+    tables["fact"] = TableData("fact", fact.schema, fcols)
+    s = star_session(tables)
+    before = _degrades()
+    on, off = on_off(s, star_sql(3))
+    assert on == off
+    assert _delta(before) == {"escape": 1}
+
+
+# ---- fact side authoritative ---------------------------------------------
+
+def test_mis_sized_fact_stays_probe():
+    """A fact smaller than its dims must NOT flip into the VMEM build
+    (the pairwise path re-derives sides per hop; MultiJoinNode's fact
+    is authoritative). Output still matches the ladder."""
+    s = star_session(default_star(k=3, fact_rows=64, dim_rows=512,
+                                  hit_rate=0.9))
+    on, off = on_off(s, star_sql(3))
+    assert on == off
+
+
+# ---- mesh executor: wholesale degrade ------------------------------------
+
+def test_mesh_degrades_to_ladder():
+    from trino_tpu.parallel.dist_executor import MeshExecutor
+    from trino_tpu.parallel.mesh import make_mesh
+    s = star_session(default_star(k=3))
+    s.execute("SET SESSION enable_multiway_join = 'true'")
+    ref = s.execute(star_sql(3, agg=True)).rows
+    m = star_session(default_star(k=3))
+    m.executor = MeshExecutor(m.catalog, make_mesh(8))
+    m.execute("SET SESSION enable_multiway_join = 'true'")
+    before = _degrades()
+    got = m.execute(star_sql(3, agg=True)).rows
+    assert got == ref
+    assert _delta(before) == {"mesh": 3}
+
+
+# ---- EXPLAIN surface ------------------------------------------------------
+
+def test_explain_star_verdict_and_strategy():
+    s = star_session(default_star(k=3))
+    s.execute("SET SESSION enable_multiway_join = 'true'")
+    on = "\n".join(r[0] for r in s.execute(
+        "EXPLAIN " + star_sql(3)).rows)
+    assert "MultiJoin[star, k=3" in on
+    assert "join strategy: multiway[k=3]" in on
+    s.execute("SET SESSION enable_multiway_join = 'false'")
+    off = "\n".join(r[0] for r in s.execute(
+        "EXPLAIN " + star_sql(3)).rows)
+    assert "MultiJoin" not in off.replace("multiway star", "")
+    assert "multiway star: fusable k=3" in off
+
+
+def test_explain_analyze_ran_divergence():
+    """After a full VMEM degrade, EXPLAIN ANALYZE appends the executed
+    strategy to the multiway prediction ([ran: ladder])."""
+    s = star_session(default_star(k=3))
+    s.execute("SET SESSION enable_multiway_join = 'true'")
+    s.execute("SET SESSION multiway_vmem_kb = 8")
+    text = "\n".join(r[0] for r in s.execute(
+        "EXPLAIN ANALYZE " + star_sql(3)).rows)
+    assert "join strategy: multiway[k=3] [ran: ladder]" in text
+
+
+def test_explain_declined_star():
+    """A non-inner hop keeps the ladder and EXPLAIN says why."""
+    s = star_session(default_star(k=2))
+    s.execute("SET SESSION enable_multiway_join = 'true'")
+    sql = ("SELECT f_value FROM fact "
+           "JOIN dim0 ON f_d0key = d0_key "
+           "LEFT JOIN dim1 ON f_d1key = d1_key")
+    text = "\n".join(r[0] for r in s.execute("EXPLAIN " + sql).rows)
+    assert "MultiJoin" not in text
+    assert "multiway star: declined" in text
+
+
+def test_multiway_max_dims_cap():
+    s = star_session(default_star(k=3))
+    s.execute("SET SESSION enable_multiway_join = 'true'")
+    s.execute("SET SESSION multiway_max_dims = 2")
+    text = "\n".join(r[0] for r in s.execute(
+        "EXPLAIN " + star_sql(3)).rows)
+    assert "MultiJoin[star, k=2" in text
+    on, off = on_off(s, star_sql(3))
+    assert on == off
+
+
+# ---- shape-lattice compliance --------------------------------------------
+
+def test_repeated_star_zero_new_shapes():
+    """Lattice lint: once the star's decisions settle, re-executions of
+    the same fused query add ZERO distinct compiled shapes anywhere."""
+    from trino_tpu.exec.profiler import RECORDER
+    s = star_session(default_star(k=3))
+    s.execute("SET SESSION enable_multiway_join = 'true'")
+    sql = star_sql(3, agg=True)
+    s.execute(sql)                  # cold: compiles + decision fetches
+    s.execute(sql)                  # adaptation pass (decisions settle)
+    settled = RECORDER.site_shape_counts()
+    s.execute(sql)
+    s.execute(sql)
+    again = RECORDER.site_shape_counts()
+    assert again == settled, {k: again[k] - settled.get(k, 0)
+                              for k in again
+                              if again[k] != settled.get(k, 0)}
+
+
+# ---- metrics surface ------------------------------------------------------
+
+def test_multijoin_metric_families_render_cold():
+    from trino_tpu.metrics import REGISTRY
+    text = REGISTRY.render()
+    assert "# TYPE trino_tpu_multijoin_fused_probes_total" in text
+    for reason in ("kernel_off", "vmem", "dup", "escape", "dtype",
+                   "mesh", "spill"):
+        assert f'reason="{reason}"' in text
+
+
+def test_operator_stats_strategy_column():
+    s = star_session(default_star(k=3))
+    s.execute("SET SESSION enable_multiway_join = 'true'")
+    s.execute(star_sql(3))
+    assert s.executor.strategy_decisions.get("MultiJoinNode") == \
+        "multiway[k=3]"
+
+
+# ---- bench harness --------------------------------------------------------
+
+def test_star_micro_smoke_and_regression_series(tmp_path):
+    """--star-micro CPU smoke writes a parseable round; the regression
+    gate reads star-micro rounds as their own config series and flags
+    an injected 3x fused-kernel slowdown."""
+    import json
+
+    import bench
+    out = bench.star_micro(shapes=[(2, 0.9)], fact_rows=1 << 11,
+                           dim_rows=128, runs=1,
+                           out_path=str(tmp_path /
+                                        "BENCH_star_micro.json"))
+    assert out["records"] and out["records"][0]["fused_engaged"]
+    parsed = bench.load_bench_round(str(tmp_path /
+                                        "BENCH_star_micro.json"))
+    assert parsed and any(k.startswith("star_micro_k") for k in parsed)
+    base = {"metric": "star_micro_ms",
+            "records": [{"dims": 2, "hit_rate": 0.9, "fused_ms": 3.0,
+                         "pairwise_ms": 9.0}]}
+    paths = []
+    for i in range(3):
+        p = tmp_path / f"r{i}.json"
+        p.write_text(json.dumps(base))
+        paths.append(str(p))
+    bad = {"metric": "star_micro_ms",
+           "records": [{"dims": 2, "hit_rate": 0.9, "fused_ms": 9.5,
+                        "pairwise_ms": 9.0}]}
+    pbad = tmp_path / "r3.json"
+    pbad.write_text(json.dumps(bad))
+    ok, _ = bench.check_regressions(paths)
+    assert ok
+    ok2, report2 = bench.check_regressions(paths + [str(pbad)])
+    assert not ok2
+    assert "star_micro_k2_h0.9_fused" in report2["regressions"]

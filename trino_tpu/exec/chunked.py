@@ -588,6 +588,13 @@ def analyze(root: L.OutputNode, catalog, chunk_rows: int,
             if parent.left is not node:
                 return None       # driver on the build side: can't stream
             build_roots.append(parent.right)
+        elif isinstance(parent, L.MultiJoinNode):
+            # fused star: the driver must BE the fact side; every
+            # dimension pins like a pairwise build side, so the fused
+            # tables build once and each chunk probes them sync-free
+            if parent.fact is not node:
+                return None
+            build_roots.extend(parent.dims)
         elif isinstance(parent, L.AggregateNode):
             if any(a.distinct for a in parent.aggs):
                 return None       # distinct needs global dedup

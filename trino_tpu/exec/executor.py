@@ -84,6 +84,10 @@ class ExecStats:
     scan_prefetched_chunks: int = 0  # chunks served from the prefetch
                                      # pipeline (exec/chunked.py)
     scan_prefetch_stalls: int = 0    # consumer waits on an unstaged chunk
+    multijoin_fused_probes: int = 0  # fused multiway star passes run
+                                     # (ops/pallas_hash.multiway_probe)
+    multijoin_degrades: int = 0      # star dimensions degraded back to
+                                     # the pairwise ladder (any reason)
 
 
 class QueryDeadlineError(RuntimeError):
@@ -166,6 +170,16 @@ class Executor:
         # aggregation + hybrid hash join; same auto/true/false contract
         self.enable_pallas_hash = "auto"
         self.hash_table_slots = 0      # 0 = size from stats; tests pin
+        # fused multiway star join (ops/pallas_hash.multiway_probe):
+        # same auto/true/false contract; the planner consults its OWN
+        # copy of the property when deciding to emit MultiJoinNode, this
+        # one gates the executor's kernel-vs-ladder choice
+        self.enable_multiway_join = "auto"
+        self.multiway_max_dims = 5
+        # resident-table budget for the fused pass, in KiB (per-dim
+        # tables share one slot count; dims are dropped largest-first to
+        # the pairwise path until the stack fits)
+        self.multiway_vmem_kb = 8192
         # per-query record of the strategy each operator class actually
         # ran with (EXPLAIN `agg strategy:` lines, operator_stats column)
         self.strategy_decisions: Dict[str, str] = {}
@@ -406,14 +420,19 @@ class Executor:
         error propagates so an enclosing operator (or the query
         boundary) handles it."""
         if not self.enable_spill or \
-                not isinstance(node, (L.JoinNode, L.AggregateNode,
-                                      L.SortNode)):
+                not isinstance(node, (L.JoinNode, L.MultiJoinNode,
+                                      L.AggregateNode, L.SortNode)):
             raise
         # drop this subtree's partial reservations from the failed
         # attempt; the spill path re-executes the children bounded
         self.release_path_reservations(node, keep=self._subst)
         from .spill import spill_aggregate, spill_join, spill_sort
-        if isinstance(node, L.JoinNode):
+        if isinstance(node, L.MultiJoinNode):
+            # the spill tier partitions pairwise joins: reconstruct the
+            # exact ladder the star fused and spill its top hop
+            self._note_multijoin_degrade("spill", len(node.dims))
+            out = spill_join(self, L.multijoin_to_ladder(node))
+        elif isinstance(node, L.JoinNode):
             out = spill_join(self, node)
         elif isinstance(node, L.AggregateNode):
             out = spill_aggregate(self, node)
@@ -499,7 +518,8 @@ class Executor:
         return (self.enable_dynamic_filtering, self.enable_merge_join,
                 str(self.enable_mxu_agg), bool(self.stream_build_bytes),
                 self.spill_chunk_rows, self.hash_mode() != "off",
-                self.hash_table_slots)
+                self.hash_table_slots, self.multiway_mode() != "off",
+                self.multiway_vmem_kb)
 
     _DECISION_CACHE_FILE = "decisions.pkl"
 
@@ -688,6 +708,8 @@ class Executor:
             return self.run_aggregate(node)
         if isinstance(node, L.JoinNode):
             return self.run_join(node)
+        if isinstance(node, L.MultiJoinNode):
+            return self.run_multijoin(node)
         if isinstance(node, L.WindowNode):
             return self.run_window(node)
         if isinstance(node, L.SortNode):
@@ -1002,6 +1024,21 @@ class Executor:
         path, like the tiled gather's)."""
         from ..ops.pallas_hash import resolve_mode
         return resolve_mode(self.enable_pallas_hash)
+
+    def multiway_mode(self) -> str:
+        """Resolved fused multiway-join mode: 'device' | 'interpret' |
+        'off' (ops/pallas_hash.resolve_mode — the same contract as the
+        other Pallas kernels; interpret is the CPU/tier-1 path)."""
+        from ..ops.pallas_hash import resolve_mode
+        return resolve_mode(self.enable_multiway_join)
+
+    def _note_multijoin_degrade(self, reason: str,
+                                count: int = 1) -> None:
+        """Count star dimensions degraded back to the pairwise ladder,
+        per reason (kernel_off/vmem/dup/escape/dtype/mesh/spill)."""
+        self.stats.multijoin_degrades += count
+        from ..metrics import MULTIJOIN_DEGRADES
+        MULTIJOIN_DEGRADES.inc(count, reason=reason)
 
     def _note_strategy(self, op: str, strategy: str, kind: str) -> None:
         """Record the strategy an operator actually ran with: the
@@ -1788,6 +1825,205 @@ class Executor:
                                    node.right_keys, node.kind,
                                    self.gather_mode())
 
+    # ------------------------------------------------------------------
+    # fused multiway star join (MultiJoinNode)
+    # ------------------------------------------------------------------
+
+    def run_multijoin(self, node: "L.MultiJoinNode") -> Batch:
+        """Lower a MultiJoinNode to the fused single-pass kernel
+        (ops/pallas_hash.multiway_probe), degrading DIMENSION-BY-
+        DIMENSION to the pairwise path whenever a dim's table overflows
+        the VMEM budget, its build keys turn out duplicated, or its
+        insert escaped — and wholesale to the reconstructed ladder when
+        the kernel is off or fewer than two dims survive.  Every output
+        is bit-exact vs `multijoin_to_ladder`'s pairwise ladder: fused
+        dims ride the SAME payload-gather machinery the dense/hash
+        joins use, and column order is restored to ladder order at the
+        end.  The fact side is authoritative (never flipped to build).
+
+        Chunk mode caches the validated dimension tables per node, so
+        each streamed fact chunk probes sync-free like the pairwise
+        dense-LUT path."""
+        from ..ops import pallas_hash as ph
+        mode = self.multiway_mode()
+        if mode == "off":
+            self._note_multijoin_degrade("kernel_off", len(node.dims))
+            return self._run_multijoin_ladder(node)
+        fact = self.run(node.fact)
+        dims = [self.run(d) for d in node.dims]
+        k = len(dims)
+        ckey = (id(node), "multiway")
+        rec = self._chunk_lut_cache.get(ckey) if self.chunk_mode \
+            else None
+        if rec is None:
+            degraded: Dict[int, str] = {}
+            sized = []
+            for d in range(k):
+                ok_dtype = True
+                for side, keys in ((fact, node.fact_keys[d]),
+                                   (dims[d], node.dim_keys[d])):
+                    for ki in keys:
+                        dt = side.columns[ki].data.dtype
+                        if not (jnp.issubdtype(dt, jnp.integer) or
+                                dt == jnp.bool_):
+                            ok_dtype = False
+                if not ok_dtype:
+                    degraded[d] = "dtype"
+                    continue
+                slots, fits = ph.join_table_slots(dims[d].capacity)
+                if self.hash_table_slots:
+                    t = ph.MIN_TABLE_SLOTS
+                    while t * 2 <= min(self.hash_table_slots,
+                                       ph.MAX_TABLE_SLOTS):
+                        t *= 2
+                    slots = t
+                    fits = t * ph.LOAD_NUM // ph.LOAD_DEN >= \
+                        dims[d].capacity
+                if not fits:
+                    degraded[d] = "vmem"
+                    continue
+                sized.append((d, slots))
+            # all resident tables share ONE slot count (rectangular
+            # stack on the bucket_capacity-style power-of-two lattice);
+            # shed the largest dims until the stack fits the budget
+            budget = self.multiway_vmem_kb << 10
+            while sized and ph.multiway_table_bytes(
+                    len(sized), max(s for _, s in sized)) > budget:
+                drop = max(sized, key=lambda x: x[1])
+                sized.remove(drop)
+                degraded[drop[0]] = "vmem"
+            fused = []
+            if len(sized) >= 2:
+                table_slots = max(s for _, s in sized)
+                builds, checks = [], []
+                for d, _s in sized:
+                    tkl, tkh, src, dup, esc = ph.build_join_table(
+                        dims[d], node.dim_keys[d], table_slots, mode)
+                    builds.append((d, tkl, tkh, src))
+                    checks.extend((dup, esc))
+                # ONE fused validation fetch for all k builds
+                vals = self.fetch_ints(node, f"mjbuild{table_slots}",
+                                       *checks)
+                for i, b in enumerate(builds):
+                    if vals[2 * i] > 0:
+                        degraded[b[0]] = "dup"
+                    elif vals[2 * i + 1] > 0:
+                        degraded[b[0]] = "escape"
+                    else:
+                        fused.append(b)
+            for _d, reason in sorted(degraded.items()):
+                self._note_multijoin_degrade(reason)
+            rec = (fused, sorted(degraded))
+            if self.chunk_mode:
+                self._chunk_lut_cache[ckey] = rec
+        fused, degraded_dims = rec
+        if len(fused) < 2:
+            # nothing left worth a fused pass: run the whole ladder
+            # over the already-materialized children
+            return self._run_multijoin_ladder(node, fact, dims)
+        from ..metrics import (JOIN_STRATEGY_DECISIONS,
+                               MULTIJOIN_FUSED_PROBES)
+        found, _miss = ph.multiway_probe(
+            fact,
+            jnp.stack([b[1] for b in fused]),
+            jnp.stack([b[2] for b in fused]),
+            jnp.stack([b[3] for b in fused]),
+            tuple(node.fact_keys[b[0]] for b in fused), mode)
+        self.stats.multijoin_fused_probes += 1
+        MULTIJOIN_FUSED_PROBES.inc()
+        self.strategy_decisions["MultiJoinNode"] = \
+            f"multiway[k={len(fused)}]"
+        JOIN_STRATEGY_DECISIONS.inc(strategy="multiway")
+        # payload assembly: fused dims first (their found rows align to
+        # fact rows), then each degraded dim through the pairwise path;
+        # unique-build hops are commutative live-mask ANDs and dup
+        # expansions keep their original relative order, so the row
+        # sequence matches the ladder's
+        from ..ops.join import _combined_key, _gather_build_payload
+        gm = self.gather_mode()
+        acc = fact
+        acc_out = list(node.fact.output)
+        col_ranges: Dict[int, tuple] = {}
+        pos = len(fact.columns)
+        for i, (d, _tl, _th, _sr) in enumerate(fused):
+            matched = found[i] >= 0
+            pk, _pk_valid = _combined_key(fact, node.fact_keys[d])
+            src_c = jnp.clip(found[i], 0, dims[d].capacity - 1)
+            acc = _gather_build_payload(acc, dims[d], src_c, matched,
+                                        pk, node.dim_keys[d], "inner",
+                                        gm)
+            col_ranges[d] = (pos, len(dims[d].columns))
+            acc_out.extend(node.dims[d].output)
+            pos += len(dims[d].columns)
+        for d in degraded_dims:
+            # chunk mode: keep the synthesized hop alive across chunks
+            # so its id stays stable — the pairwise LUT/hash caches key
+            # on id(node), and a per-chunk temporary could both miss
+            # every chunk AND alias a dead node's reused id
+            jkey = (id(node), "mjpair", d)
+            j = self._chunk_lut_cache.get(jkey) if self.chunk_mode \
+                else None
+            if j is None:
+                j = L.JoinNode(
+                    "inner", node.fact, node.dims[d],
+                    node.fact_keys[d], node.dim_keys[d], None, True,
+                    tuple(acc_out) + tuple(node.dims[d].output),
+                    distribution=node.distribution,
+                    build_key_domain=node.dim_domains[d])
+                if self.chunk_mode:
+                    self._chunk_lut_cache[jkey] = j
+            # per-partition batches differ from what the structure key
+            # describes (fused columns ride along): no cached decisions
+            with self.no_decisions():
+                acc = self._run_join_inner(j, acc, dims[d])
+            col_ranges[d] = (pos, len(dims[d].columns))
+            acc_out.extend(node.dims[d].output)
+            pos += len(dims[d].columns)
+        perm = list(range(len(fact.columns)))
+        for d in range(k):
+            start, ln = col_ranges[d]
+            perm.extend(range(start, start + ln))
+        if perm != list(range(len(acc.columns))):
+            acc = Batch(tuple(acc.columns[i] for i in perm), acc.live)
+        if not self.chunk_mode and not degraded_dims:
+            acc = self.maybe_compact(acc, node=node)
+        return acc
+
+    def _run_multijoin_ladder(self, node: "L.MultiJoinNode",
+                              fact: Optional[Batch] = None,
+                              dims: Optional[list] = None) -> Batch:
+        """Full degrade: execute the exact pairwise ladder the star
+        fused.  Already-run children are substituted in so they are not
+        recomputed; the ladder is cached per node in chunk mode so the
+        pairwise LUT/hash caches stay keyed on stable node ids."""
+        from ..metrics import JOIN_STRATEGY_DECISIONS
+        self.strategy_decisions["MultiJoinNode"] = "ladder"
+        JOIN_STRATEGY_DECISIONS.inc(strategy="ladder")
+        lkey = (id(node), "mjladder")
+        ladder = self._chunk_lut_cache.get(lkey) if self.chunk_mode \
+            else None
+        if ladder is None:
+            ladder = L.multijoin_to_ladder(node)
+            if self.chunk_mode:
+                self._chunk_lut_cache[lkey] = ladder
+        temp = []
+        try:
+            if fact is not None:
+                for child, batch in zip((node.fact,) + node.dims,
+                                        [fact] + list(dims)):
+                    if id(child) not in self._subst:
+                        self._subst[id(child)] = batch
+                        temp.append(id(child))
+            out = self.run(ladder)
+        finally:
+            for i in temp:
+                self._subst.pop(i, None)
+        # the outer run() re-reserves this result under the
+        # MultiJoinNode's own id; drop the ladder-top ledger entry so
+        # the bytes are not double-counted
+        self.pool.free(self._node_bytes.pop(id(ladder), 0))
+        return out
+
     def enter_chunk_mode(self) -> None:
         self.chunk_mode = True
 
@@ -1968,6 +2204,8 @@ def explain_strategy_lines(root: L.PlanNode, executor) -> List[str]:
     (e.g. a hash plan whose keys could not pack fell back to sort)."""
     lines: List[str] = []
     hash_on = executor.hash_mode() != "off"
+    multiway_on = executor.multiway_mode() != "off"
+    max_dims = int(getattr(executor, "multiway_max_dims", 5))
     ran = executor.strategy_decisions
 
     def verdict(predicted: str, op: str) -> str:
@@ -1976,7 +2214,7 @@ def explain_strategy_lines(root: L.PlanNode, executor) -> List[str]:
             return f"{predicted} [ran: {actual}]"
         return predicted
 
-    def walk(node: L.PlanNode) -> None:
+    def walk(node: L.PlanNode, spine: bool = False) -> None:
         if isinstance(node, L.AggregateNode) and \
                 node.strategy != "global":
             if node.strategy == "direct":
@@ -1994,6 +2232,13 @@ def explain_strategy_lines(root: L.PlanNode, executor) -> List[str]:
             lines.append("agg strategy: "
                          + verdict(pred, "AggregateNode"))
         elif isinstance(node, L.JoinNode):
+            # star-detector verdict at the TOP of each probe spine: why
+            # a ladder that stayed pairwise would (not) fuse — printed
+            # either way, so declined stars are as visible as fused ones
+            if not spine:
+                sv = L.star_verdict(node, max_dims)
+                if sv is not None:
+                    lines.append("multiway star: " + sv)
             if node.build_key_domain is not None and node.build_unique:
                 pred = f"dense-lut (domain {node.build_key_domain})"
             elif not node.build_unique:
@@ -2010,8 +2255,23 @@ def explain_strategy_lines(root: L.PlanNode, executor) -> List[str]:
             dist = getattr(node, "distribution", "auto")
             lines.append("join distribution: "
                          + verdict(dist, "JoinDistribution"))
-        for c in L.children(node):
-            walk(c)
+        elif isinstance(node, L.MultiJoinNode):
+            kk = len(node.dims)
+            pred = f"multiway[k={kk}]" if multiway_on else \
+                f"multiway[k={kk}] (kernel off -> ladder)"
+            lines.append("join strategy: "
+                         + verdict(pred, "MultiJoinNode"))
+            lines.append("join distribution: "
+                         + verdict(node.distribution,
+                                   "JoinDistribution"))
+        if isinstance(node, L.JoinNode):
+            walk(node.left, spine=True)
+            walk(node.right)
+        elif isinstance(node, L.FilterNode):
+            walk(node.child, spine=spine)
+        else:
+            for c in L.children(node):
+                walk(c)
 
     walk(root)
     return lines

@@ -468,6 +468,18 @@ JIT_DISTINCT_SHAPES = REGISTRY.gauge(
     "Distinct (fingerprint) program shapes recorded per jit site — the "
     "shape-canonicalization regression signal", ("site",))
 
+# fused multiway star join (ops/pallas_hash.py multiway_probe +
+# exec/executor.py run_multijoin): one Pallas pass probing every
+# VMEM-resident dimension table, degrading dim-by-dim to the ladder
+MULTIJOIN_FUSED_PROBES = REGISTRY.counter(
+    "trino_tpu_multijoin_fused_probes_total",
+    "Fused multiway probe kernel launches (one per fact chunk that "
+    "probed >= 2 resident dimension tables in a single pass)")
+MULTIJOIN_DEGRADES = REGISTRY.counter(
+    "trino_tpu_multijoin_degrades_total",
+    "Dimension hops evicted from the fused star probe back to the "
+    "pairwise ladder, by reason", ("reason",))
+
 # query history + latency-regression detection (server/history.py)
 LATENCY_REGRESSIONS = REGISTRY.counter(
     "trino_tpu_query_latency_regressions_total",
@@ -495,8 +507,12 @@ for _target in ("host", "device"):
     ROUTER_DECISIONS.init_labels(target=_target)
 for _s in ("global", "direct", "mxu", "sort", "hash"):
     AGG_STRATEGY_DECISIONS.init_labels(strategy=_s)
-for _s in ("dense-lut", "hybrid-hash", "sort-merge", "sorted", "expand"):
+for _s in ("dense-lut", "hybrid-hash", "sort-merge", "sorted", "expand",
+           "multiway", "ladder"):
     JOIN_STRATEGY_DECISIONS.init_labels(strategy=_s)
+for _r in ("kernel_off", "vmem", "dup", "escape", "dtype", "mesh",
+           "spill"):
+    MULTIJOIN_DEGRADES.init_labels(reason=_r)
 for _m in ("broadcast", "partitioned"):
     JOIN_DISTRIBUTION_DECISIONS.init_labels(mode=_m)
 for _ls in ("ACTIVE", "DRAINING", "DRAINED", "LEFT", "FAILED"):

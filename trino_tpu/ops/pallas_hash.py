@@ -552,6 +552,165 @@ def hash_join_probe(probe: Batch, build: Batch, tk_lo, tk_hi, src,
                                  build_keys, kind, gather_mode)
 
 
+# --------------------------------------------------------------------------
+# fused multiway star probe: k resident dimension tables, one pass
+# --------------------------------------------------------------------------
+
+MAX_MULTI_DIMS = 5           # q5-class stars top out here; the planner cap
+
+
+def multiway_table_bytes(k: int, table_slots: int) -> int:
+    """Resident VMEM footprint of k fused dimension tables: 3 int32
+    planes each (key_lo, key_hi, src row id)."""
+    return 3 * 4 * k * table_slots
+
+
+def _multiprobe_kernel(k: int, table_slots: int):
+    """Per fact block, walk all k probe chains in ONE kernel pass.
+
+    Dimension planes arrive stacked [k, t_rows, LANES] and stay VMEM
+    resident across the whole grid (index map pins them to block 0).
+    Each row short-circuits: once it misses a dimension it is dead for
+    every later one — exactly the ladder's live-mask AND, but without k
+    intermediate materializations.  Per-dimension miss counters (rows
+    that were still alive entering dimension d and failed there) ride
+    an SMEM (1, k) accumulator, the `_insert_kernel` esc/occ pattern.
+    """
+    t_rows = table_slots // LANES
+
+    def kernel(slot_ref, klo_ref, khi_ref, tk_lo, tk_hi, src_ref,
+               found_ref, sc_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            for d in range(k):
+                sc_ref[0, d] = jnp.int32(0)
+
+        def row(j, miss):
+            r = j // LANES
+            l = j % LANES
+            # slot encoding: -2 dead fact row (skip entirely), -1 live
+            # row whose key is NULL/sentinel (counts as a miss), else
+            # the home slot.  The dead/live split is per row, so dim 0's
+            # plane answers it for all dims.
+            alive = slot_ref[0, r, l] != -2
+            out_miss = []
+            for d in range(k):
+                slot = slot_ref[d, r, l]
+                klo = klo_ref[d, r, l]
+                khi = khi_ref[d, r, l]
+                ok = alive & (slot >= 0)
+
+                def probe_cond(c):
+                    return c[2] == 0
+
+                def probe_body(c, d=d):
+                    s, p, _ = c
+                    sr = s // LANES
+                    sl = s % LANES
+                    thi = tk_hi[d, sr, sl]
+                    tlo = tk_lo[d, sr, sl]
+                    empty = (thi == _EMPTY_HI) & (tlo == _EMPTY_LO)
+                    match = (~empty) & (thi == khi) & (tlo == klo)
+                    out = jnp.where(match, 1,
+                                    jnp.where(empty, 3,
+                                              0)).astype(jnp.int32)
+                    p2 = p + jnp.int32(1)
+                    out = jnp.where((out == 0) & (p2 >= MAX_PROBES),
+                                    jnp.int32(3), out)
+                    nxt = jnp.where(s + 1 >= table_slots, 0,
+                                    s + 1).astype(jnp.int32)
+                    return (jnp.where(out == 0, nxt, s), p2, out)
+
+                s_f, _, outcome = jax.lax.while_loop(
+                    probe_cond, probe_body,
+                    (jnp.where(ok, slot, 0), jnp.int32(0),
+                     jnp.where(ok, jnp.int32(0), jnp.int32(3))))
+                hit = ok & (outcome == 1)
+                sr = s_f // LANES
+                sl = s_f % LANES
+                found_ref[d, r, l] = jnp.where(
+                    hit, src_ref[d, sr, sl], jnp.int32(-1))
+                out_miss.append(
+                    miss[d] + jnp.where(alive & ~hit,
+                                        1, 0).astype(jnp.int32))
+                alive = hit
+            return tuple(out_miss)
+
+        miss0 = tuple(sc_ref[0, d] for d in range(k))
+        miss = jax.lax.fori_loop(0, BLOCK, row, miss0)
+        for d in range(k):
+            sc_ref[0, d] = miss[d]
+    return kernel
+
+
+@recorded_jit(static_argnums=(4, 5))
+def multiway_probe(probe: Batch, tk_lo, tk_hi, src,
+                   probe_keys: tuple, mode: str):
+    """Fused star probe: k dup/escape-validated dimension tables
+    (stacked `build_join_table` planes, ALL sized to one shared
+    `table_slots` so the stack is rectangular) probed in a single
+    Pallas pass over the fact batch.  `probe_keys` is a tuple of
+    per-dimension fact-side key index tuples.  Returns
+    (found [k, n] int32 build row ids, -1 = miss at-or-before that
+    dimension; miss [k] int64 per-dimension miss counters) — payload
+    gathers stay in the caller, which shares the dense-join machinery
+    with the pairwise ladder for bit-exactness."""
+    from .join import _combined_key
+    k = len(probe_keys)
+    table_slots = tk_lo.shape[1]
+    slots, klos, khis = [], [], []
+    for pk_idx in probe_keys:
+        pk, pk_valid = _combined_key(probe, pk_idx)
+        ok = probe.live & pk_valid & (pk != EMPTY_KEY)
+        slot = jnp.where(ok, hash_slot(pk, table_slots),
+                         jnp.where(probe.live, -1, -2))
+        klo, khi = _split64(pk)
+        slots.append(slot)
+        klos.append(jnp.where(ok, klo, 0))
+        khis.append(jnp.where(ok, khi, 0))
+    n = probe.capacity
+    slot = _pad_rows(jnp.stack(slots), -2)
+    klo = _pad_rows(jnp.stack(klos), 0)
+    khi = _pad_rows(jnp.stack(khis), 0)
+    npad = slot.shape[-1]
+    nb = npad // BLOCK
+    t_rows = table_slots // LANES
+    found, sc = pl.pallas_call(
+        _multiprobe_kernel(k, table_slots),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((k, SUB, LANES), lambda i: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, SUB, LANES), lambda i: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, SUB, LANES), lambda i: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, t_rows, LANES), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, t_rows, LANES), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, t_rows, LANES), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM)],
+        out_specs=[
+            pl.BlockSpec((k, SUB, LANES), lambda i: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM)],
+        out_shape=[
+            jax.ShapeDtypeStruct((k, nb * SUB, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((1, k), jnp.int32)],
+        interpret=(mode == "interpret"),
+    )(slot.reshape(k, nb * SUB, LANES),
+      klo.reshape(k, nb * SUB, LANES),
+      khi.reshape(k, nb * SUB, LANES),
+      tk_lo.reshape(k, t_rows, LANES),
+      tk_hi.reshape(k, t_rows, LANES),
+      src.reshape(k, t_rows, LANES))
+    return found.reshape(k, npad)[:, :n], sc[0].astype(jnp.int64)
+
+
 def shard_join(probe: Batch, build: Batch, probe_keys: tuple,
                build_keys: tuple, kind: str, table_slots: int,
                mode: str, gather_mode: str = "off"):

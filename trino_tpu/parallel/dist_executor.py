@@ -177,6 +177,13 @@ class MeshExecutor(Executor):
 
     # -- join distribution (broadcast vs partitioned) ------------------
 
+    def run_multijoin(self, node):
+        # The fused star kernel assumes a single-device VMEM-resident
+        # build set; on a mesh the pairwise ladder keeps the
+        # partitioned/broadcast machinery per hop instead.
+        self._note_multijoin_degrade("mesh", len(node.dims))
+        return self._run_multijoin_ladder(node)
+
     def _run_join_inner(self, node: L.JoinNode, probe: Batch,
                         build: Batch) -> Batch:
         mode = "partitioned" if self._partitioned_eligible(

@@ -102,6 +102,11 @@ def push_scan_predicates(node: L.PlanNode) -> L.PlanNode:
             nv = push_scan_predicates(v)
             if nv is not v:
                 changes[f.name] = nv
+        elif isinstance(v, tuple) and v and \
+                all(isinstance(x, L.PlanNode) for x in v):
+            nt = tuple(push_scan_predicates(x) for x in v)
+            if any(a is not b for a, b in zip(nt, v)):
+                changes[f.name] = nt
     return _dc.replace(node, **changes) if changes else node
 
 
@@ -291,6 +296,18 @@ def _prune(node: L.PlanNode, needed: frozenset):
             node.num_rows,
             tuple(node.fields[i] for i in keep),
             tuple(node.output[i] for i in keep)), mapping
+
+    if isinstance(node, L.MultiJoinNode):
+        # The fused star probe consumes every fact/dim column that the
+        # ladder it replaces would have; keep children exact (scans
+        # beneath them still prune via their own Project/Filter layers)
+        fact = _prune_exact(node.fact,
+                            frozenset(range(len(node.fact.output))))
+        dims = tuple(_prune_exact(d, frozenset(range(len(d.output))))
+                     for d in node.dims)
+        return L.MultiJoinNode(
+            fact, dims, node.fact_keys, node.dim_keys, node.dim_domains,
+            node.output, node.distribution), _identity(len(node.output))
 
     if isinstance(node, L.SetOpNode):
         # distinct/intersect/except semantics are over the whole row:

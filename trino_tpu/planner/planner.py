@@ -402,7 +402,7 @@ class Planner:
         if 2 < len(pending) <= self.DP_REORDER_MAX:
             planned = self._dp_reorder(pending, conjuncts)
             if planned is not None:
-                return planned
+                return self._maybe_multijoin(planned)
         pending.sort(key=lambda r: -self.estimate_rows(r.node))
         acc = pending.pop(0)
         while pending:
@@ -425,7 +425,22 @@ class Planner:
             pending.remove(chosen)
             acc = self.join_pair(acc, chosen, conjuncts, kind="inner")
             acc = self.apply_local_filters(acc, conjuncts)
-        return acc
+        return self._maybe_multijoin(acc)
+
+    def _maybe_multijoin(self, rel: PlannedRelation) -> PlannedRelation:
+        """Star detector (ISSUE round-17): fuse the ladder's longest
+        fact-to-dims prefix into a MultiJoinNode when the session allows
+        it.  The rewrite is plan-shape only — the executor owns every
+        runtime degrade back to the pairwise path."""
+        from ..ops.pallas_hash import resolve_mode
+        setting = self.properties.get("enable_multiway_join", "auto")
+        if resolve_mode(setting) == "off":
+            return rel
+        max_dims = int(self.properties.get("multiway_max_dims", 5))
+        fused = L.fuse_star_joins(rel.node, max_dims)
+        if fused is rel.node:
+            return rel
+        return PlannedRelation(fused, rel.scope)
 
     # cost-based join reordering explores all connected bushy splits up
     # to this many relations (2^n subsets; TPC-DS join graphs past ~10
