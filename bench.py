@@ -952,6 +952,162 @@ def chaos_soak(n_seeds=None, cluster=None, out_path="BENCH_chaos.json"):
     return rec
 
 
+# ---------------------------------------------------------------------------
+# --write-chaos: exactly-once distributed-write soak (round-18 PR)
+# ---------------------------------------------------------------------------
+
+WRITE_CHAOS_SRC = ("SELECT o_orderkey, o_custkey, o_orderstatus, "
+                   "o_totalprice FROM tpch.tiny.orders")
+
+
+def write_chaos_soak(n_seeds=None, out_path="BENCH_write_chaos.json"):
+    """Seeded write-chaos soak: distributed CTAS with kills injected at
+    each write-protocol boundary (WRITE_STAGE / WRITE_COMMIT /
+    WRITE_PUBLISH, faults rotating through RAISE / CRASH / DELAY plus
+    torn-journal CORRUPT appends, some seeds with forced duplicate
+    hedged attempts). Every seed's committed table must equal the
+    fault-free row multiset — 0 lost rows, 0 duplicate rows — and leave
+    0 orphaned staging files or journals. Pre-intent failures are
+    retried under the SAME query id, so the soak also proves commit
+    idempotence across whole-query retries. Emits BENCH_write_chaos.json
+    with per-point commit-wall percentiles for the regression gate."""
+    import shutil as _shutil
+    import tempfile
+    from collections import Counter
+
+    from trino_tpu.connectors.orcdir import OrcConnector
+    from trino_tpu.exec.session import Session
+    from trino_tpu.server import writeprotocol as wp
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.failureinjector import (CORRUPT, CRASH, DELAY,
+                                                  RAISE, WRITE_COMMIT,
+                                                  WRITE_POINTS,
+                                                  FailureInjector)
+    from trino_tpu.server.worker import WorkerServer
+
+    n = n_seeds if n_seeds is not None else \
+        int(os.environ.get("TRINO_TPU_WRITE_CHAOS_SEEDS", 27))
+    budget_s = float(os.environ.get("TRINO_TPU_WRITE_CHAOS_BUDGET_S", 420))
+    t_start = time.monotonic()
+    root = tempfile.mkdtemp(prefix="write_chaos_")
+    os.makedirs(os.path.join(root, "out"))
+    session = Session(default_schema="tiny")
+    conn = OrcConnector(root)
+    session.catalog.register("orc", conn)
+    coord = CoordinatorServer(session, retry_policy="QUERY").start()
+    sched = coord.state.scheduler
+    sched.split_rows = 4096
+    workers = [WorkerServer(f"wchaos-w{i}", coord.uri,
+                            announce_interval_s=0.1,
+                            catalog=session.catalog).start()
+               for i in range(3)]
+    deadline = time.time() + 5
+    while len(coord.state.active_nodes()) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+
+    baseline = Counter(_chaos_rows(session.execute(WRITE_CHAOS_SRC).rows))
+    rec = {"metric": "write_chaos", "seeds": 0, "writes_committed": 0,
+           "failed_writes": 0, "query_retries": 0, "lost_rows": 0,
+           "dup_rows": 0, "orphans": 0, "hedged_seeds": 0,
+           "attempts_deduped": 0, "injected_total": 0,
+           "injected_by_fault": {}, "injected_by_point": {},
+           "points": {}, "budget_exhausted": False}
+    walls = {p: [] for p in WRITE_POINTS}
+    try:
+        for seed in range(n):
+            if time.monotonic() - t_start > budget_s:
+                rec["budget_exhausted"] = True
+                break
+            point = WRITE_POINTS[seed % len(WRITE_POINTS)]
+            fault = (RAISE, CRASH, DELAY)[(seed // 3) % 3]
+            if point == WRITE_COMMIT and seed % 9 == 4:
+                fault = CORRUPT          # torn intent-journal append
+            inj = FailureInjector(seed=seed)
+            inj.inject(point, times=1, fault=fault)
+            sched.failure_injector = inj
+            for w in workers:
+                w.task_manager.injector = inj
+            sched.force_write_hedge = seed % 4 == 3
+            if sched.force_write_hedge:
+                rec["hedged_seeds"] += 1
+            tbl = f"w{seed}"
+            qid = f"wchaos_{seed}"
+            sql = f"CREATE TABLE orc.out.{tbl} AS {WRITE_CHAOS_SRC}"
+            res = None
+            t0 = time.monotonic()
+            for _attempt in range(3):
+                try:
+                    res = sched.execute(sql, query_id=qid)
+                    break
+                except Exception:
+                    # pre-intent abort: the QUERY retry policy reruns
+                    # the same query id — exactly-once must hold
+                    rec["query_retries"] += 1
+            wall_ms = (time.monotonic() - t0) * 1000
+            sched.failure_injector = None
+            sched.force_write_hedge = False
+            for w in workers:
+                w.task_manager.injector = None
+            rec["seeds"] += 1
+            rec["injected_total"] += inj.injected_count
+            rec["injected_by_point"][point] = \
+                rec["injected_by_point"].get(point, 0) + inj.injected_count
+            for f, cnt in inj.injected_by_fault.items():
+                if cnt:
+                    rec["injected_by_fault"][f] = \
+                        rec["injected_by_fault"].get(f, 0) + cnt
+            if res is None:
+                rec["failed_writes"] += 1
+                continue
+            rec["writes_committed"] += 1
+            walls[point].append(wall_ms)
+            wr = (sched.last_query or {}).get("write") or {}
+            rec["attempts_deduped"] += int(wr.get("deduped", 0))
+            got = Counter(_chaos_rows(session.execute(
+                f"SELECT o_orderkey, o_custkey, o_orderstatus, "
+                f"o_totalprice FROM orc.out.{tbl}").rows))
+            rec["lost_rows"] += sum((baseline - got).values())
+            rec["dup_rows"] += sum((got - baseline).values())
+            td = conn._table_dir("out", tbl)
+            rec["orphans"] += len(os.listdir(wp.staging_dir(td))) \
+                if os.path.isdir(wp.staging_dir(td)) else 0
+            rec["orphans"] += sum(1 for f in os.listdir(td)
+                                  if f.endswith(".journal")
+                                  or f.startswith(".tmp."))
+            conn.drop_table("out", tbl)
+        # nothing may survive outside the published tables either
+        for dirpath, dirnames, filenames in os.walk(root):
+            rec["orphans"] += sum(1 for d in dirnames if d == ".staging")
+            rec["orphans"] += sum(1 for f in filenames
+                                  if f.endswith(".journal")
+                                  or f.startswith(".tmp."))
+    finally:
+        sched.failure_injector = None
+        sched.force_write_hedge = False
+        for w in workers:
+            w.task_manager.injector = None
+            w.stop()
+        coord.stop()
+        _shutil.rmtree(root, ignore_errors=True)
+    for point, ws in walls.items():
+        if ws:
+            ws = sorted(ws)
+            rec["points"][point] = {
+                "commits": len(ws),
+                "p50_ms": round(ws[len(ws) // 2], 1),
+                "p95_ms": round(ws[int(len(ws) * 0.95)], 1)}
+    rec["elapsed_s"] = round(time.monotonic() - t_start, 1)
+    rec["passed"] = (rec["lost_rows"] == 0 and rec["dup_rows"] == 0
+                     and rec["orphans"] == 0
+                     and rec["failed_writes"] == 0
+                     and rec["injected_total"] >= rec["seeds"])
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 def memory_pressure_soak(n_queries=None, out_path="BENCH_memory.json"):
     """Memory-pressure soak (round 9 acceptance): >= 20 concurrent
     queries against a 3-worker cluster with every executor pool clamped
@@ -1764,6 +1920,16 @@ def load_bench_round(path):
         if qps:
             out["soak_ms_per_query"] = 1000.0 / float(qps)
         return out or None
+    if str(doc.get("metric", "")) == "write_chaos":
+        # --write-chaos rounds gate on the per-chaos-point commit walls:
+        # a slower staged-write/commit/publish path in a later round
+        # reads as a regressed write_chaos_* config (correctness — lost
+        # or duplicate rows, orphans — already hard-fails the soak)
+        out = {}
+        for point, d in (doc.get("points") or {}).items():
+            if isinstance(d, dict) and "p50_ms" in d:
+                out[f"write_chaos_{point.lower()}_p50"] = float(d["p50_ms"])
+        return out or None
     if str(doc.get("metric", "")) == "cold_start":
         # --cold-start rounds gate on the fresh-process cold wall AND
         # the cold/steady ratio per query: a compile-cache or prewarm
@@ -1939,6 +2105,11 @@ def build_parser():
     mode.add_argument("--chaos", action="store_true",
                       help="seeded fault-injection soak -> "
                            "BENCH_chaos.json")
+    mode.add_argument("--write-chaos", action="store_true",
+                      help="exactly-once write soak: seeded kills at "
+                           "WRITE_STAGE/WRITE_COMMIT/WRITE_PUBLISH, "
+                           "0 lost/0 dup rows + 0 orphans required -> "
+                           "BENCH_write_chaos.json")
     mode.add_argument("--memory-pressure", action="store_true",
                       help="concurrent soak at 25%% pool -> "
                            "BENCH_memory.json")
@@ -2008,6 +2179,9 @@ def main(argv=None):
     if args.chaos:
         chaos_soak()
         return 0
+    if args.write_chaos:
+        rec = write_chaos_soak()
+        return 0 if rec["passed"] else 1
     if args.memory_pressure:
         memory_pressure_soak()
         return 0
@@ -2073,6 +2247,17 @@ def main(argv=None):
                                              mad_k=args.mad_k)
             report["soak"] = report5
             ok = ok and ok5
+        # the exactly-once write trajectory gates as its own series
+        # (BENCH_write_chaos.json + later rounds'
+        # BENCH_write_chaos_r*.json): a slower commit path at any chaos
+        # point in a later round fails here
+        wc_paths = sorted(_glob.glob("BENCH_write_chaos*.json"))
+        if wc_paths:
+            ok8, report8 = check_regressions(wc_paths,
+                                             ratio=args.ratio,
+                                             mad_k=args.mad_k)
+            report["write_chaos"] = report8
+            ok = ok and ok8
         # the cold-start trajectory gates as its own series
         # (BENCH_cold_r*.json): a regressed fresh-process cold wall or
         # cold/steady ratio in a later round fails here

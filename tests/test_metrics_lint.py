@@ -137,6 +137,12 @@ REQUIRED_FAMILIES = (
     # dim degrades back to the pairwise ladder
     "trino_tpu_multijoin_fused_probes_total",
     "trino_tpu_multijoin_degrades_total",
+    # round-18 exactly-once distributed writes: staged attempts, commit
+    # outcomes, first-success-wins dedup, orphan sweeps
+    "trino_tpu_write_tasks_total",
+    "trino_tpu_write_attempts_deduped_total",
+    "trino_tpu_write_commits_total",
+    "trino_tpu_write_orphans_swept_total",
 )
 
 

@@ -1,0 +1,404 @@
+"""Exactly-once distributed writes (server/writeprotocol.py + the
+scheduler's write path).
+
+Round-18 acceptance surface: staged task outputs are invisible until the
+coordinator's commit; the CRC-framed fsync'd journal replays idempotently
+from every byte prefix (torn tail included); duplicate attempts from
+forced hedging dedup first-success-wins; a crash injected at each write
+chaos point (WRITE_STAGE / WRITE_COMMIT / WRITE_PUBLISH) recovers to the
+sqlite-oracle row set with zero lost rows, zero duplicates, zero orphans;
+CTAS -> query round-trips bit-exact with zone-map pruning live and the
+result cache invalidated by the commit's catalog-version bump.
+"""
+
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from oracle import assert_rows_match, load_oracle, oracle_query
+from trino_tpu.batch import Field, Schema
+from trino_tpu.client.client import Client
+from trino_tpu.connectors.orcdir import OrcConnector, export_table, load_orc
+from trino_tpu.connectors.tpch.datagen import TableData
+from trino_tpu.exec.session import Session
+from trino_tpu.metrics import RESULT_CACHE_INVALIDATIONS
+from trino_tpu.server import writeprotocol as wp
+from trino_tpu.server.coordinator import CoordinatorServer
+from trino_tpu.server.failureinjector import (CORRUPT, CRASH, RAISE,
+                                              WRITE_COMMIT, WRITE_POINTS,
+                                              FailureInjector)
+from trino_tpu.server.worker import WorkerServer
+from trino_tpu.types import BIGINT
+from trino_tpu.utils.atomicio import atomic_write_bytes
+
+
+def _ints(name, n, seed=0):
+    rng = np.random.default_rng(seed)
+    return TableData(name, Schema((Field("a", BIGINT), Field("b", BIGINT))),
+                     [np.arange(n, dtype=np.int64),
+                      rng.integers(0, 100, n).astype(np.int64)])
+
+
+# ---------------------------------------------------------------------------
+# satellite: torn-file exposure in the file writers
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_crash_leaves_no_partial(tmp_path, monkeypatch):
+    target = str(tmp_path / "t.orc")
+    atomic_write_bytes(target, b"v1")
+    import trino_tpu.utils.atomicio as aio
+
+    def boom(src, dst):
+        raise OSError("injected crash before rename")
+    monkeypatch.setattr(aio.os, "replace", boom)
+    with pytest.raises(OSError):
+        atomic_write_bytes(target, b"v2-partial")
+    monkeypatch.undo()
+    # old content intact, no temp stray a directory scan could surface
+    with open(target, "rb") as f:
+        assert f.read() == b"v1"
+    assert os.listdir(tmp_path) == ["t.orc"]
+
+
+def test_write_orc_leaves_no_temp_files(tmp_path):
+    path = str(tmp_path / "x.orc")
+    export_table(_ints("x", 100), path)
+    assert sorted(os.listdir(tmp_path)) == ["x.orc"]
+    assert load_orc(path, "x").num_rows == 100
+
+
+# ---------------------------------------------------------------------------
+# satellite: directory scans skip write-protocol artifacts
+# ---------------------------------------------------------------------------
+
+def test_scan_skips_staging_and_journal_artifacts(tmp_path):
+    conn = OrcConnector(str(tmp_path))
+    conn.create_table("s1", "t", _ints("t", 50))
+    # plant every artifact class a crashed write could leave behind
+    td = tmp_path / "s1" / "t"
+    os.makedirs(td / ".staging", exist_ok=True)
+    (td / ".staging" / "deadbeef_1_0_t9.orc").write_bytes(b"orphan")
+    (td / ".commit_deadbeef.journal").write_bytes(b"torn")
+    (td / ".tmp.123.part").write_bytes(b"half")
+    (tmp_path / "s1" / ".hidden").mkdir()
+    (tmp_path / "s1" / "x.journal").write_bytes(b"junk")
+    assert conn.table_names("s1") == ["t"]
+    assert conn._load_table("s1", "t").num_rows == 50
+    # startup sweep removes the orphans without touching the table
+    conn2 = OrcConnector(str(tmp_path))
+    assert not (td / ".staging").exists()
+    assert not (td / ".commit_deadbeef.journal").exists()
+    assert not (td / ".tmp.123.part").exists()
+    assert conn2._load_table("s1", "t").num_rows == 50
+
+
+# ---------------------------------------------------------------------------
+# journal replay: every byte prefix is idempotent
+# ---------------------------------------------------------------------------
+
+def _journal_bytes(table_dir, manifests):
+    """The exact intent+commit frames wp.commit would journal, with the
+    file paths rebased into `table_dir`."""
+    tok = wp.qtoken("q1")
+    files = [{"src": os.path.join(table_dir, wp.STAGING_DIR,
+                                  os.path.basename(m["path"])),
+              "dst": os.path.join(table_dir, wp.part_filename(
+                  i, tok, m["rows"], "orc")),
+              "rows": m["rows"], "crc": m["crc"]}
+             for i, m in enumerate(manifests)]
+    return (wp._frame({"rec": "intent", "query": "q1", "files": files})
+            + wp._frame({"rec": "commit", "query": "q1"}))
+
+
+def test_journal_prefix_replay_idempotent(tmp_path):
+    import struct
+    tmpl = str(tmp_path / "tmpl")
+    manifests = (wp.stage_table_data(tmpl, _ints("t", 10, seed=1),
+                                     "q1", 1, 0, "t1", "orc"),
+                 wp.stage_table_data(tmpl, _ints("t", 20, seed=2),
+                                     "q1", 1, 1, "t2", "orc"))
+    # fixed-width work-dir names => identical journal length for every
+    # cut, so one intent_end offset applies to all of them
+    probe = _journal_bytes(str(tmp_path / "w0000"), manifests)
+    intent_end = 12 + struct.unpack_from("<I", probe, 8)[0]
+    jname = ".commit_%s.journal" % wp.qtoken("q1")
+    for cut in range(len(probe) + 1):
+        work = str(tmp_path / f"w{cut:04d}")
+        shutil.copytree(tmpl, work)
+        journal = _journal_bytes(work, manifests)
+        assert len(journal) == len(probe)
+        with open(os.path.join(work, jname), "wb") as f:
+            f.write(journal[:cut])
+        wp.recover_table_dir(work)
+        parts = wp.list_parts(work)
+        if cut >= intent_end:
+            # durable intent: rolled forward, both parts, exact rows
+            assert wp.published_rows_for(work, "q1") == 30, (cut, parts)
+            assert len(parts) == 2
+        else:
+            # torn/absent intent: rolled back, nothing published
+            assert parts == [], (cut, parts)
+        # no staging, no journal, no temp strays — ever
+        assert not os.path.isdir(wp.staging_dir(work))
+        assert [f for f in os.listdir(work)
+                if f.endswith(".journal") or f.startswith(".tmp.")] == []
+        before = sorted(os.listdir(work))
+        wp.recover_table_dir(work)           # replay is idempotent
+        assert sorted(os.listdir(work)) == before
+
+
+def test_commit_is_idempotent_per_query(tmp_path):
+    td = str(tmp_path / "t")
+    m = wp.stage_table_data(td, _ints("t", 25), "q7", 1, 0, "t1", "orc")
+    s1 = wp.commit(td, "q7", [m])
+    assert s1["rows"] == 25 and s1["published"] == 1
+    # whole-query retry: the same query id commits again -> recognized
+    # by the part-name token, not re-published
+    s2 = wp.commit(td, "q7", [])
+    assert s2["rows"] == 25 and s2["published"] == 0
+    assert len(wp.list_parts(td)) == 1
+
+
+def test_duplicate_attempt_dedup_first_success_wins(tmp_path):
+    td = str(tmp_path / "t")
+    m_win = wp.stage_table_data(td, _ints("t", 40, seed=3), "q9", 1, 0,
+                                "t1", "orc")
+    m_dup = wp.stage_table_data(td, _ints("t", 40, seed=3), "q9", 1, 0,
+                                "t2", "orc")
+    stats = wp.commit(td, "q9", [m_win, m_dup])
+    assert stats["deduped"] == 1 and stats["published"] == 1
+    assert stats["rows"] == 40
+    assert len(wp.list_parts(td)) == 1
+    assert not os.path.isdir(wp.staging_dir(td))   # loser swept too
+
+
+def test_abort_sweeps_staging_clean(tmp_path):
+    td = str(tmp_path / "t")
+    wp.stage_table_data(td, _ints("t", 15), "q5", 1, 0, "t1", "orc")
+    wp.stage_table_data(td, _ints("t", 15), "q5", 1, 1, "t2", "orc")
+    wp.abort(td, "q5")
+    assert wp.list_parts(td) == []
+    assert not os.path.isdir(wp.staging_dir(td))
+    assert wp.published_rows_for(td, "q5") is None
+
+
+# ---------------------------------------------------------------------------
+# cluster: distributed writes under chaos, vs the sqlite oracle
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def wcluster(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("orcw"))
+    os.makedirs(os.path.join(root, "out"))
+    session = Session(default_schema="tiny")
+    conn = OrcConnector(root)
+    session.catalog.register("orc", conn)
+    coord = CoordinatorServer(session, retry_policy="QUERY").start()
+    sched = coord.state.scheduler
+    sched.split_rows = 4096
+    workers = [WorkerServer(f"w-{i}", coord.uri, announce_interval_s=0.1,
+                            catalog=session.catalog).start()
+               for i in range(3)]
+    deadline = time.time() + 5
+    while len(coord.state.active_nodes()) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+    orders = session.catalog.connector("tpch").get_table("tiny", "orders")
+    oracle = load_oracle([orders])
+    yield coord, workers, session, conn, sched, oracle
+    for w in workers:
+        w.stop()
+    coord.stop()
+
+
+@pytest.fixture(autouse=True)
+def _wclean(request):
+    if "wcluster" not in request.fixturenames:
+        yield
+        return
+    coord, workers, _, _, sched, _ = request.getfixturevalue("wcluster")
+    sched.spool.clear()
+    yield
+    sched.failure_injector = None
+    sched.force_write_hedge = False
+    for w in workers:
+        w.task_manager.injector = None
+    deadline = time.time() + 5
+    while len(coord.state.active_nodes()) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+
+
+_SRC = ("SELECT o_orderkey, o_custkey, o_orderstatus, o_totalprice "
+        "FROM tpch.tiny.orders")
+
+
+def _assert_table_matches_oracle(session, oracle, table, times=1):
+    got = session.execute(
+        f"SELECT o_orderkey, o_custkey, o_orderstatus, o_totalprice "
+        f"FROM {table} ORDER BY o_orderkey").rows
+    want = oracle_query(
+        oracle, "SELECT o_orderkey, o_custkey, o_orderstatus, "
+                "o_totalprice FROM orders ORDER BY o_orderkey") * times
+    want.sort(key=lambda r: r[0])
+    assert_rows_match(got, want)
+
+
+def test_distributed_ctas_roundtrip_bit_exact(wcluster):
+    _, _, session, conn, sched, oracle = wcluster
+    res = sched.execute(f"CREATE TABLE orc.out.rt AS {_SRC}",
+                        query_id="q_rt")
+    assert res is not None, sched.fallback_reason
+    assert res.rows == [(15000,)]
+    wr = sched.last_query["write"]
+    assert wr["phase"] == "committed" and wr["rows"] == 15000
+    assert wr["partitions"] == 3 and wr["deduped"] == 0
+    _assert_table_matches_oracle(session, oracle, "orc.out.rt")
+    # zone-map pruning is live on the published parts: an impossible
+    # range prunes every stripe, a real predicate stays oracle-exact
+    pruned = conn.get_table_pruned("out", "rt", {"o_orderkey": (-10, -1)})
+    assert pruned.total_stripes > 0
+    assert pruned.skipped_stripes == pruned.total_stripes
+    got = session.execute(
+        "SELECT COUNT(*), SUM(o_totalprice) FROM orc.out.rt "
+        "WHERE o_orderkey <= 1000").rows
+    want = oracle_query(oracle, "SELECT COUNT(*), SUM(o_totalprice) "
+                                "FROM orders WHERE o_orderkey <= 1000")
+    assert_rows_match(got, want)
+
+
+def test_write_retry_same_query_id_is_exactly_once(wcluster):
+    _, _, session, _, sched, _ = wcluster
+    r1 = sched.execute(f"CREATE TABLE orc.out.once AS {_SRC}",
+                       query_id="q_once")
+    assert r1 is not None, sched.fallback_reason
+    r2 = sched.execute(f"CREATE TABLE orc.out.once AS {_SRC}",
+                       query_id="q_once")    # whole-query retry
+    assert r1.rows == r2.rows == [(15000,)]
+    assert session.execute(
+        "SELECT COUNT(*) FROM orc.out.once").rows == [(15000,)]
+
+
+def test_forced_hedge_duplicates_dedup(wcluster):
+    _, _, session, conn, sched, oracle = wcluster
+    sched.force_write_hedge = True
+    res = sched.execute(f"CREATE TABLE orc.out.hedge AS {_SRC}",
+                        query_id="q_hedge")
+    assert res is not None, sched.fallback_reason
+    assert res.rows == [(15000,)]
+    wr = sched.last_query["write"]
+    assert wr["deduped"] >= 1, wr       # both attempts staged, one wins
+    _assert_table_matches_oracle(session, oracle, "orc.out.hedge")
+    td = conn._table_dir("out", "hedge")
+    assert not os.path.isdir(wp.staging_dir(td))
+
+
+@pytest.mark.parametrize("fault", [RAISE, CRASH])
+@pytest.mark.parametrize("point", WRITE_POINTS)
+def test_crash_at_each_write_point_recovers_exactly_once(
+        wcluster, point, fault):
+    _, workers, session, conn, sched, oracle = wcluster
+    tbl = f"c_{point.lower()}_{fault.lower()}"
+    qid = f"q_{tbl}"
+    inj = FailureInjector()
+    inj.inject(point, times=1, fault=fault)
+    sched.failure_injector = inj
+    for w in workers:
+        w.task_manager.injector = inj
+    sql = f"CREATE TABLE orc.out.{tbl} AS {_SRC}"
+    try:
+        res = sched.execute(sql, query_id=qid)
+    except Exception:
+        # pre-intent failure aborted the query: the QUERY retry policy
+        # reruns it under the same id — the rerun must be exactly-once
+        res = sched.execute(sql, query_id=qid)
+    assert res is not None, sched.fallback_reason
+    assert res.rows == [(15000,)]
+    assert inj.injected_count == 1, (point, fault)
+    # oracle row-set equality: zero lost, zero duplicate rows
+    _assert_table_matches_oracle(session, oracle, f"orc.out.{tbl}")
+    td = conn._table_dir("out", tbl)
+    assert not os.path.isdir(wp.staging_dir(td))       # zero orphans
+    assert [f for f in os.listdir(td) if f.endswith(".journal")] == []
+
+
+def test_torn_intent_journal_rolls_back_then_recovers(wcluster):
+    """CORRUPT at WRITE_COMMIT models a torn intent append: half the
+    frame hits disk, then the coordinator dies. Replay must treat the
+    torn record as absent (roll back), and the rerun commits cleanly."""
+    _, _, session, conn, sched, oracle = wcluster
+    inj = FailureInjector()
+    inj.inject(WRITE_COMMIT, times=1, fault=CORRUPT)
+    sched.failure_injector = inj
+    sql = f"CREATE TABLE orc.out.torn AS {_SRC}"
+    with pytest.raises(Exception):
+        sched.execute(sql, query_id="q_torn")
+    assert inj.injected_count == 1
+    sched.failure_injector = None
+    res = sched.execute(sql, query_id="q_torn")
+    assert res is not None, sched.fallback_reason
+    assert res.rows == [(15000,)]
+    _assert_table_matches_oracle(session, oracle, "orc.out.torn")
+    td = conn._table_dir("out", "torn")
+    assert [f for f in os.listdir(td) if f.endswith(".journal")] == []
+
+
+def test_distributed_insert_appends_exactly_once(wcluster):
+    _, _, session, _, sched, oracle = wcluster
+    r0 = sched.execute(f"CREATE TABLE orc.out.app AS {_SRC}",
+                       query_id="q_a1")
+    assert r0 is not None, sched.fallback_reason
+    res = sched.execute(f"INSERT INTO orc.out.app {_SRC}",
+                        query_id="q_a2")
+    assert res is not None, sched.fallback_reason
+    assert res.rows == [(15000,)]
+    # the same INSERT retried under its query id must not double-append
+    res2 = sched.execute(f"INSERT INTO orc.out.app {_SRC}",
+                         query_id="q_a2")
+    assert res2.rows == [(15000,)]
+    _assert_table_matches_oracle(session, oracle, "orc.out.app", times=2)
+
+
+def test_commit_invalidates_result_cache(wcluster):
+    coord, _, session, _, sched, _ = wcluster
+    client = Client(coord.uri, user="fte", poll_interval_s=0.005)
+    client.execute("CREATE TABLE memory.s.wrc (k bigint)")
+    client.execute("INSERT INTO memory.s.wrc VALUES (1), (2)")
+    client.execute("SET SESSION enable_result_cache = true")
+    sql = "SELECT count(*) FROM memory.s.wrc"
+    assert client.execute(sql).rows == [[2]]
+    assert client.execute(sql).rows == [[2]]       # cached page
+    v0 = session.catalog.version
+    i0 = RESULT_CACHE_INVALIDATIONS.value()
+    res = sched.execute(
+        "CREATE TABLE orc.out.vbump AS SELECT o_orderkey "
+        "FROM tpch.tiny.orders", query_id="q_vb")
+    assert res is not None, sched.fallback_reason
+    assert session.catalog.version > v0
+    # the stale page is version-mismatched now: dropped and re-executed
+    assert client.execute(sql).rows == [[2]]
+    assert RESULT_CACHE_INVALIDATIONS.value() > i0
+
+
+def test_query_info_reports_write_stats(wcluster):
+    coord, _, _, _, _, _ = wcluster
+    client = Client(coord.uri, user="fte", poll_interval_s=0.005)
+    r = client.execute("CREATE TABLE orc.out.qinfo AS SELECT o_orderkey, "
+                       "o_custkey FROM tpch.tiny.orders")
+    info = client.query_info(r.query_id)
+    assert info["writtenRows"] == 15000
+    assert info["writtenBytes"] > 0
+    assert info["commitPhase"] == "committed"
+
+
+def test_explain_analyze_write_renders_commit_plan(wcluster):
+    _, _, _, _, sched, _ = wcluster
+    res = sched.execute(
+        "EXPLAIN ANALYZE CREATE TABLE orc.out.exp AS SELECT o_orderkey, "
+        "o_custkey FROM tpch.tiny.orders", query_id="q_exp")
+    assert res is not None, sched.fallback_reason
+    text = "\n".join(r[0] for r in res.rows)
+    assert "TableCommit[orc.out.exp]" in text
+    assert "TableWriter[orc.out.exp]" in text
+    assert "write: " in text and "staged" in text and "deduped" in text

@@ -18,6 +18,7 @@ import numpy as np
 from ..batch import Field, Schema
 from ..formats.orc import read_orc_file
 from ..types import BIGINT, BOOLEAN, DOUBLE, TypeKind, VARCHAR
+from .dirtable import StagedWriteMixin
 from .tpch.datagen import TableData
 
 
@@ -77,12 +78,22 @@ def load_orc(path: str, name: str,
     return data
 
 
-class OrcConnector:
+class OrcConnector(StagedWriteMixin):
     name = "orc"
+    ext = "orc"
+    fmt = "orc"
 
     def __init__(self, root: str):
         self.root = root
         self._cache: Dict[Tuple[str, str], TableData] = {}
+        # unclean-shutdown recovery: roll forward / sweep any staged
+        # write state before the first scan can observe it
+        self.sweep_on_startup()
+
+    @staticmethod
+    def _load(path: str, name: str,
+              predicates: Optional[dict] = None) -> TableData:
+        return load_orc(path, name, predicates)
 
     def _schema_dir(self, schema: str) -> str:
         return os.path.join(self.root, schema)
@@ -91,23 +102,16 @@ class OrcConnector:
         if not os.path.isdir(self.root):
             return []
         return sorted(d for d in os.listdir(self.root)
-                      if os.path.isdir(os.path.join(self.root, d)))
+                      if os.path.isdir(os.path.join(self.root, d))
+                      and not d.startswith("."))
 
     def table_names(self, schema: str):
-        d = self._schema_dir(schema)
-        if not os.path.isdir(d):
-            return []
-        return sorted(f[:-4] for f in os.listdir(d)
-                      if f.endswith(".orc"))
+        return self._list_tables(schema)
 
     def get_table(self, schema: str, table: str) -> TableData:
         key = (schema, table)
         if key not in self._cache:
-            path = os.path.join(self._schema_dir(schema), f"{table}.orc")
-            if not os.path.isfile(path):
-                raise KeyError(f"orc table {schema}.{table} not found "
-                               f"({path})")
-            self._cache[key] = load_orc(path, table)
+            self._cache[key] = self._load_table(schema, table)
         return self._cache[key]
 
     def get_table_schema(self, schema: str, table: str) -> Schema:
@@ -119,11 +123,7 @@ class OrcConnector:
         match `ranges` are never decompressed or decoded. The result is
         NOT cached as the table (its row set is predicate-specific);
         callers own caching under a predicate-aware key."""
-        path = os.path.join(self._schema_dir(schema), f"{table}.orc")
-        if not os.path.isfile(path):
-            raise KeyError(f"orc table {schema}.{table} not found "
-                           f"({path})")
-        return load_orc(path, table, predicates=ranges)
+        return self._load_table(schema, table, predicates=ranges)
 
 
 def export_table(data: TableData, path: str,

@@ -24,6 +24,7 @@ import numpy as np
 from ..batch import Field, Schema
 from ..formats.parquet import read_parquet_file, write_parquet
 from ..types import BIGINT, BOOLEAN, DOUBLE, INTEGER, TypeKind, VARCHAR
+from .dirtable import StagedWriteMixin
 from .tpch.datagen import TableData
 
 
@@ -151,12 +152,22 @@ def export_table(data: TableData, path: str) -> None:
     write_parquet(path, *flatten_table(data, "parquet"))
 
 
-class ParquetConnector:
+class ParquetConnector(StagedWriteMixin):
     name = "parquet"
+    ext = "parquet"
+    fmt = "parquet"
 
     def __init__(self, root: str):
         self.root = root
         self._cache: Dict[Tuple[str, str], TableData] = {}
+        # unclean-shutdown recovery: roll forward / sweep any staged
+        # write state before the first scan can observe it
+        self.sweep_on_startup()
+
+    @staticmethod
+    def _load(path: str, name: str,
+              predicates: Optional[dict] = None) -> TableData:
+        return load_parquet(path, name, predicates)
 
     def _schema_dir(self, schema: str) -> str:
         return os.path.join(self.root, schema)
@@ -165,24 +176,16 @@ class ParquetConnector:
         if not os.path.isdir(self.root):
             return []
         return sorted(d for d in os.listdir(self.root)
-                      if os.path.isdir(os.path.join(self.root, d)))
+                      if os.path.isdir(os.path.join(self.root, d))
+                      and not d.startswith("."))
 
     def table_names(self, schema: str):
-        d = self._schema_dir(schema)
-        if not os.path.isdir(d):
-            return []
-        return sorted(f[:-8] for f in os.listdir(d)
-                      if f.endswith(".parquet"))
+        return self._list_tables(schema)
 
     def get_table(self, schema: str, table: str) -> TableData:
         key = (schema, table)
         if key not in self._cache:
-            path = os.path.join(self._schema_dir(schema),
-                                f"{table}.parquet")
-            if not os.path.isfile(path):
-                raise KeyError(f"parquet table {schema}.{table} not found "
-                               f"({path})")
-            self._cache[key] = load_parquet(path, table)
+            self._cache[key] = self._load_table(schema, table)
         return self._cache[key]
 
     def get_table_schema(self, schema: str, table: str) -> Schema:
@@ -195,8 +198,4 @@ class ParquetConnector:
         result is NOT cached as the table (its row set is
         predicate-specific); callers own caching under a
         predicate-aware key."""
-        path = os.path.join(self._schema_dir(schema), f"{table}.parquet")
-        if not os.path.isfile(path):
-            raise KeyError(f"parquet table {schema}.{table} not found "
-                           f"({path})")
-        return load_parquet(path, table, predicates=ranges)
+        return self._load_table(schema, table, predicates=ranges)

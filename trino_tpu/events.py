@@ -49,6 +49,10 @@ class QueryCompletedEvent:
     spills: int = 0               # spill-tier activations (history +
                                   # regression-detector input)
     tenant: str = "default"       # resource-group tenant (audit label)
+    # exactly-once write rollup (zero/empty for read queries)
+    written_rows: int = 0
+    written_bytes: int = 0
+    commit_phase: str = ""        # "committed" | "aborted" | ""
 
 
 class EventListener:
@@ -103,5 +107,8 @@ class EventListenerManager:
             faults_survived=int(st.get("faults_survived", 0)),
             hedges_fired=int(st.get("hedged_tasks", 0)),
             spills=int(getattr(tq, "spills", 0)),
-            tenant=getattr(tq, "tenant", "default"))
+            tenant=getattr(tq, "tenant", "default"),
+            written_rows=int((st.get("write") or {}).get("rows", 0)),
+            written_bytes=int((st.get("write") or {}).get("bytes", 0)),
+            commit_phase=(st.get("write") or {}).get("phase", ""))
         self._dispatch("query_completed", ev)

@@ -139,6 +139,8 @@ SESSION_PROPERTY_DEFAULTS = {
     # distributed runtime knobs (execution/scheduler tier)
     "split_rows": (250_000, int),
     "task_retries": (2, int),
+    # distributed write fan-out (0 = one write task per active worker)
+    "write_partitions": (0, int),
     # straggler hedging: a task past max(hedge_min_s, hedge_multiplier *
     # median drain time of its round) is speculatively re-dispatched to
     # a survivor; first success wins. multiplier <= 0 disables.
@@ -301,7 +303,18 @@ class Session:
 
     def execute_explain(self, stmt: A.Explain, t0) -> QueryResult:
         planner = self.planner()
-        rel = planner.plan_query(stmt.query)
+        # EXPLAIN over a write statement plans its source query and
+        # renders it under TableCommit/TableWriter wrapper nodes (the
+        # reference's TableFinishNode over TableWriterNode)
+        wstmt = None
+        query = stmt.query
+        if isinstance(query, (A.InsertInto, A.CreateTable)):
+            if getattr(query, "query", None) is None:
+                raise ValueError("EXPLAIN of CREATE TABLE without AS "
+                                 "SELECT is not supported")
+            wstmt = query
+            query = query.query
+        rel = planner.plan_query(query)
         root = prune_plan(rel.node)
 
         def estimate(node) -> str:
@@ -324,6 +337,21 @@ class Session:
         # predictions below read executor knobs that must reflect
         # SET SESSION (zone_map_rows, enable_multiway_join, ...)
         self._apply_executor_properties(t0)
+        if stmt.analyze and wstmt is not None:
+            # ANALYZE of a write really writes (local staged path); the
+            # plan stays estimate-annotated — the single commit is the
+            # interesting line, not per-operator device times
+            wres = self.execute_ddl(wstmt, t0)
+            written = wres.rows[0][0] if wres.rows else 0
+            text = explain_text(root, annotate=annotate)
+            cat, sch, tbl = self.resolve_table(wstmt.table)
+            rows = [(f"TableCommit[{cat}.{sch}.{tbl}]",),
+                    (f"  TableWriter[{cat}.{sch}.{tbl}]",)]
+            rows += [(f"    {line}",) for line in text.split("\n")]
+            rows.append((f"write: 1 partitions, 1 staged, 0 deduped, "
+                         f"{written} rows",))
+            return QueryResult(["query plan"], rows,
+                               time.monotonic() - t0)
         if stmt.analyze:
             saved = self.executor.profile
             self.executor.profile = True
@@ -349,6 +377,11 @@ class Session:
                 return f"[{s[0] * 1000:.2f}ms, {s[1]} rows] {est}"
         text = explain_text(root, annotate=annotate)
         rows = [(line,) for line in text.split("\n")]
+        if wstmt is not None:
+            cat, sch, tbl = self.resolve_table(wstmt.table)
+            rows = [(f"TableCommit[{cat}.{sch}.{tbl}]",),
+                    (f"  TableWriter[{cat}.{sch}.{tbl}]",)] + \
+                [(f"    {r[0]}",) for r in rows]
         # per-operator strategy verdicts (the aggregation/join gate's
         # choice; after ANALYZE the executed strategy is authoritative)
         try:

@@ -893,8 +893,5 @@ def write_orc(path: str, names, columns, valids=None, logicals=None,
     })
     body += ps
     body.append(len(ps))
-    tmp = f"{path}.tmp"
-    with open(tmp, "wb") as f:
-        f.write(bytes(body))
-    import os
-    os.replace(tmp, path)
+    from trino_tpu.utils.atomicio import atomic_write_bytes
+    atomic_write_bytes(path, bytes(body))

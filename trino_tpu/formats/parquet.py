@@ -567,8 +567,8 @@ def write_parquet(path: str, names: List[str], arrays: List[np.ndarray],
     body += footer
     body += struct.pack("<I", len(footer))
     body += MAGIC
-    with open(path, "wb") as f:
-        f.write(bytes(body))
+    from trino_tpu.utils.atomicio import atomic_write_bytes
+    atomic_write_bytes(path, bytes(body))
 
 
 # --------------------------------------------------------------------------

@@ -489,6 +489,24 @@ HISTORY_RECORDS = REGISTRY.counter(
     "trino_tpu_query_history_records_total",
     "Completed-query records appended to the query history store")
 
+# exactly-once distributed writes (server/writeprotocol.py): staged
+# attempt files, manifest dedup, journal commit, orphan sweeps
+WRITE_TASKS = REGISTRY.counter(
+    "trino_tpu_write_tasks_total",
+    "Staged write attempts produced (one per attempt file written to a "
+    "table's .staging directory)")
+WRITE_ATTEMPTS_DEDUPED = REGISTRY.counter(
+    "trino_tpu_write_attempts_deduped_total",
+    "Duplicate write attempts dropped by (stage, partition) "
+    "first-success-wins manifest dedup at commit")
+WRITE_COMMITS = REGISTRY.counter(
+    "trino_tpu_write_commits_total",
+    "Write commit-protocol outcomes", ("outcome",))
+WRITE_ORPHANS_SWEPT = REGISTRY.counter(
+    "trino_tpu_write_orphans_swept_total",
+    "Orphaned staging files / journals removed by abort and "
+    "startup-recovery sweeps")
+
 # the labeled families acceptance scrapes: seed the hot label values so
 # a cold server's /v1/metrics already carries them at 0
 for _op in ("scan", "output"):
@@ -518,3 +536,5 @@ for _m in ("broadcast", "partitioned"):
 for _ls in ("ACTIVE", "DRAINING", "DRAINED", "LEFT", "FAILED"):
     NODE_LIFECYCLE_TRANSITIONS.init_labels(state=_ls)
 TENANT_QUERIES.init_labels(tenant="default")
+for _o in ("committed", "aborted"):
+    WRITE_COMMITS.init_labels(outcome=_o)

@@ -240,9 +240,47 @@ class OutputNode(PlanNode):
     output: Tuple
 
 
+@dataclass(frozen=True)
+class TableWriterNode(PlanNode):
+    """Partitioned write stage root (sql/planner/plan/TableWriterNode.java):
+    the subtree's rows are staged to a uniquely-named attempt file under
+    the target table's `.staging/` directory — never published by the
+    worker. `fields` carries the concrete output Fields (dictionaries
+    included) so a write task can rebuild TableData from exchange pages;
+    `attempt` makes every task attempt's staging file unique."""
+    child: PlanNode
+    catalog: str
+    schema_name: str
+    table: str
+    table_dir: str
+    fmt: str                          # "orc" | "parquet"
+    query_id: str
+    stage: int
+    partition: int
+    attempt: str
+    fields: Tuple                     # Tuple[Field, ...]
+    output: Tuple                     # (("rows", BIGINT),)
+
+
+@dataclass(frozen=True)
+class TableCommitNode(PlanNode):
+    """Coordinator-side commit root (TableFinishNode.java's role): dedups
+    staged-file manifests by (stage, partition) first-success-wins, writes
+    the CRC-framed commit journal, publishes by atomic rename, bumps the
+    catalog version. Executes on the coordinator only — the scheduler
+    interprets it; the executor never sees it."""
+    child: PlanNode
+    catalog: str
+    schema_name: str
+    table: str
+    query_id: str
+    output: Tuple
+
+
 def children(node: PlanNode):
     if isinstance(node, (FilterNode, ProjectNode, AggregateNode, SortNode,
-                         LimitNode, OutputNode, WindowNode, UnnestNode)):
+                         LimitNode, OutputNode, WindowNode, UnnestNode,
+                         TableWriterNode, TableCommitNode)):
         return (node.child,)
     if isinstance(node, (JoinNode, SetOpNode)):
         return (node.left, node.right)
@@ -474,6 +512,12 @@ def explain_text(node: PlanNode, indent: int = 0, annotate=None) -> str:
         line = f"{pad}RemoteSource[fragment {node.fragment_id}]"
     elif isinstance(node, OutputNode):
         line = f"{pad}Output[{', '.join(node.names)}]"
+    elif isinstance(node, TableWriterNode):
+        line = (f"{pad}TableWriter[{node.catalog}.{node.schema_name}."
+                f"{node.table}, {node.fmt}, partition {node.partition}]")
+    elif isinstance(node, TableCommitNode):
+        line = (f"{pad}TableCommit[{node.catalog}.{node.schema_name}."
+                f"{node.table}]")
     else:
         line = f"{pad}{type(node).__name__}"
     if annotate is not None:

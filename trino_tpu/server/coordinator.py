@@ -768,7 +768,11 @@ class _Handler(BaseHTTPRequestHandler):
                 "taskRetries": st.get("task_retries", 0),
                 "hedgedTasks": st.get("hedged_tasks", 0),
                 "hedgeWins": st.get("hedge_wins", 0),
-                "faultsSurvived": st.get("faults_survived", 0)}})
+                "faultsSurvived": st.get("faults_survived", 0)},
+            # exactly-once write rollup (empty for reads)
+            "writtenRows": (st.get("write") or {}).get("rows", 0),
+            "writtenBytes": (st.get("write") or {}).get("bytes", 0),
+            "commitPhase": (st.get("write") or {}).get("phase", "")})
 
     def _get_query_trace(self, parts, user):
         """Stitched query trace (coordinator + adopted worker spans) as

@@ -45,10 +45,19 @@ SPOOL_WRITE = "SPOOL_WRITE"            # durable exchange put()
 HEARTBEAT_PING = "HEARTBEAT_PING"      # failure detector /v1/status probe
 SCAN_PREFETCH = "SCAN_PREFETCH"        # chunked-driver prefetch worker,
                                        # per staged chunk (exec/chunked.py)
+WRITE_STAGE = "WRITE_STAGE"            # write task staging an attempt file
+WRITE_COMMIT = "WRITE_COMMIT"          # coordinator journaling the commit
+WRITE_PUBLISH = "WRITE_PUBLISH"        # per-file atomic rename publish
 
 POINTS = (DISPATCH, EXECUTION, STAGE_BOUNDARY, WORKER_TASK_CREATE,
           WORKER_TASK_RUN, EXCHANGE_DRAIN, SPOOL_READ, SPOOL_WRITE,
-          HEARTBEAT_PING, SCAN_PREFETCH)
+          HEARTBEAT_PING, SCAN_PREFETCH, WRITE_STAGE, WRITE_COMMIT,
+          WRITE_PUBLISH)
+
+# The write-protocol boundaries, for `bench.py --write-chaos` and targeted
+# soaks (kept out of the from_seed default so the round-7 chaos series
+# keeps its historical schedule).
+WRITE_POINTS = (WRITE_STAGE, WRITE_COMMIT, WRITE_PUBLISH)
 
 # Fault types.
 RAISE = "RAISE"

@@ -465,7 +465,9 @@ class StageScheduler:
         self._begin_query(query_id)
         try:
             if isinstance(stmt, A.Explain) and stmt.analyze and \
-                    isinstance(stmt.query, A.Query):
+                    (isinstance(stmt.query, A.Query) or
+                     isinstance(stmt.query, (A.InsertInto, A.CreateTable))
+                     and getattr(stmt.query, "query", None) is not None):
                 return self._execute_explain_analyze(stmt, sql)
             return self._execute_stmt(stmt, sql)
         finally:
@@ -482,6 +484,9 @@ class StageScheduler:
         if not workers:
             self.fallback_reason = "no active workers"
             return None
+        if isinstance(stmt, (A.InsertInto, A.CreateTable)):
+            with tracer.span("distributed-write"):
+                return self._execute_write(stmt, sql, t0, workers)
         with tracer.span("plan-distributed"):
             planned = self._plan_stmt(stmt)
         if planned is None:
@@ -554,6 +559,279 @@ class StageScheduler:
         self.stats["queries"] += 1
         return result
 
+    def _execute_write(self, stmt, sql: str, t0: float, workers):
+        """Distributed INSERT / CTAS with exactly-once commit (the FTE
+        write path: TableWriterOperator staging + TableFinishOperator
+        commit under task retries). Source tasks run the inner query
+        split-streamed with hash-partitioned output; P write tasks each
+        pull one partition through the CRC-framed exchange and stage an
+        attempt file, reporting a manifest in terminal status; the
+        coordinator dedups manifests first-success-wins, journals the
+        commit, publishes by rename, and bumps the catalog version.
+        Returns None (local staged fallback) only before any task has
+        side effects."""
+        import os as _os
+        import uuid as _uuid
+        from ..batch import Field
+        from ..exec import zonemap
+        from ..exec.session import QueryResult
+        from ..metrics import WRITE_ATTEMPTS_DEDUPED
+        from ..types import BIGINT
+        from . import writeprotocol as wp
+        sess = self.session
+        inner = getattr(stmt, "query", None)
+        if inner is None or not isinstance(inner, A.Query):
+            self.fallback_reason = "coordinator-only statement"
+            return None
+        cat, sch, tbl = sess.resolve_table(stmt.table)
+        try:
+            conn = sess.catalog.connector(cat)
+        except Exception:
+            self.fallback_reason = f"unknown catalog {cat}"
+            return None
+        if not getattr(conn, "supports_staged_writes", False):
+            self.fallback_reason = (f"connector {cat} has no staged "
+                                    f"write support")
+            return None
+        is_ctas = isinstance(stmt, A.CreateTable)
+        qid = (self.last_query or {}).get("query_id") or \
+            f"adhoc_{_uuid.uuid4().hex[:10]}"
+        table_dir = _os.path.abspath(conn._table_dir(sch, tbl))
+
+        def _finish_commit(stats, partitions, staged):
+            conn._cache.pop((sch, tbl), None)
+            sess.catalog.bump_version()
+            sess.executor.invalidate_scan_cache()
+            try:
+                zonemap.note_table(conn.get_table(sch, tbl))
+            except Exception:   # noqa: BLE001 — registration best-effort
+                pass
+            with self._lock:
+                lq = self.last_query
+                if lq is not None:
+                    lq["write"] = {
+                        "partitions": partitions, "staged": staged,
+                        "deduped": stats.get("deduped", 0),
+                        "rows": stats["rows"],
+                        "bytes": stats.get("bytes", 0),
+                        "phase": stats.get("phase", "committed")}
+            return QueryResult(["rows"], [(stats["rows"],)],
+                               time.monotonic() - t0)
+
+        # a prior attempt of this very query already committed: the
+        # protocol's idempotence — return its result, never re-stage
+        already = wp.published_rows_for(table_dir, qid)
+        if already is not None:
+            wp.recover_table_dir(table_dir)
+            return _finish_commit({"rows": already, "phase": "committed"},
+                                  0, 0)
+        wp.recover_table_dir(table_dir)
+        if is_ctas and conn.table_exists(sch, tbl):
+            self.fallback_reason = "CTAS target exists (local path " \
+                                   "resolves IF NOT EXISTS / errors)"
+            return None
+        if not is_ctas and not conn.table_exists(sch, tbl):
+            self.fallback_reason = "insert target missing (local path " \
+                                   "raises the canonical error)"
+            return None
+        planned = self._plan_stmt(inner)
+        if planned is None:
+            return None
+        rel, root = planned
+        analysis = analyze(root, sess.catalog, self.split_rows)
+        if analysis is None or analysis.merge_agg is not None or \
+                analysis.merge_sort is not None:
+            self.fallback_reason = ("write source not split-streamable "
+                                    "in concat mode")
+            return None
+        out_fields = []
+        for name, sc in zip(root.names, rel.scope.columns):
+            fld = sc.field if sc.field is not None else Field(name,
+                                                              sc.dtype)
+            out_fields.append(Field(name, sc.dtype,
+                                    dictionary=fld.dictionary))
+        if not is_ctas:
+            target = conn.get_table_schema(sch, tbl)
+            if len(target) != len(out_fields) or any(
+                    tf.dtype.kind is not of.dtype.kind
+                    for tf, of in zip(target, out_fields)):
+                self.fallback_reason = ("insert column mismatch (local "
+                                        "path raises)")
+                return None
+            out_fields = [Field(tf.name, of.dtype,
+                                dictionary=of.dictionary)
+                          for tf, of in zip(target, out_fields)]
+
+        props = getattr(sess, "properties", {})
+        P = int(props.get("write_partitions") or 0) or len(workers)
+        src_root = root.child
+        keys = [i for i, (_, dt) in enumerate(src_root.output)
+                if np.issubdtype(dt.np_dtype, np.integer)][:1]
+        if not keys:
+            # no hashable column: everything lands in partition 0, so a
+            # single write partition avoids empty-part churn
+            P = 1
+        t_deadline = time.time() + self.task_timeout_s
+        traceparent = self._tracer().traceparent()
+        splits = self._make_splits(analysis)
+        blob = encode_fragment({"root": src_root,
+                                "driver": analysis.driver})
+        src_tasks = []
+        live: Dict[int, list] = {}
+        _os.makedirs(table_dir, exist_ok=True)
+        created_dir = is_ctas
+        try:
+            for wi, w in enumerate(workers):
+                sp = [s for i, s in enumerate(splits)
+                      if i % len(workers) == wi]
+                if not sp:
+                    continue
+                with self._lock:
+                    self._seq += 1
+                    tid = f"t{self._seq}"
+                task = RemoteTask(w, tid, blob, sp,
+                                  partition={"keys": keys, "count": P},
+                                  injector=self.failure_injector,
+                                  traceparent=traceparent)
+                task.start()
+                self.stats["tasks"] += 1
+                SCHED_TASKS.inc()
+                src_tasks.append(task)
+
+            def launch_writer(p: int, attempt_no: int, exclude=()):
+                w = next((n for n in self.state.active_nodes()
+                          if n.node_id not in exclude),
+                         None) or workers[(p + attempt_no) % len(workers)]
+                with self._lock:
+                    self._seq += 1
+                    tid = f"t{self._seq}"
+                node = L.TableWriterNode(
+                    child=L.RemoteSourceNode(1, src_root.output),
+                    catalog=cat, schema_name=sch, table=tbl,
+                    table_dir=table_dir, fmt=conn.fmt, query_id=qid,
+                    stage=1, partition=p, attempt=tid,
+                    fields=tuple(out_fields), output=(("rows", BIGINT),))
+                wblob = encode_fragment({"root": node,
+                                         "timeout_s":
+                                             self.task_timeout_s})
+                sources = {"1": [{"uri": t.node.uri, "taskId": t.task_id,
+                                  "buffer": p} for t in src_tasks]}
+                task = RemoteTask(w, tid, wblob, [], sources=sources,
+                                  injector=self.failure_injector,
+                                  traceparent=traceparent)
+                task.start()
+                self.stats["tasks"] += 1
+                SCHED_TASKS.inc()
+                return task
+
+            attempts: Dict[int, int] = {}
+            for p in range(P):
+                live[p] = [launch_writer(p, 0)]
+                attempts[p] = 1
+                if getattr(self, "force_write_hedge", False):
+                    # duplicate-attempt injection: both stage; commit's
+                    # (stage, partition) dedup must drop one
+                    live[p].append(launch_writer(p, 1))
+                    attempts[p] += 1
+                    self.stats["hedged_tasks"] = \
+                        self.stats.get("hedged_tasks", 0) + 1
+            manifests: List[dict] = []
+            collected: Set[str] = set()
+            done: Set[int] = set()
+            max_attempts = 4
+            while len(done) < P:
+                if time.time() > t_deadline:
+                    raise TaskFailedError("write stage timed out")
+                for p in range(P):
+                    if p in done:
+                        continue
+                    failed_nodes = []
+                    all_failed = bool(live[p])
+                    for t in list(live[p]):
+                        try:
+                            st = t._request(t._url())
+                        except Exception:
+                            st = {"state": "FAILED", "error": "status "
+                                  "fetch failed (node dead?)"}
+                        state = st.get("state")
+                        if state == "FINISHED":
+                            m = (st.get("stats") or {}).get("manifest")
+                            if m is not None:
+                                manifests.append(m)
+                                collected.add(t.task_id)
+                                done.add(p)
+                                self._record_task(t)
+                                all_failed = False
+                                break
+                            state = "FAILED"
+                        if state in ("FAILED", "CANCELED"):
+                            live[p].remove(t)
+                            failed_nodes.append(t.node.node_id)
+                            self.stats["task_retries"] += 1
+                            SCHED_TASK_RETRIES.inc()
+                        else:
+                            all_failed = False
+                    if p in done or not all_failed:
+                        continue
+                    if attempts[p] >= max_attempts:
+                        raise TaskFailedError(
+                            f"write partition {p} exhausted "
+                            f"{max_attempts} attempts")
+                    live[p].append(launch_writer(p, attempts[p],
+                                                 exclude=failed_nodes))
+                    attempts[p] += 1
+                time.sleep(0.02)
+            # duplicate attempts that also finished report their
+            # manifests too — commit's (stage, partition) dedup drops
+            # them; still-running stragglers are cancelled (their staged
+            # files, if any, fall to the post-commit sweep)
+            for p in range(P):
+                for t in live[p]:
+                    if t.task_id in collected:
+                        continue
+                    try:
+                        st = t._request(t._url())
+                        m = (st.get("stats") or {}).get("manifest") \
+                            if st.get("state") == "FINISHED" else None
+                    except Exception:  # noqa: BLE001
+                        m = None
+                    if m is not None:
+                        manifests.append(m)
+                        collected.add(t.task_id)
+                        continue
+                    try:
+                        t.cancel()
+                    except Exception:  # noqa: BLE001
+                        pass
+            for t in src_tasks:
+                t.wait_finished(t_deadline)
+                self._record_task(t)
+            stats = wp.commit(table_dir, qid, manifests,
+                              injector=self.failure_injector)
+            WRITE_ATTEMPTS_DEDUPED.inc(stats.get("deduped", 0))
+            self.stats["stages"] = self.stats.get("stages", 0) + 2
+            self.stats["queries"] += 1
+            return _finish_commit(stats, P, len(manifests))
+        except BaseException:
+            for t in src_tasks + [t for ts in live.values() for t in ts]:
+                try:
+                    t.cancel()
+                except Exception:  # noqa: BLE001
+                    pass
+            wp.abort(table_dir, qid)
+            committed = wp.published_rows_for(table_dir, qid)
+            if committed is not None:
+                # the INTENT was durable: abort rolled the commit
+                # FORWARD — report success, a re-run would double-write
+                return _finish_commit(
+                    {"rows": committed, "phase": "committed"}, P, 0)
+            if created_dir:
+                try:
+                    _os.rmdir(table_dir)
+                except OSError:
+                    pass
+            raise
+
     def _execute_explain_analyze(self, stmt, sql: str):
         """EXPLAIN ANALYZE over the cluster: run the inner query
         distributed (with worker-side per-operator profiling forced),
@@ -572,8 +850,17 @@ class StageScheduler:
             return None      # not eligible: local EXPLAIN ANALYZE runs
         self._finalize_rollup()
         lq = self.last_query
-        rel = self.session.planner().plan_query(stmt.query)
+        inner = stmt.query
+        wstmt = None
+        if isinstance(inner, (A.InsertInto, A.CreateTable)):
+            wstmt, inner = inner, inner.query
+        rel = self.session.planner().plan_query(inner)
         lines = explain_text(prune_plan(rel.node)).split("\n")
+        if wstmt is not None:
+            cat, sch, tbl = self.session.resolve_table(wstmt.table)
+            lines = [f"TableCommit[{cat}.{sch}.{tbl}]",
+                     f"  TableWriter[{cat}.{sch}.{tbl}]"] + \
+                [f"    {ln}" for ln in lines]
         stages: Dict[str, list] = {}
         for t in lq["tasks"]:
             s = stages.setdefault(t["stage"], [0, 0, 0, 0.0])
@@ -588,6 +875,11 @@ class StageScheduler:
                       f"{lq['hedged_tasks']} hedged",
                   f"scan: {lq.get('splits_total', 0)} splits, "
                   f"{lq.get('splits_pruned', 0)} pruned by zone maps"]
+        wr = lq.get("write")
+        if wr is not None:
+            lines.append(f"write: {wr['partitions']} partitions, "
+                         f"{wr['staged']} staged, "
+                         f"{wr['deduped']} deduped, {wr['rows']} rows")
         for name in sorted(stages):
             n, splits, rows, wall = stages[name]
             lines.append(f"Stage {name}: tasks={n}, splits={splits}, "

@@ -224,6 +224,9 @@ class WorkerTask:
     # slow consumer (OutputBuffer's maxBufferedBytes + isFull blocking)
     buffered_bytes: int = 0
     backpressure_waits: int = 0
+    # staged-file manifest for write tasks (rides terminal status stats;
+    # publication is the coordinator's commit, never this worker's)
+    manifest: Optional[dict] = None
 
     def __post_init__(self):
         # producer/consumer rendezvous sharing the task lock: _emit
@@ -450,6 +453,8 @@ class TaskManager:
                               (time.monotonic() - t_start) * 1000, 3),
                           "splitsDone": task.splits_done,
                           "operators": ops}
+            if task.manifest is not None:
+                task.stats["manifest"] = task.manifest
             if tracer.enabled:
                 task.spans = tracer.export()
         self._executor.flush_metrics()
@@ -601,7 +606,8 @@ class TaskManager:
 
     def _pull_buffer(self, uri: str, task_id: str, buffer: int,
                      deadline: float, task: WorkerTask,
-                     tracer: Tracer = NOOP) -> List[bytes]:
+                     tracer: Tracer = NOOP,
+                     ack: bool = True) -> List[bytes]:
         """Pull one upstream buffer to completion (the worker-side twin
         of the coordinator's RemoteTask.drain — HttpPageBufferClient's
         loop, running worker-to-worker). The consumer's trace context
@@ -622,7 +628,8 @@ class TaskManager:
             if task.state == "CANCELED":
                 raise RuntimeError("task canceled during exchange pull")
             req = Request(
-                f"{uri}/v1/task/{task_id}/results/{buffer}/{token}",
+                f"{uri}/v1/task/{task_id}/results/{buffer}/{token}"
+                + ("" if ack else "?ack=0"),
                 headers=headers)
             with urlopen(req, timeout=30.0) as resp:
                 body = resp.read()
@@ -660,6 +667,11 @@ class TaskManager:
         from ..planner import logical as L
         fragment = decode_fragment(task.fragment_blob)
         root = fragment["root"]
+        writer = None
+        if isinstance(root, L.TableWriterNode):
+            # write-stage task: execute the subtree, then stage the rows
+            # to an attempt file instead of emitting exchange pages
+            writer, root = root, root.child
         deadline = _time.time() + float(fragment.get("timeout_s", 300.0))
 
         from ..planner.fragmenter import _subtree_nodes
@@ -677,7 +689,8 @@ class TaskManager:
                                  buffer=int(s.get("buffer", 0))):
                     pages.extend(self._pull_buffer(
                         s["uri"], s["taskId"], int(s.get("buffer", 0)),
-                        deadline, task, tracer))
+                        deadline, task, tracer,
+                        ack=writer is None))
             nodes = by_fid.get(fid)
             arrs, vals = concat_pages(
                 pages, nodes[0].output if nodes else ())
@@ -721,9 +734,45 @@ class TaskManager:
                 for b in ex._node_bytes.values():
                     ex.pool.free(b)
                 ex._node_bytes.clear()
+        if writer is not None:
+            self._stage_write(task, writer, arrs, vals)
+            return
         self._emit(task, arrs, vals)
         # terminal state is set by _run AFTER stats finalize — a status
         # fetch racing completion must never see FINISHED + partial stats
+
+    def _stage_write(self, task: WorkerTask, writer,
+                     arrs, vals) -> None:
+        """Write-stage terminal: rows land in a uniquely-named attempt
+        file under `<table>/.staging/`; the manifest (path, rows, CRC,
+        zone stats) rides the terminal task status. The write buffer is
+        a memory-pool reservation for its lifetime — a worker near its
+        memory limit fails the attempt instead of silently ballooning."""
+        from ..batch import Schema
+        from ..connectors.tpch.datagen import TableData
+        from . import writeprotocol as wp
+        arrays = [np.asarray(a) for a in arrs]
+        valids = None
+        if vals is not None and any(v is not None and not bool(np.all(v))
+                                    for v in vals):
+            valids = [None if v is None or bool(np.all(v))
+                      else np.asarray(v) for v in vals]
+        data = TableData(writer.table, Schema(tuple(writer.fields)),
+                         arrays, valids=valids)
+        nbytes = sum(a.nbytes for a in arrays)
+        ex = self._executor
+        ex.pool.reserve(nbytes, tag=f"write:{task.task_id}")
+        try:
+            m = wp.stage_table_data(
+                writer.table_dir, data, writer.query_id, writer.stage,
+                writer.partition, writer.attempt or task.task_id,
+                writer.fmt, injector=self.injector)
+        finally:
+            ex.pool.free(nbytes, tag=f"write:{task.task_id}")
+        with task.lock:
+            task.manifest = m
+            task.rows_out += m["rows"]
+            task.bytes_out += m["bytes"]
 
     def status_json(self, task: WorkerTask) -> dict:
         with task.lock:      # buffers/acked mutate on the task thread

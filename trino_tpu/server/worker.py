@@ -175,6 +175,12 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         buffer = int(parts[4]) if len(parts) == 6 else 0
         token = int(parts[-1])
         binary = "x-trino-pages" in self.headers.get("Accept", "")
+        # ?ack=0: serve without the implicit-ack page drop — write-stage
+        # consumers use it so a retried or hedged attempt re-reads the
+        # whole buffer (an acked page is gone for every later attempt)
+        from urllib.parse import parse_qs, urlparse
+        ack = parse_qs(urlparse(self.path).query).get(
+            "ack", ["1"])[0] != "0"
         # only bookkeeping under the lock: P concurrent consumer
         # pulls + the producer's _emit all contend on it, so socket
         # writes must happen after release
@@ -188,7 +194,7 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             # drained pages so a long-lived worker's memory stays flat;
             # same-token retries after a fetch failure still succeed.
             drained = 0
-            while acked < token and pages:
+            while ack and acked < token and pages:
                 drained += len(pages.pop(0))
                 acked += 1
             task.acked[buffer] = acked

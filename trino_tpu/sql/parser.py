@@ -75,7 +75,14 @@ class Parser:
     def parse_statement(self) -> A.Node:
         if self.accept_kw("EXPLAIN"):
             analyze = self.accept_kw("ANALYZE")
-            q = self.parse_query()
+            if self.at_kw("CREATE"):
+                q: A.Node = self.parse_create_table()
+            elif self.accept_kw("INSERT"):
+                self.expect_kw("INTO")
+                q = A.InsertInto(tuple(self.qualified_name()),
+                                 self.parse_query())
+            else:
+                q = self.parse_query()
             node: A.Node = A.Explain(q, analyze)
         elif self.at_kw("SHOW"):
             node = self.parse_show()
