@@ -143,6 +143,12 @@ REQUIRED_FAMILIES = (
     "trino_tpu_write_attempts_deduped_total",
     "trino_tpu_write_commits_total",
     "trino_tpu_write_orphans_swept_total",
+    # round-19 timeline + flight recorder: critical-path attribution and
+    # the bounded telemetry ring's sample/eviction accounting
+    "trino_tpu_timeline_queries_total",
+    "trino_tpu_critical_path_seconds",
+    "trino_tpu_telemetry_samples_total",
+    "trino_tpu_telemetry_ring_evictions_total",
 )
 
 

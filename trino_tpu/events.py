@@ -53,6 +53,9 @@ class QueryCompletedEvent:
     written_rows: int = 0
     written_bytes: int = 0
     commit_phase: str = ""        # "committed" | "aborted" | ""
+    # critical-path attribution (server/timeline.py): the phase holding
+    # the most elapsed wall, "" when no timeline was built
+    dominant_phase: str = ""
 
 
 class EventListener:
@@ -110,5 +113,7 @@ class EventListenerManager:
             tenant=getattr(tq, "tenant", "default"),
             written_rows=int((st.get("write") or {}).get("rows", 0)),
             written_bytes=int((st.get("write") or {}).get("bytes", 0)),
-            commit_phase=(st.get("write") or {}).get("phase", ""))
+            commit_phase=(st.get("write") or {}).get("phase", ""),
+            dominant_phase=(getattr(tq, "timeline", None) or
+                            {}).get("dominant", ""))
         self._dispatch("query_completed", ev)

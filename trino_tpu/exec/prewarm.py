@@ -210,12 +210,15 @@ class PrewarmEngine:
             return self.budget_s
         return self._deadline - time.monotonic()
 
-    def warm_fingerprint(self, fingerprint: str, sql: str) -> bool:
+    def warm_fingerprint(self, fingerprint: str, sql: str,
+                         context: str = "") -> bool:
         """Compile this statement's device programs off the query path:
         execute it once under prewarm_context (every jit site along the
         plan records an off-path prewarm compile), then mark the
         fingerprint warm. Returns False when the warm failed or was
-        skipped (already warm / in flight / no runner)."""
+        skipped (already warm / in flight / no runner). `context` is the
+        triggering query's `query=... trace=...` log prefix, so a warm
+        kicked by a served query greps back to it."""
         if not sql:
             return False
         with self._lock:
@@ -240,7 +243,8 @@ class PrewarmEngine:
                     runner(sql)
             ok = True
         except Exception as e:    # noqa: BLE001 — warming is best-effort
-            log.warning("prewarm of %s failed: %s", fingerprint, e)
+            log.warning("%sprewarm of %s failed: %s", context,
+                        fingerprint, e)
         finally:
             with self._lock:
                 self._inflight.discard(fingerprint)
@@ -248,7 +252,8 @@ class PrewarmEngine:
                     self._warmed.add(fingerprint)
         return ok
 
-    def ensure_warming(self, fingerprint: str, sql: str) -> None:
+    def ensure_warming(self, fingerprint: str, sql: str,
+                       context: str = "") -> None:
         """Kick a background warm for a cold fingerprint the serving
         layer just routed to host. Dedup'd: one warm per fingerprint.
         When the warm completes the fingerprint routes to device."""
@@ -259,7 +264,8 @@ class PrewarmEngine:
                     fingerprint in self._inflight:
                 return
         t = threading.Thread(
-            target=self.warm_fingerprint, args=(fingerprint, sql),
+            target=self.warm_fingerprint,
+            args=(fingerprint, sql, context),
             name=f"prewarm-{fingerprint[:8]}", daemon=True)
         t.start()
         self._threads.append(t)

@@ -122,6 +122,13 @@ class Histogram(_Metric):
         if not self.labelnames:
             self._hists[()] = [0] * (len(self.buckets) + 2)
 
+    def init_labels(self, **labels) -> None:
+        """Pre-create a zeroed histogram for the label combination so it
+        renders (buckets/count/sum at 0) before the first observe."""
+        key = self._key(labels)
+        with self._lock:
+            self._hists.setdefault(key, [0] * (len(self.buckets) + 2))
+
     def observe(self, value: float, **labels) -> None:
         key = self._key(labels)
         with self._lock:
@@ -507,6 +514,32 @@ WRITE_ORPHANS_SWEPT = REGISTRY.counter(
     "Orphaned staging files / journals removed by abort and "
     "startup-recovery sweeps")
 
+# critical-path wall-time attribution (server/timeline.py) + the cluster
+# flight recorder (server/telemetry.py): per-query phase timelines and
+# the bounded delta-encoded metric ring each node samples into
+TIMELINE_QUERIES = REGISTRY.counter(
+    "trino_tpu_timeline_queries_total",
+    "Completed queries whose wall time was attributed into phase "
+    "intervals by the critical-path analyzer")
+CRITICAL_PATH_SECONDS = REGISTRY.counter(
+    "trino_tpu_critical_path_seconds",
+    "Attributed query wall seconds, by timeline phase (sums to total "
+    "query wall across phases)", ("phase",))
+TELEMETRY_SAMPLES = REGISTRY.counter(
+    "trino_tpu_telemetry_samples_total",
+    "Flight-recorder samples taken of the process metrics registry")
+TELEMETRY_RING_EVICTIONS = REGISTRY.counter(
+    "trino_tpu_telemetry_ring_evictions_total",
+    "Flight-recorder samples evicted to hold the ring under its byte "
+    "bound")
+TENANT_QUERY_SECONDS = REGISTRY.histogram(
+    "trino_tpu_tenant_query_seconds",
+    "End-to-end query wall time by resource-group tenant — the "
+    "flight-recorder series behind the soak's p99-over-time SLO gate",
+    ("tenant",),
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             15.0, 60.0))
+
 # the labeled families acceptance scrapes: seed the hot label values so
 # a cold server's /v1/metrics already carries them at 0
 for _op in ("scan", "output"):
@@ -538,3 +571,8 @@ for _ls in ("ACTIVE", "DRAINING", "DRAINED", "LEFT", "FAILED"):
 TENANT_QUERIES.init_labels(tenant="default")
 for _o in ("committed", "aborted"):
     WRITE_COMMITS.init_labels(outcome=_o)
+# kept in sync with server/timeline.py PHASES (asserted in tier-1)
+for _p in ("queued", "plan", "schedule", "exchange-wait", "device",
+           "host", "compile", "spill", "retry", "write-commit", "other"):
+    CRITICAL_PATH_SECONDS.init_labels(phase=_p)
+TENANT_QUERY_SECONDS.init_labels(tenant="default")

@@ -62,6 +62,12 @@ ROUTES = (
     ("GET", ("v1", "query"), "_get_queries", True),
     ("GET", ("v1", "query", STAR), "_get_query", True),
     ("GET", ("v1", "query", STAR, "trace"), "_get_query_trace", True),
+    ("GET", ("v1", "query", STAR, "timeline"), "_get_query_timeline",
+     True),
+    # the coordinator's own flight-recorder ring — same contract the
+    # workers serve, so the federation scrape path is uniform. Internal:
+    # metric keys carry tenant/route labels a stranger shouldn't map
+    ("GET", ("v1", "telemetry"), "_get_telemetry", "internal"),
     ("GET", ("v1", "statement", "executing", STAR), "_get_executing",
      True),
     ("GET", ("v1", "statement", "executing", STAR, STAR),
@@ -119,6 +125,11 @@ class RegisteredNode:
         # last heartbeat-reported device/HBM allocator stats
         # (system.runtime.nodes surface)
         self.device: Optional[dict] = None
+        # estimated clock skew (worker clock minus coordinator clock),
+        # refreshed from the `now` stamp each announce carries; adopted
+        # worker spans are rebased by it so stitched-trace intervals
+        # cannot go negative under skewed wall clocks
+        self.clock_offset: float = 0.0
 
 
 class Dispatcher:
@@ -181,10 +192,28 @@ class Dispatcher:
 
         def on_terminal(state):
             if state in ("FINISHED", "FAILED", "CANCELED"):
-                from ..metrics import QUERIES, QUERY_SECONDS, TENANT_QUERIES
+                from ..metrics import (QUERIES, QUERY_SECONDS,
+                                       TENANT_QUERIES,
+                                       TENANT_QUERY_SECONDS)
                 QUERIES.inc(state=state)
                 TENANT_QUERIES.inc(tenant=tq.tenant)
                 QUERY_SECONDS.observe(tq.elapsed_s)
+                TENANT_QUERY_SECONDS.observe(tq.elapsed_s,
+                                             tenant=tq.tenant)
+                # critical-path attribution BEFORE the completion event
+                # fires, so listeners (history store, event sinks) see
+                # the dominant phase
+                try:
+                    from ..metrics import (CRITICAL_PATH_SECONDS,
+                                           TIMELINE_QUERIES)
+                    from .timeline import build_timeline
+                    tq.timeline = build_timeline(tq)
+                    TIMELINE_QUERIES.inc()
+                    for p, v in tq.timeline["phases"].items():
+                        if v > 0:
+                            CRITICAL_PATH_SECONDS.inc(v, phase=p)
+                except Exception:  # noqa: BLE001 — attribution never
+                    pass           # fails a query
                 self.event_listeners.query_completed(tq)
 
         tq.state_machine.add_listener(on_terminal)
@@ -417,7 +446,8 @@ class Dispatcher:
 
 class CoordinatorState:
     def __init__(self, session: Session, max_concurrency: int = 4,
-                 retry_policy: str = "NONE"):
+                 retry_policy: str = "NONE",
+                 telemetry_interval_s: Optional[float] = None):
         self.session = session
         self.tracker = QueryTracker()
         self.dispatcher = Dispatcher(session, self.tracker, max_concurrency,
@@ -457,13 +487,29 @@ class CoordinatorState:
                                      exec_lock=self.dispatcher.exec_lock)
         self.dispatcher.serving.prewarm = self.prewarm
         self.prewarm.maybe_start()
+        # the timeline analyzer's EXPLAIN ANALYZE hook: the scheduler
+        # looks up the running TrackedQuery (state-machine stamps) to
+        # print queued time in the critical-path breakdown line
+        self.scheduler.tracked_lookup = self.tracker.get
+        # cluster flight recorder (server/telemetry.py): the local ring
+        # plus coordinator-scrape federation of worker rings. The sampler
+        # thread only runs when an interval is configured
+        # (TRINO_TPU_TELEMETRY_INTERVAL_S or the constructor arg); the
+        # default path creates the recorder but no thread and no samples.
+        from .telemetry import ClusterTelemetry, FlightRecorder
+        self.telemetry = ClusterTelemetry(
+            FlightRecorder("coordinator",
+                           interval_s=telemetry_interval_s),
+            lambda: [(n.node_id, n.uri) for n in self.active_nodes()])
         # system.runtime.{queries,nodes,tasks,operator_stats,jit_cache,
-        # query_history} backed by this coordinator's state
+        # query_history,query_timeline,metrics_history} backed by this
+        # coordinator's state
         from .system_connector import SystemConnector
         session.catalog.register("system", SystemConnector(self))
 
     def announce(self, node_id: str, uri: str,
-                 state: str = "ACTIVE") -> None:
+                 state: str = "ACTIVE",
+                 now: Optional[float] = None) -> None:
         """Register/refresh a worker, honoring its reported lifecycle
         state. LEFT deregisters (the graceful mirror of a failure-
         detector eviction); DRAINING/DRAINED pull the node out of
@@ -473,8 +519,15 @@ class CoordinatorState:
         change triggers an immediate cluster-memory re-arbitration."""
         from ..metrics import NODE_LIFECYCLE_TRANSITIONS
         changed = False
+        # clock-skew estimate: the worker stamped `now` at send time and
+        # we read our clock at receive time — the send/recv midpoint of a
+        # sub-millisecond local POST, so offset ≈ worker_clock - ours.
+        # Adopted worker spans are rebased by it (utils/tracing.py).
+        offset = (now - time.time()) if now is not None else None
         with self.nodes_lock:
             node = self.nodes.get(node_id)
+            if offset is not None and node is not None and state != "LEFT":
+                node.clock_offset = offset
             if state == "LEFT":
                 if node is not None:
                     del self.nodes[node_id]
@@ -483,6 +536,8 @@ class CoordinatorState:
                 self.nodes[node_id] = RegisteredNode(node_id, uri)
                 self.nodes[node_id].state = \
                     state if state in ("DRAINING", "DRAINED") else "ACTIVE"
+                if offset is not None:
+                    self.nodes[node_id].clock_offset = offset
                 changed = True
                 state = self.nodes[node_id].state
             else:
@@ -677,7 +732,8 @@ class _Handler(BaseHTTPRequestHandler):
         body = json.loads(self._read_body() or "{}")
         self.state.announce(body.get("nodeId", "unknown"),
                             body.get("uri", ""),
-                            state=body.get("state", "ACTIVE"))
+                            state=body.get("state", "ACTIVE"),
+                            now=body.get("now"))
         self._send(202, {"ok": True})
 
     def _get_info(self, parts, user):
@@ -790,6 +846,31 @@ class _Handler(BaseHTTPRequestHandler):
             "traceId": tracer.trace_id if tracer is not None else None,
             "spans": spans or []})
 
+    def _get_query_timeline(self, parts, user):
+        """Critical-path wall-time attribution (server/timeline.py):
+        phase intervals summing exactly to elapsed wall, the dominant
+        phase, and the blocking critical path over stage spans."""
+        tq = self.state.tracker.get(parts[2])
+        if tq is None:
+            self._send(404, {"error": {"message": "unknown query"}})
+            return
+        tl = tq.timeline
+        if tl is None:                    # still executing: live view
+            from .timeline import build_timeline
+            tl = build_timeline(tq)
+        self._send(200, tl)
+
+    def _get_telemetry(self, parts, user):
+        from urllib.parse import parse_qs, urlparse
+        try:
+            since = float(parse_qs(urlparse(self.path).query)
+                          .get("since", ["0"])[0])
+        except ValueError:
+            since = 0.0
+        rec = self.state.telemetry.recorder
+        self._send(200, {"nodeId": rec.node_id,
+                         "samples": rec.since(since)})
+
     def _get_executing(self, parts, user):
         qid = parts[3]
         token = int(parts[4]) if len(parts) > 4 else 0
@@ -820,9 +901,11 @@ class CoordinatorServer:
     HTTP, embeddable in one process for tests)."""
 
     def __init__(self, session: Optional[Session] = None, port: int = 0,
-                 max_concurrency: int = 4, retry_policy: str = "NONE"):
+                 max_concurrency: int = 4, retry_policy: str = "NONE",
+                 telemetry_interval_s: Optional[float] = None):
         self.state = CoordinatorState(session or Session(),
-                                      max_concurrency, retry_policy)
+                                      max_concurrency, retry_policy,
+                                      telemetry_interval_s)
         handler = type("BoundHandler", (_Handler,), {"state": self.state})
         self.httpd = ClusterHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
@@ -834,9 +917,12 @@ class CoordinatorServer:
                                         name="coordinator-http",
                                         daemon=True)
         self._thread.start()
+        # no-op unless a telemetry interval is configured
+        self.state.telemetry.start()
         return self
 
     def stop(self) -> None:
+        self.state.telemetry.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:

@@ -215,13 +215,16 @@ class QueryHistoryStore:
         HISTORY_RECORDS.inc()
         if regression:
             LATENCY_REGRESSIONS.inc()
+            from ..utils.log import query_context
+            dominant = rec.get("dominant_phase") or "unattributed"
             log.warning(
-                "slow query %s (fingerprint %s): %s=%.4g vs baseline "
-                "median %.4g (MAD %.4g over %d runs): %s",
-                rec["query_id"], rec["fingerprint"],
+                "%sslow query (fingerprint %s): %s=%.4g vs baseline "
+                "median %.4g (MAD %.4g over %d runs), wall dominated by "
+                "%s: %s",
+                query_context(rec["query_id"]), rec["fingerprint"],
                 regression["metric"], regression["value"],
                 regression["median"], regression["mad"],
-                regression["n"], (rec.get("sql") or "")[:200])
+                regression["n"], dominant, (rec.get("sql") or "")[:200])
         return regression
 
     def record_tracked(self, tq) -> None:
@@ -240,6 +243,8 @@ class QueryHistoryStore:
                 "rows": int(tq.rows_returned),
                 "bytes_shuffled": int(st.get("bytes_shuffled", 0)),
                 "spills": int(getattr(tq, "spills", 0)),
+                "dominant_phase": (getattr(tq, "timeline", None) or
+                                   {}).get("dominant", ""),
             })
         except Exception:    # noqa: BLE001 — eviction must never fail
             log.exception("history eviction flush failed for %s",
@@ -321,5 +326,6 @@ class HistoryEventListener:
             "rows": int(event.rows),
             "bytes_shuffled": int(event.bytes_shuffled),
             "spills": int(getattr(event, "spills", 0)),
+            "dominant_phase": getattr(event, "dominant_phase", ""),
             "end_time": event.end_time,
         })

@@ -26,9 +26,12 @@ records it on the node inventory as it pings. The manager's tick then:
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional
+
+log = logging.getLogger("trino_tpu.memory")
 
 
 class ClusterMemoryManager:
@@ -173,6 +176,9 @@ class ClusterMemoryManager:
         self.queries_killed += 1
         from ..metrics import QUERIES_KILLED_OOM
         QUERIES_KILLED_OOM.inc()
+        from ..utils.log import tq_context
+        log.warning("%skilled by the cluster low-memory killer: %s",
+                    tq_context(tq), why)
         return tq.query_id
 
     # -- lifecycle ---------------------------------------------------------

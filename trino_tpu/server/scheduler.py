@@ -332,6 +332,10 @@ class StageScheduler:
         # into TrackedQuery.fallback_reason — the round-3 verdict's
         # "silently local" complaint)
         self.fallback_reason: Optional[str] = None
+        # wired by CoordinatorState: query_id -> TrackedQuery, so
+        # EXPLAIN ANALYZE can fold queued time (state-machine stamps)
+        # into its critical-path line. None under session-local use.
+        self.tracked_lookup = None
 
     # -- per-query observability rollup -----------------------------------
 
@@ -395,6 +399,7 @@ class StageScheduler:
         except Exception:  # noqa: BLE001 — stats fetch is best-effort
             return
         stats = st.get("stats") or {}
+        ops = stats.get("operators") or {}
         rec = {"query_id": (self.last_query or {}).get("query_id") or "",
                "task_id": task.task_id, "node": task.node.node_id,
                "stage": self._current_stage,
@@ -402,7 +407,16 @@ class StageScheduler:
                "splits": int(stats.get("splitsDone", 0)),
                "rows": int(stats.get("rowsOut", 0)),
                "bytes": int(stats.get("bytesOut", 0)),
-               "wall_ms": float(stats.get("wallMs", 0.0))}
+               "wall_ms": float(stats.get("wallMs", 0.0)),
+               # per-task device/host/compile split: the timeline's
+               # blocking-task attribution (server/timeline.py) reads
+               # these off the stage's slowest task
+               "device_ms": sum(float(d.get("deviceMs", 0.0))
+                                for d in ops.values()),
+               "host_ms": sum(float(d.get("hostMs", 0.0))
+                              for d in ops.values()),
+               "compile_ms": sum(float(d.get("compileMs", 0.0))
+                                 for d in ops.values())}
         with self._lock:
             self.task_history.append(rec)
             lq = self.last_query
@@ -425,7 +439,11 @@ class StageScheduler:
                         acc["strategy"] = d["strategy"]
                     if d.get("distribution"):
                         acc["distribution"] = d["distribution"]
-        self._tracer().adopt(st.get("spans") or [])
+        # rebase the worker's span stamps onto the coordinator clock
+        # using the offset estimated at announce (skew satellite)
+        self._tracer().adopt(
+            st.get("spans") or [],
+            offset_s=getattr(task.node, "clock_offset", 0.0))
 
     # -- eligibility + planning -------------------------------------------
 
@@ -597,6 +615,11 @@ class StageScheduler:
         qid = (self.last_query or {}).get("query_id") or \
             f"adhoc_{_uuid.uuid4().hex[:10]}"
         table_dir = _os.path.abspath(conn._table_dir(sch, tbl))
+        # commit-phase wall (stage / commit), surfaced on the EXPLAIN
+        # ANALYZE write line and read by the timeline's write-commit
+        # attribution when tracing is off; empty on the idempotent
+        # already-committed path (no staging happened this attempt)
+        phase_times: Dict[str, float] = {}
 
         def _finish_commit(stats, partitions, staged):
             conn._cache.pop((sch, tbl), None)
@@ -614,7 +637,10 @@ class StageScheduler:
                         "deduped": stats.get("deduped", 0),
                         "rows": stats["rows"],
                         "bytes": stats.get("bytes", 0),
-                        "phase": stats.get("phase", "committed")}
+                        "phase": stats.get("phase", "committed"),
+                        "stage_s": round(phase_times.get("stage", 0.0), 6),
+                        "commit_s": round(phase_times.get("commit", 0.0),
+                                          6)}
             return QueryResult(["rows"], [(stats["rows"],)],
                                time.monotonic() - t0)
 
@@ -680,134 +706,143 @@ class StageScheduler:
         live: Dict[int, list] = {}
         _os.makedirs(table_dir, exist_ok=True)
         created_dir = is_ctas
+        tracer = self._tracer()
         try:
-            for wi, w in enumerate(workers):
-                sp = [s for i, s in enumerate(splits)
-                      if i % len(workers) == wi]
-                if not sp:
-                    continue
-                with self._lock:
-                    self._seq += 1
-                    tid = f"t{self._seq}"
-                task = RemoteTask(w, tid, blob, sp,
-                                  partition={"keys": keys, "count": P},
-                                  injector=self.failure_injector,
-                                  traceparent=traceparent)
-                task.start()
-                self.stats["tasks"] += 1
-                SCHED_TASKS.inc()
-                src_tasks.append(task)
-
-            def launch_writer(p: int, attempt_no: int, exclude=()):
-                w = next((n for n in self.state.active_nodes()
-                          if n.node_id not in exclude),
-                         None) or workers[(p + attempt_no) % len(workers)]
-                with self._lock:
-                    self._seq += 1
-                    tid = f"t{self._seq}"
-                node = L.TableWriterNode(
-                    child=L.RemoteSourceNode(1, src_root.output),
-                    catalog=cat, schema_name=sch, table=tbl,
-                    table_dir=table_dir, fmt=conn.fmt, query_id=qid,
-                    stage=1, partition=p, attempt=tid,
-                    fields=tuple(out_fields), output=(("rows", BIGINT),))
-                wblob = encode_fragment({"root": node,
-                                         "timeout_s":
-                                             self.task_timeout_s})
-                sources = {"1": [{"uri": t.node.uri, "taskId": t.task_id,
-                                  "buffer": p} for t in src_tasks]}
-                task = RemoteTask(w, tid, wblob, [], sources=sources,
-                                  injector=self.failure_injector,
-                                  traceparent=traceparent)
-                task.start()
-                self.stats["tasks"] += 1
-                SCHED_TASKS.inc()
-                return task
-
-            attempts: Dict[int, int] = {}
-            for p in range(P):
-                live[p] = [launch_writer(p, 0)]
-                attempts[p] = 1
-                if getattr(self, "force_write_hedge", False):
-                    # duplicate-attempt injection: both stage; commit's
-                    # (stage, partition) dedup must drop one
-                    live[p].append(launch_writer(p, 1))
-                    attempts[p] += 1
-                    self.stats["hedged_tasks"] = \
-                        self.stats.get("hedged_tasks", 0) + 1
-            manifests: List[dict] = []
-            collected: Set[str] = set()
-            done: Set[int] = set()
-            max_attempts = 4
-            while len(done) < P:
-                if time.time() > t_deadline:
-                    raise TaskFailedError("write stage timed out")
-                for p in range(P):
-                    if p in done:
+            _t_stage = time.monotonic()
+            with tracer.span("write-stage", partitions=P):
+                for wi, w in enumerate(workers):
+                    sp = [s for i, s in enumerate(splits)
+                          if i % len(workers) == wi]
+                    if not sp:
                         continue
-                    failed_nodes = []
-                    all_failed = bool(live[p])
-                    for t in list(live[p]):
+                    with self._lock:
+                        self._seq += 1
+                        tid = f"t{self._seq}"
+                    task = RemoteTask(w, tid, blob, sp,
+                                      partition={"keys": keys, "count": P},
+                                      injector=self.failure_injector,
+                                      traceparent=traceparent)
+                    task.start()
+                    self.stats["tasks"] += 1
+                    SCHED_TASKS.inc()
+                    src_tasks.append(task)
+
+                def launch_writer(p: int, attempt_no: int, exclude=()):
+                    w = next((n for n in self.state.active_nodes()
+                              if n.node_id not in exclude),
+                             None) or workers[(p + attempt_no) % len(workers)]
+                    with self._lock:
+                        self._seq += 1
+                        tid = f"t{self._seq}"
+                    node = L.TableWriterNode(
+                        child=L.RemoteSourceNode(1, src_root.output),
+                        catalog=cat, schema_name=sch, table=tbl,
+                        table_dir=table_dir, fmt=conn.fmt, query_id=qid,
+                        stage=1, partition=p, attempt=tid,
+                        fields=tuple(out_fields), output=(("rows", BIGINT),))
+                    wblob = encode_fragment({"root": node,
+                                             "timeout_s":
+                                                 self.task_timeout_s})
+                    sources = {"1": [{"uri": t.node.uri, "taskId": t.task_id,
+                                      "buffer": p} for t in src_tasks]}
+                    task = RemoteTask(w, tid, wblob, [], sources=sources,
+                                      injector=self.failure_injector,
+                                      traceparent=traceparent)
+                    task.start()
+                    self.stats["tasks"] += 1
+                    SCHED_TASKS.inc()
+                    return task
+
+                attempts: Dict[int, int] = {}
+                for p in range(P):
+                    live[p] = [launch_writer(p, 0)]
+                    attempts[p] = 1
+                    if getattr(self, "force_write_hedge", False):
+                        # duplicate-attempt injection: both stage; commit's
+                        # (stage, partition) dedup must drop one
+                        live[p].append(launch_writer(p, 1))
+                        attempts[p] += 1
+                        self.stats["hedged_tasks"] = \
+                            self.stats.get("hedged_tasks", 0) + 1
+                manifests: List[dict] = []
+                collected: Set[str] = set()
+                done: Set[int] = set()
+                max_attempts = 4
+                while len(done) < P:
+                    if time.time() > t_deadline:
+                        raise TaskFailedError("write stage timed out")
+                    for p in range(P):
+                        if p in done:
+                            continue
+                        failed_nodes = []
+                        all_failed = bool(live[p])
+                        for t in list(live[p]):
+                            try:
+                                st = t._request(t._url())
+                            except Exception:
+                                st = {"state": "FAILED", "error": "status "
+                                      "fetch failed (node dead?)"}
+                            state = st.get("state")
+                            if state == "FINISHED":
+                                m = (st.get("stats") or {}).get("manifest")
+                                if m is not None:
+                                    manifests.append(m)
+                                    collected.add(t.task_id)
+                                    done.add(p)
+                                    self._record_task(t)
+                                    all_failed = False
+                                    break
+                                state = "FAILED"
+                            if state in ("FAILED", "CANCELED"):
+                                live[p].remove(t)
+                                failed_nodes.append(t.node.node_id)
+                                self.stats["task_retries"] += 1
+                                SCHED_TASK_RETRIES.inc()
+                            else:
+                                all_failed = False
+                        if p in done or not all_failed:
+                            continue
+                        if attempts[p] >= max_attempts:
+                            raise TaskFailedError(
+                                f"write partition {p} exhausted "
+                                f"{max_attempts} attempts")
+                        live[p].append(launch_writer(p, attempts[p],
+                                                     exclude=failed_nodes))
+                        attempts[p] += 1
+                    time.sleep(0.02)
+                # duplicate attempts that also finished report their
+                # manifests too — commit's (stage, partition) dedup drops
+                # them; still-running stragglers are cancelled (their staged
+                # files, if any, fall to the post-commit sweep)
+                for p in range(P):
+                    for t in live[p]:
+                        if t.task_id in collected:
+                            continue
                         try:
                             st = t._request(t._url())
-                        except Exception:
-                            st = {"state": "FAILED", "error": "status "
-                                  "fetch failed (node dead?)"}
-                        state = st.get("state")
-                        if state == "FINISHED":
-                            m = (st.get("stats") or {}).get("manifest")
-                            if m is not None:
-                                manifests.append(m)
-                                collected.add(t.task_id)
-                                done.add(p)
-                                self._record_task(t)
-                                all_failed = False
-                                break
-                            state = "FAILED"
-                        if state in ("FAILED", "CANCELED"):
-                            live[p].remove(t)
-                            failed_nodes.append(t.node.node_id)
-                            self.stats["task_retries"] += 1
-                            SCHED_TASK_RETRIES.inc()
-                        else:
-                            all_failed = False
-                    if p in done or not all_failed:
-                        continue
-                    if attempts[p] >= max_attempts:
-                        raise TaskFailedError(
-                            f"write partition {p} exhausted "
-                            f"{max_attempts} attempts")
-                    live[p].append(launch_writer(p, attempts[p],
-                                                 exclude=failed_nodes))
-                    attempts[p] += 1
-                time.sleep(0.02)
-            # duplicate attempts that also finished report their
-            # manifests too — commit's (stage, partition) dedup drops
-            # them; still-running stragglers are cancelled (their staged
-            # files, if any, fall to the post-commit sweep)
-            for p in range(P):
-                for t in live[p]:
-                    if t.task_id in collected:
-                        continue
-                    try:
-                        st = t._request(t._url())
-                        m = (st.get("stats") or {}).get("manifest") \
-                            if st.get("state") == "FINISHED" else None
-                    except Exception:  # noqa: BLE001
-                        m = None
-                    if m is not None:
-                        manifests.append(m)
-                        collected.add(t.task_id)
-                        continue
-                    try:
-                        t.cancel()
-                    except Exception:  # noqa: BLE001
-                        pass
-            for t in src_tasks:
-                t.wait_finished(t_deadline)
-                self._record_task(t)
-            stats = wp.commit(table_dir, qid, manifests,
-                              injector=self.failure_injector)
+                            m = (st.get("stats") or {}).get("manifest") \
+                                if st.get("state") == "FINISHED" else None
+                        except Exception:  # noqa: BLE001
+                            m = None
+                        if m is not None:
+                            manifests.append(m)
+                            collected.add(t.task_id)
+                            continue
+                        try:
+                            t.cancel()
+                        except Exception:  # noqa: BLE001
+                            pass
+                for t in src_tasks:
+                    t.wait_finished(t_deadline)
+                    self._record_task(t)
+            phase_times["stage"] = time.monotonic() - _t_stage
+            _t_commit = time.monotonic()
+            with tracer.span("write-commit", partitions=P,
+                             manifests=len(manifests)):
+                stats = wp.commit(table_dir, qid, manifests,
+                                  injector=self.failure_injector,
+                                  tracer=tracer)
+            phase_times["commit"] = time.monotonic() - _t_commit
             WRITE_ATTEMPTS_DEDUPED.inc(stats.get("deduped", 0))
             self.stats["stages"] = self.stats.get("stages", 0) + 2
             self.stats["queries"] += 1
@@ -831,6 +866,28 @@ class StageScheduler:
                 except OSError:
                     pass
             raise
+
+    def _critical_path_line(self, t0: float) -> str:
+        """The `critical path: ...` EXPLAIN ANALYZE line — phase
+        attribution over this query's elapsed wall (server/timeline.py).
+        Dispatcher-tracked queries fold in queued time from their
+        state-machine stamps; session-local runs attribute only the
+        scheduler-observed elapsed."""
+        from .timeline import attribute_phases, breakdown_line
+        lq = self.last_query or {}
+        wall = max(0.0, time.monotonic() - t0)
+        queued = 0.0
+        lookup = self.tracked_lookup
+        tq = lookup(lq.get("query_id") or "") if lookup else None
+        if tq is not None:
+            sm = tq.state_machine
+            stamps = getattr(sm, "state_times", {}) or {}
+            queued = max(0.0, stamps.get("PLANNING", sm.created_at) -
+                         sm.created_at)
+            wall = max(queued, time.time() - sm.created_at)
+        phases = attribute_phases(wall, queued, self._tracer().export(),
+                                  lq, lq.get("write"))
+        return breakdown_line(phases, wall)
 
     def _execute_explain_analyze(self, stmt, sql: str):
         """EXPLAIN ANALYZE over the cluster: run the inner query
@@ -873,13 +930,16 @@ class StageScheduler:
                       f"{lq['bytes_shuffled']} bytes shuffled, "
                       f"{lq['task_retries']} task retries, "
                       f"{lq['hedged_tasks']} hedged",
+                  self._critical_path_line(t0),
                   f"scan: {lq.get('splits_total', 0)} splits, "
                   f"{lq.get('splits_pruned', 0)} pruned by zone maps"]
         wr = lq.get("write")
         if wr is not None:
             lines.append(f"write: {wr['partitions']} partitions, "
                          f"{wr['staged']} staged, "
-                         f"{wr['deduped']} deduped, {wr['rows']} rows")
+                         f"{wr['deduped']} deduped, {wr['rows']} rows "
+                         f"(stage {wr.get('stage_s', 0.0) * 1000:.1f}ms + "
+                         f"commit {wr.get('commit_s', 0.0) * 1000:.1f}ms)")
         for name in sorted(stages):
             n, splits, rows, wall = stages[name]
             lines.append(f"Stage {name}: tasks={n}, splits={splits}, "

@@ -36,6 +36,7 @@ behind one 100-260 ms tunnel RTT). Four cooperating parts:
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import re
 import threading
@@ -49,7 +50,10 @@ from ..planner import logical as L
 from ..planner.optimizer import prune_plan
 from ..sql import ast_nodes as A
 from ..sql.parser import parse
+from ..utils.log import tq_context
 from .history import plan_fingerprint
+
+log = logging.getLogger("trino_tpu.serving")
 
 
 def _env_int(name: str, default: int) -> int:
@@ -616,6 +620,8 @@ class ServingLayer:
             if tq is not None:
                 tq.route = "device"
                 tq.route_reason = "replanned: catalog changed mid-flight"
+                log.info("%sreplanned: catalog changed mid-flight",
+                         tq_context(tq))
         self.store_result(entry, result, version=version)
         return result
 
@@ -645,7 +651,8 @@ class ServingLayer:
                 # warm the device program in the background so the NEXT
                 # submission of the fingerprint routes to device
                 self.prewarm.ensure_warming(
-                    fingerprint, getattr(tq, "sql", None) or "")
+                    fingerprint, getattr(tq, "sql", None) or "",
+                    context=tq_context(tq) if tq is not None else "")
             try:
                 result = run_host(session, rel, root, t0)
                 ROUTER_DECISIONS.inc(target="host")
@@ -656,6 +663,8 @@ class ServingLayer:
                 if tq is not None:
                     tq.route = "device"
                     tq.route_reason = f"host fallback: {e}"
+                    log.info("%shost route fell back to device: %s",
+                             tq_context(tq), e)
         ROUTER_DECISIONS.inc(target="device")
         self.fair_share.device_begin(tenant or "default")
         try:

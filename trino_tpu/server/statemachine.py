@@ -36,6 +36,11 @@ class QueryStateMachine:
         self.error_code: int = 1
         self.created_at = time.time()
         self.ended_at: Optional[float] = None
+        # entry timestamp per state reached — the timeline analyzer's
+        # queued/plan attribution input (server/timeline.py); QUEUED is
+        # stamped at creation so queued time exists even for queries
+        # failed before their first transition
+        self.state_times: Dict[str, float] = {"QUEUED": self.created_at}
 
     @property
     def state(self) -> str:
@@ -53,6 +58,7 @@ class QueryStateMachine:
             if ORDER.index(new_state) <= ORDER.index(self._state):
                 return False
             self._state = new_state
+            self.state_times.setdefault(new_state, time.time())
             if new_state in TERMINAL:
                 self.ended_at = time.time()
             to_fire = list(self._listeners)
@@ -70,6 +76,7 @@ class QueryStateMachine:
             self.error_name = error_name
             self.error_code = error_code
             self._state = "FAILED"
+            self.state_times.setdefault("FAILED", time.time())
             self.ended_at = time.time()
             to_fire = list(self._listeners)
         for fn in to_fire:
@@ -81,6 +88,7 @@ class QueryStateMachine:
             if self._state in TERMINAL:
                 return False
             self._state = "CANCELED"
+            self.state_times.setdefault("CANCELED", time.time())
             self.error = "Query was canceled"
             self.ended_at = time.time()
             to_fire = list(self._listeners)
@@ -131,6 +139,10 @@ class TrackedQuery:
     # labels metrics, history records, and audit events so per-tenant
     # isolation is observable, not just enforced
     tenant: str = "default"
+    # critical-path timeline (server/timeline.py): phase attribution
+    # summing exactly to elapsed wall, built at terminal transition and
+    # served at GET /v1/query/{id}/timeline + system.runtime.query_timeline
+    timeline: Optional[dict] = None
 
     @property
     def state(self) -> str:

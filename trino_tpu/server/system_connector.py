@@ -32,7 +32,7 @@ class SystemConnector:
         if schema == "runtime":
             return ["queries", "nodes", "tasks", "operator_stats",
                     "resource_groups", "jit_cache", "query_history",
-                    "plan_cache"]
+                    "plan_cache", "query_timeline", "metrics_history"]
         return []
 
     def get_table(self, schema: str, table: str) -> TableData:
@@ -54,6 +54,10 @@ class SystemConnector:
             return self._query_history_table()
         if table == "plan_cache":
             return self._plan_cache_table()
+        if table == "query_timeline":
+            return self._query_timeline_table()
+        if table == "metrics_history":
+            return self._metrics_history_table()
         raise KeyError(f"system table {table!r} not found")
 
     def _scheduler(self):
@@ -262,6 +266,69 @@ class SystemConnector:
                     Field("point_shape", BIGINT),
                     Field("result_cacheable", BIGINT))),
             base.columns + [hits, weight, point, cacheable])
+
+    def _query_timeline_table(self) -> TableData:
+        """Per-(query, phase) wall attribution from the critical-path
+        analyzer (server/timeline.py) — one row per phase per tracked
+        query, phases summing exactly to elapsed wall, plus the
+        dominant phase label repeated on each row for easy filtering."""
+        from .timeline import PHASES, build_timeline
+        queries = self.state.tracker.all() if self.state else []
+        rows = []
+        for q in queries:
+            tl = q.timeline
+            if tl is None and q.state_machine.is_done():
+                try:
+                    tl = build_timeline(q)
+                except Exception:  # noqa: BLE001 — view is best-effort
+                    tl = None
+            if tl is None:
+                continue
+            for ph in PHASES:
+                rows.append((q.query_id, ph, tl["phases"].get(ph, 0.0),
+                             tl["dominant"], tl["wall_s"],
+                             tl["criticalPathSeconds"]))
+        base = _strings_table(
+            "query_timeline",
+            [("query_id", [r[0] for r in rows]),
+             ("phase", [r[1] for r in rows]),
+             ("dominant", [r[3] for r in rows])])
+        seconds = np.array([r[2] for r in rows], dtype=np.float64)
+        wall = np.array([r[4] for r in rows], dtype=np.float64)
+        cp = np.array([r[5] for r in rows], dtype=np.float64)
+        return TableData(
+            "query_timeline",
+            Schema(base.schema.fields +
+                   (Field("seconds", DOUBLE),
+                    Field("wall_seconds", DOUBLE),
+                    Field("critical_path_seconds", DOUBLE))),
+            base.columns + [seconds, wall, cp])
+
+    def _metrics_history_table(self) -> TableData:
+        """The cluster flight recorder's federated time series
+        (server/telemetry.py) — one row per (timestamp, node, metric)
+        sample. Reading the table triggers a collection round so the
+        view is current even without the background federation thread."""
+        tel = getattr(self.state, "telemetry", None) if self.state \
+            else None
+        recs = []
+        if tel is not None:
+            try:
+                tel.collect()
+            except Exception:  # noqa: BLE001 — scrape is best-effort
+                pass
+            recs = tel.rows()
+        base = _strings_table(
+            "metrics_history",
+            [("node_id", [r[1] for r in recs]),
+             ("metric", [r[2] for r in recs])])
+        ts = np.array([r[0] for r in recs], dtype=np.float64)
+        value = np.array([r[3] for r in recs], dtype=np.float64)
+        return TableData(
+            "metrics_history",
+            Schema(base.schema.fields +
+                   (Field("ts", DOUBLE), Field("value", DOUBLE))),
+            base.columns + [ts, value])
 
     def _query_history_table(self) -> TableData:
         """The coordinator's persistent completed-query ring

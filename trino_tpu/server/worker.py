@@ -48,6 +48,10 @@ ROUTES = (
     ("POST", ("v1", "task", STAR), "_post_task", "internal"),
     ("DELETE", ("v1", "task", STAR), "_delete_task", "internal"),
     ("PUT", ("v1", "info", "state"), "_put_state", "internal"),
+    # flight-recorder scrape (server/telemetry.py): the coordinator
+    # federates worker rings from here. Internal: metric keys carry
+    # tenant/route labels a stranger shouldn't map
+    ("GET", ("v1", "telemetry"), "_get_telemetry", "internal"),
 )
 
 register_routes(SERVER_NAME, ROUTES)
@@ -147,6 +151,18 @@ class _WorkerHandler(BaseHTTPRequestHandler):
     def _get_metrics(self, parts, user):
         from ..metrics import REGISTRY
         self._send_text(200, REGISTRY.render())
+
+    # GET /v1/telemetry?since=<ts> — incremental flight-recorder scrape
+    def _get_telemetry(self, parts, user):
+        from urllib.parse import parse_qs, urlparse
+        try:
+            since = float(parse_qs(urlparse(self.path).query)
+                          .get("since", ["0"])[0])
+        except ValueError:
+            since = 0.0
+        rec = self.worker.telemetry
+        self._send(200, {"nodeId": self.worker.node_id,
+                         "samples": rec.since(since)})
 
     def _task_or_404(self, task_id: str):
         task = self.worker.task_manager.get(task_id)
@@ -298,7 +314,8 @@ class WorkerServer:
     def __init__(self, node_id: str, coordinator_uri: str, port: int = 0,
                  announce_interval_s: float = 1.0, catalog=None,
                  drain_timeout_s: float = 30.0,
-                 flush_grace_s: float = 1.0):
+                 flush_grace_s: float = 1.0,
+                 telemetry_interval_s: Optional[float] = None):
         self.node_id = node_id
         self.coordinator_uri = coordinator_uri
         self.state = "ACTIVE"
@@ -337,6 +354,11 @@ class WorkerServer:
         self._drain_thread: Optional[threading.Thread] = None
         self._drain_cancel = threading.Event()
         self._threads = []
+        # per-node flight recorder; interval<=0 (the default) records
+        # only on demand and spawns no sampler thread
+        from .telemetry import FlightRecorder
+        self.telemetry = FlightRecorder(node_id,
+                                        interval_s=telemetry_interval_s)
 
     def start(self) -> "WorkerServer":
         t1 = threading.Thread(target=self.httpd.serve_forever,
@@ -346,6 +368,7 @@ class WorkerServer:
                               name=f"announcer-{self.node_id}", daemon=True)
         t2.start()
         self._threads = [t1, t2]
+        self.telemetry.start()
         return self
 
     def announce_once(self, attempts: int = 5,
@@ -360,9 +383,13 @@ class WorkerServer:
 
         def post():
             from .security import internal_headers
+            # "now" lets the coordinator estimate this node's clock
+            # offset (announce RTT is sub-ms in-process, so the send
+            # stamp ~= receive time on a synchronized clock)
             body = json.dumps({"nodeId": self.node_id,
                                "uri": self.uri,
-                               "state": state or self.state}).encode()
+                               "state": state or self.state,
+                               "now": time.time()}).encode()
             req = Request(f"{self.coordinator_uri}/v1/announce", data=body,
                           headers={"Content-Type": "application/json",
                                    **internal_headers()})
@@ -506,6 +533,7 @@ class WorkerServer:
                 while self.state != "LEFT" and \
                         time.monotonic() < deadline:
                     time.sleep(0.02)
+        self.telemetry.stop()
         self._stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()

@@ -25,14 +25,18 @@ files, journals, or torn tables.
 """
 
 import json
+import logging
 import os
 import re
 import struct
 
 from ..metrics import (WRITE_COMMITS, WRITE_ORPHANS_SWEPT, WRITE_TASKS)
 from ..utils.atomicio import fsync_dir
+from ..utils.log import query_context
 from .failureinjector import WRITE_COMMIT, WRITE_PUBLISH, WRITE_STAGE
 from .pageserde import _crc32c
+
+log = logging.getLogger("trino_tpu.write")
 
 STAGING_DIR = ".staging"
 JOURNAL_MAGIC = b"TWJ1"
@@ -222,11 +226,16 @@ def dedup_manifests(manifests):
     return ordered, deduped
 
 
-def commit(table_dir: str, query_id: str, manifests, injector=None) -> dict:
+def commit(table_dir: str, query_id: str, manifests, injector=None,
+           tracer=None) -> dict:
     """Publish deduped staged files transactionally. The INTENT journal
     record (durable before any rename) is the commit point: recovery
     rolls the full rename set forward from it; without it, staged files
-    are swept. Idempotent per query id."""
+    are swept. Idempotent per query id. `tracer`, when given, nests
+    write-publish / write-sweep child spans under the caller's
+    write-commit span so the commit's phases show in the query trace."""
+    from ..utils.tracing import NOOP
+    tracer = tracer or NOOP
     chosen, deduped = dedup_manifests(manifests)
     tok = qtoken(query_id)
     if injector is not None:
@@ -252,21 +261,26 @@ def commit(table_dir: str, query_id: str, manifests, injector=None) -> dict:
                                      for f in files]},
                    injector=injector, key=query_id)
     # ---- point of no return: roll forward from here ----
-    for f in files:
-        if injector is not None:
-            injector.maybe_fail(WRITE_PUBLISH, f["dst"])
-        _publish_one(f["src"], f["dst"])
-    fsync_dir(table_dir)
-    append_journal(jpath, {"rec": "commit", "query": query_id})
-    sweep_query(table_dir, query_id)
-    try:
-        os.unlink(jpath)
-    except OSError:
-        pass
-    fsync_dir(table_dir)
+    with tracer.span("write-publish", files=len(files)):
+        for f in files:
+            if injector is not None:
+                injector.maybe_fail(WRITE_PUBLISH, f["dst"])
+            _publish_one(f["src"], f["dst"])
+        fsync_dir(table_dir)
+        append_journal(jpath, {"rec": "commit", "query": query_id})
+    with tracer.span("write-sweep"):
+        sweep_query(table_dir, query_id)
+        try:
+            os.unlink(jpath)
+        except OSError:
+            pass
+        fsync_dir(table_dir)
     WRITE_COMMITS.inc(outcome="committed")
+    rows = sum(f["rows"] for f in files)
+    log.info("%scommitted %d parts (%d rows, %d deduped) in %s",
+             query_context(query_id), len(files), rows, deduped, table_dir)
     return {"published": len(files), "deduped": deduped,
-            "rows": sum(f["rows"] for f in files),
+            "rows": rows,
             "bytes": sum(m["bytes"] for m in chosen),
             "phase": "committed"}
 
@@ -295,6 +309,8 @@ def abort(table_dir: str, query_id: str) -> None:
     if n:
         WRITE_ORPHANS_SWEPT.inc(n)
     WRITE_COMMITS.inc(outcome="aborted")
+    log.info("%saborted write: swept %d staging artifacts in %s",
+             query_context(query_id), n, table_dir)
 
 
 def sweep_query(table_dir: str, query_id: str) -> int:

@@ -153,16 +153,30 @@ class Tracer:
             with self._lock:
                 self.spans.append(s)
 
-    def adopt(self, span_dicts) -> None:
+    def adopt(self, span_dicts, offset_s: float = 0.0) -> None:
         """Merge remote spans (exported dicts shipped back in task
         results) into this trace. Spans from another trace id are kept
         too — a mis-stitched span is more diagnosable than a dropped
-        one."""
+        one.
+
+        `offset_s` is the remote node's estimated clock offset (remote
+        clock minus local clock, measured at announce time): remote
+        `startTimeUnixNano` stamps are rebased onto the local clock so
+        cross-node timeline intervals cannot go negative when a worker's
+        wall clock is skewed. Spans are copied, not mutated in place."""
         if not self.enabled or not span_dicts:
             return
+        adopted = []
+        for d in span_dicts:
+            if not isinstance(d, dict):
+                continue
+            if offset_s and "startTimeUnixNano" in d:
+                d = dict(d)
+                d["startTimeUnixNano"] = int(
+                    d["startTimeUnixNano"] - offset_s * 1e9)
+            adopted.append(d)
         with self._lock:
-            self._foreign.extend(d for d in span_dicts
-                                 if isinstance(d, dict))
+            self._foreign.extend(adopted)
 
     def export(self) -> List[dict]:
         with self._lock:
