@@ -1108,6 +1108,210 @@ def write_chaos_soak(n_seeds=None, out_path="BENCH_write_chaos.json"):
     return rec
 
 
+COORD_CHAOS_PHASES = ("QUEUED", "PLANNING", "RUNNING", "FINISHING",
+                      "WRITE_COMMIT")
+
+
+def coordinator_chaos_soak(n_seeds=None,
+                           out_path="BENCH_coordinator_chaos.json"):
+    """Seeded coordinator-kill soak (round 20 acceptance): for every
+    seed, bring up a primary + warm standby sharing one durable query
+    ledger and spool root plus two workers, submit a query through a
+    multi-address client, and kill the primary at a rotating lifecycle
+    phase (QUEUED / PLANNING / RUNNING / FINISHING / WRITE_COMMIT —
+    the write phase crashes the staged-write commit mid-flight so
+    exactly-once must hold across the failover). Promotion alternates
+    by seed parity between detector-driven and admin `PUT
+    /v1/info/state`. The client must finish every seed with bit-exact
+    rows and NO visible error: 0 wrong results, 0 lost rows, 0
+    duplicate rows. Emits BENCH_coordinator_chaos.json with
+    failover-to-first-result percentiles for the regression gate."""
+    import shutil as _shutil
+    import tempfile
+    import threading
+    from collections import Counter
+    from urllib.request import Request, urlopen
+
+    from trino_tpu.client.client import Client
+    from trino_tpu.connectors.orcdir import OrcConnector
+    from trino_tpu.exec.session import Session
+    from trino_tpu.metrics import COORDINATOR_FAILOVERS
+    from trino_tpu.server import ledger as led
+    from trino_tpu.server import writeprotocol as wp
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.failureinjector import (CRASH, DELAY,
+                                                  WRITE_COMMIT,
+                                                  FailureInjector)
+    from trino_tpu.server.security import internal_headers
+    from trino_tpu.server.worker import WorkerServer
+
+    n = n_seeds if n_seeds is not None else \
+        int(os.environ.get("TRINO_TPU_COORD_CHAOS_SEEDS", 20))
+    budget_s = float(os.environ.get("TRINO_TPU_COORD_CHAOS_BUDGET_S",
+                                    600))
+    t_start = time.monotonic()
+    read_sql = ("SELECT n_regionkey, count(*) AS c FROM nation "
+                "GROUP BY n_regionkey ORDER BY n_regionkey")
+    read_expect = [(0, 5), (1, 5), (2, 5), (3, 5), (4, 5)]
+    write_src = ("SELECT o_orderkey, o_custkey, o_orderstatus, "
+                 "o_totalprice FROM tpch.tiny.orders")
+    rec = {"metric": "coordinator_chaos", "seeds": 0,
+           "wrong_results": 0, "lost_rows": 0, "dup_rows": 0,
+           "client_errors": 0, "failovers": 0,
+           "detector_promotions": 0, "admin_promotions": 0,
+           "kills_by_phase": {}, "resumed_by_mode": {},
+           "budget_exhausted": False}
+    fo_walls = []
+    write_baseline = None
+    for seed in range(n):
+        if time.monotonic() - t_start > budget_s:
+            rec["budget_exhausted"] = True
+            break
+        phase = COORD_CHAOS_PHASES[seed % len(COORD_CHAOS_PHASES)]
+        admin = seed % 2 == 1           # else detector-driven
+        write_phase = phase == "WRITE_COMMIT"
+        root = tempfile.mkdtemp(prefix="coord_chaos_")
+        ledger = os.path.join(root, "query.ledger")
+        spool = os.path.join(root, "spool")
+        s1 = Session(default_schema="tiny")
+        s2 = Session(default_schema="tiny")
+        conn2 = None
+        if write_phase:
+            os.makedirs(os.path.join(root, "orc", "out"))
+            s1.catalog.register("orc", OrcConnector(
+                os.path.join(root, "orc")))
+            conn2 = OrcConnector(os.path.join(root, "orc"))
+            s2.catalog.register("orc", conn2)
+        primary = CoordinatorServer(s1, ledger_path=ledger,
+                                    node_id=f"p{seed}",
+                                    spool_root=spool).start()
+        standby = CoordinatorServer(s2, ledger_path=ledger,
+                                    node_id=f"s{seed}", role="standby",
+                                    peer_uri=primary.uri,
+                                    spool_root=spool,
+                                    standby_interval_s=0.1,
+                                    auto_promote=not admin).start()
+        workers = [WorkerServer(f"cc{seed}w{i}", primary.uri,
+                                announce_interval_s=0.1,
+                                catalog=s1.catalog).start()
+                   for i in range(2)]
+        deadline = time.time() + 10
+        while len(primary.state.active_nodes()) < 2 and \
+                time.time() < deadline:
+            time.sleep(0.02)
+        for w in workers:
+            w.announce_once()           # learn the standby address now
+        inj = FailureInjector(seed=seed)
+        if write_phase:
+            primary.state.scheduler.split_rows = 4096
+            primary.state.scheduler.failure_injector = inj
+            # the commit dies mid-flight on the (sealed) primary; the
+            # promoted standby re-executes and must dedup to one table
+            inj.inject(WRITE_COMMIT, times=1, fault=CRASH)
+            sql = f"CREATE TABLE orc.out.c{seed} AS {write_src}"
+        else:
+            primary.state.dispatcher.failure_injector = inj
+            if phase in ("RUNNING", "FINISHING"):
+                inj.inject("EXECUTION", times=1, fault=DELAY,
+                           delay_s=1.5, match_sql="n_regionkey")
+            sql = read_sql
+        client = Client([primary.uri, standby.uri],
+                        user=f"chaos{seed}", timeout_s=120)
+        out = {}
+
+        def run(client=client, sql=sql, out=out):
+            try:
+                out["r"] = client.execute(sql)
+            except Exception as e:  # noqa: BLE001 — the gate counts it
+                out["err"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        # kill when the primary's registry first shows the query at (or
+        # past) the target phase — a bounded watch, so late phases that
+        # flash by still get a kill near the boundary
+        target = "RUNNING" if write_phase else phase
+        observed = None
+        deadline = time.time() + 8
+        while time.time() < deadline and observed is None:
+            for tq in primary.state.tracker.all():
+                if led._rank(tq.state) >= led._rank(target):
+                    observed = tq.state
+                    break
+            if observed is None:
+                time.sleep(0.002)
+        if phase == "FINISHING" and observed == "RUNNING":
+            time.sleep(1.2)             # drift toward the boundary
+        t_kill = time.monotonic()
+        primary.kill()
+        rec["kills_by_phase"][phase] = \
+            rec["kills_by_phase"].get(phase, 0) + 1
+        if admin:
+            try:
+                req = Request(f"{standby.uri}/v1/info/state",
+                              data=json.dumps(
+                                  {"state": "PRIMARY"}).encode(),
+                              headers={"Content-Type":
+                                       "application/json",
+                                       **internal_headers()},
+                              method="PUT")
+                with urlopen(req, timeout=15):
+                    pass
+                rec["admin_promotions"] += 1
+            except Exception:  # noqa: BLE001 — client error will gate
+                pass
+        else:
+            rec["detector_promotions"] += 1
+        t.join(timeout=120)
+        rec["seeds"] += 1
+        if "r" not in out or t.is_alive():
+            rec["client_errors"] += 1
+        else:
+            r = out["r"]
+            fo_walls.append((time.monotonic() - t_kill) * 1000)
+            rec["failovers"] += r.failovers
+            if write_phase:
+                got = Counter(_chaos_rows(s2.execute(
+                    f"SELECT o_orderkey, o_custkey, o_orderstatus, "
+                    f"o_totalprice FROM orc.out.c{seed}").rows))
+                if write_baseline is None:
+                    write_baseline = Counter(
+                        _chaos_rows(s2.execute(write_src).rows))
+                rec["lost_rows"] += sum(
+                    (write_baseline - got).values())
+                rec["dup_rows"] += sum((got - write_baseline).values())
+            else:
+                if [tuple(x) for x in r.rows] != read_expect:
+                    rec["wrong_results"] += 1
+            tq = standby.state.tracker.get(r.query_id)
+            mode = getattr(tq, "resumed", None) if tq else None
+            if mode:
+                rec["resumed_by_mode"][mode] = \
+                    rec["resumed_by_mode"].get(mode, 0) + 1
+        for w in workers:
+            w.kill()
+        standby.kill()
+        for c in (primary, standby):
+            c.state.dispatcher.pool.shutdown(wait=False)
+        _shutil.rmtree(root, ignore_errors=True)
+    if fo_walls:
+        ws = sorted(fo_walls)
+        rec["failover_to_result_p50_ms"] = round(ws[len(ws) // 2], 1)
+        rec["failover_to_result_p99_ms"] = round(
+            ws[min(len(ws) - 1, int(len(ws) * 0.99))], 1)
+    rec["coordinator_failovers_total"] = COORDINATOR_FAILOVERS.value()
+    rec["elapsed_s"] = round(time.monotonic() - t_start, 1)
+    rec["passed"] = (rec["wrong_results"] == 0 and rec["lost_rows"] == 0
+                     and rec["dup_rows"] == 0
+                     and rec["client_errors"] == 0
+                     and rec["failovers"] >= rec["seeds"] > 0)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 def memory_pressure_soak(n_queries=None, out_path="BENCH_memory.json"):
     """Memory-pressure soak (round 9 acceptance): >= 20 concurrent
     queries against a 3-worker cluster with every executor pool clamped
@@ -1977,6 +2181,18 @@ def load_bench_round(path):
             if isinstance(d, dict) and "p50_ms" in d:
                 out[f"write_chaos_{point.lower()}_p50"] = float(d["p50_ms"])
         return out or None
+    if str(doc.get("metric", "")) == "coordinator_chaos":
+        # --coordinator-chaos rounds gate on the failover-to-first-
+        # result walls: a slower promotion/replay/resume path in a
+        # later round reads as a regressed coordinator_chaos_* config
+        # (correctness — wrong/lost/duplicate rows or client-visible
+        # errors — already hard-fails the soak itself)
+        out = {}
+        for pct in ("p50", "p99"):
+            ms = doc.get(f"failover_to_result_{pct}_ms")
+            if ms is not None:
+                out[f"coordinator_chaos_failover_{pct}"] = float(ms)
+        return out or None
     if str(doc.get("metric", "")) == "cold_start":
         # --cold-start rounds gate on the fresh-process cold wall AND
         # the cold/steady ratio per query: a compile-cache or prewarm
@@ -2157,6 +2373,11 @@ def build_parser():
                            "WRITE_STAGE/WRITE_COMMIT/WRITE_PUBLISH, "
                            "0 lost/0 dup rows + 0 orphans required -> "
                            "BENCH_write_chaos.json")
+    mode.add_argument("--coordinator-chaos", action="store_true",
+                      help="seeded coordinator-kill failover soak "
+                           "(primary + warm standby, kill at every "
+                           "query phase) -> BENCH_coordinator_chaos"
+                           ".json")
     mode.add_argument("--memory-pressure", action="store_true",
                       help="concurrent soak at 25%% pool -> "
                            "BENCH_memory.json")
@@ -2228,6 +2449,9 @@ def main(argv=None):
         return 0
     if args.write_chaos:
         rec = write_chaos_soak()
+        return 0 if rec["passed"] else 1
+    if args.coordinator_chaos:
+        rec = coordinator_chaos_soak()
         return 0 if rec["passed"] else 1
     if args.memory_pressure:
         memory_pressure_soak()
@@ -2305,6 +2529,17 @@ def main(argv=None):
                                              mad_k=args.mad_k)
             report["write_chaos"] = report8
             ok = ok and ok8
+        # the coordinator-failover trajectory gates as its own series
+        # (BENCH_coordinator_chaos.json + later rounds'
+        # BENCH_coordinator_chaos_r*.json): a slower failover-to-first-
+        # result wall in a later round fails here
+        cc_paths = sorted(_glob.glob("BENCH_coordinator_chaos*.json"))
+        if cc_paths:
+            ok9, report9 = check_regressions(cc_paths,
+                                             ratio=args.ratio,
+                                             mad_k=args.mad_k)
+            report["coordinator_chaos"] = report9
+            ok = ok and ok9
         # the cold-start trajectory gates as its own series
         # (BENCH_cold_r*.json): a regressed fresh-process cold wall or
         # cold/steady ratio in a later round fails here

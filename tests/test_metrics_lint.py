@@ -149,6 +149,12 @@ REQUIRED_FAMILIES = (
     "trino_tpu_critical_path_seconds",
     "trino_tpu_telemetry_samples_total",
     "trino_tpu_telemetry_ring_evictions_total",
+    # round-20 coordinator crash recovery: durable query ledger,
+    # warm-standby promotion, resumption accounting
+    "trino_tpu_coordinator_failovers_total",
+    "trino_tpu_ledger_records_total",
+    "trino_tpu_ledger_bytes",
+    "trino_tpu_queries_resumed_total",
 )
 
 

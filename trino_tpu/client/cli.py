@@ -46,10 +46,14 @@ class LocalBackend:
 class RemoteBackend:
     def __init__(self, uri: str, user: str):
         from .client import Client
+        # --server accepts a comma-separated coordinator list; polling
+        # fails over across it (client.py)
         self.client = Client(uri, user=user)
+        self.last_failovers = 0
 
     def execute(self, sql: str):
         r = self.client.execute(sql)
+        self.last_failovers = r.failovers
         return r.columns, r.rows
 
 
@@ -81,7 +85,13 @@ def repl(backend, inp=sys.stdin, out=sys.stdout) -> None:
             out.write(f"Query failed: {e}\n")
             continue
         render_table(columns, rows, out)
-        out.write(f"Elapsed: {time.monotonic() - t0:.2f}s\n\n")
+        summary = f"Elapsed: {time.monotonic() - t0:.2f}s"
+        fo = getattr(backend, "last_failovers", 0)
+        if fo:
+            # the query crossed coordinators mid-flight and still
+            # finished — worth telling the operator at the prompt
+            summary += f"  Failovers: {fo}"
+        out.write(summary + "\n\n")
 
 
 def main(argv=None) -> int:
@@ -97,6 +107,9 @@ def main(argv=None) -> int:
     if args.execute:
         columns, rows = backend.execute(args.execute.rstrip(";"))
         render_table(columns, rows)
+        fo = getattr(backend, "last_failovers", 0)
+        if fo:
+            sys.stdout.write(f"Failovers: {fo}\n")
         return 0
     repl(backend)
     return 0

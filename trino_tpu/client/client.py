@@ -30,14 +30,28 @@ class ClientResult:
     rows: List[list]
     state: str
     elapsed_ms: int = 0
+    # how many times this query's polling crossed to a different
+    # coordinator address (0 on the happy path; >=1 when a failover
+    # happened under the query without surfacing an error)
+    failovers: int = 0
 
 
 class Client:
-    def __init__(self, uri: str, user: str = "anonymous",
+    def __init__(self, uri, user: str = "anonymous",
                  poll_interval_s: float = 0.05, timeout_s: float = 300.0,
                  spooled: bool = False, password: Optional[str] = None,
                  traceparent: Optional[str] = None):
-        self.uri = uri.rstrip("/")
+        # `uri` accepts a single address, a comma-separated list, or a
+        # list/tuple — the failover address list. The first entry is
+        # the preferred coordinator; nextUri polling rewrites hosts
+        # across the list when one stops answering (the HA client's
+        # multi-host JDBC-URL pattern).
+        if isinstance(uri, str):
+            uris = [u for u in (p.strip() for p in uri.split(",")) if u]
+        else:
+            uris = [str(u) for u in uri]
+        self.uris = [u.rstrip("/") for u in uris]
+        self.uri = self.uris[0]
         self.user = user
         self.password = password   # X-Trino-Password credential
         self.poll_interval_s = poll_interval_s
@@ -48,6 +62,16 @@ class Client:
         # query's trace continues the CALLER's trace instead of rooting
         # a fresh one (utils/tracing.py parses it coordinator-side)
         self.traceparent = traceparent
+        # cumulative coordinator-address switches (per-query delta is
+        # reported on ClientResult.failovers)
+        self.failovers = 0
+        from ..server.retrypolicy import RetryPolicy
+        # the retry window must outlast a standby promotion (detector
+        # misses + ledger replay + worker re-announce), not just a
+        # connection blip — hence the deep attempt budget
+        self.retry_policy = RetryPolicy(base_delay_s=0.05,
+                                        max_delay_s=1.0, max_attempts=12,
+                                        name="client-failover")
 
     def _request(self, method: str, url: str,
                  body: Optional[bytes] = None) -> dict:
@@ -64,10 +88,73 @@ class Client:
             payload = resp.read()
         return json.loads(payload) if payload else {}
 
+    # -- coordinator failover ----------------------------------------------
+
+    @staticmethod
+    def _rewrite(url: str, base: str) -> str:
+        """Re-home a server-issued URI (nextUri, spooled segment) onto
+        `base` — the statement routes are identical on every coordinator
+        in the list, and a promoted standby resumes the query under the
+        same id/token path the dead primary issued."""
+        from urllib.parse import urlsplit, urlunsplit
+        b = urlsplit(base)
+        u = urlsplit(url)
+        return urlunsplit((b.scheme, b.netloc, u.path, u.query,
+                           u.fragment))
+
+    def _next_coordinator(self, failed: str) -> None:
+        """Rotate the polling target past `failed`; counts a failover
+        only when the address actually changes."""
+        if len(self.uris) < 2:
+            return
+        try:
+            i = self.uris.index(failed)
+        except ValueError:
+            i = -1
+        nxt = self.uris[(i + 1) % len(self.uris)]
+        if nxt != failed:
+            self.uri = nxt
+            self.failovers += 1
+
+    @staticmethod
+    def _retryable_http(e: HTTPError) -> bool:
+        """A coordinator that answers but cannot serve (a not-yet-
+        promoted standby's 503 COORDINATOR_UNAVAILABLE, a proxy's 502)
+        is a failover signal, not a query error."""
+        return e.code in (502, 503)
+
+    def _submit(self, sql: str) -> dict:
+        """POST the statement, failing over across the address list
+        ONLY on errors that guarantee nothing was admitted — a refused/
+        unreachable connection, or an explicit COORDINATOR_UNAVAILABLE
+        rejection. Once any coordinator has accepted the statement,
+        recovery happens on the idempotent nextUri GETs instead (a
+        re-POST would run the query twice)."""
+        delays = self.retry_policy.delays()
+        last: Optional[Exception] = None
+        for _ in range(self.retry_policy.max_attempts):
+            base = self.uri
+            try:
+                return self._request("POST", f"{base}/v1/statement",
+                                     sql.encode())
+            except HTTPError as e:
+                if not self._retryable_http(e):
+                    raise
+                last = e
+            except (OSError, http.client.HTTPException) as e:
+                last = e
+            self._next_coordinator(base)
+            d = next(delays, None)
+            if d is None:
+                break
+            time.sleep(d)
+        raise QueryError(f"no coordinator accepted the statement: {last}",
+                         "COORDINATOR_UNAVAILABLE")
+
     def execute(self, sql: str) -> ClientResult:
         """Submit and drain the nextUri chain to completion."""
-        doc = self._request("POST", f"{self.uri}/v1/statement",
-                            sql.encode())
+        failovers_at_start = self.failovers
+        doc = self._submit(sql)
         columns: List[str] = []
         rows: List[list] = []
         deadline = time.time() + self.timeout_s
@@ -82,15 +169,20 @@ class Client:
                 rows.extend(doc["data"])
             for seg in doc.get("segments", ()):
                 # spooled protocol: fetch each segment, then acknowledge
-                sdoc = self._request("GET", seg["uri"])
+                # (re-homed onto the current coordinator — spool storage
+                # is shared, so a promoted standby serves the same keys)
+                sdoc = self._request("GET",
+                                     self._rewrite(seg["uri"], self.uri))
                 rows.extend(sdoc["data"])
-                self._request("DELETE", seg["uri"])
+                self._request("DELETE",
+                              self._rewrite(seg["uri"], self.uri))
             next_uri = doc.get("nextUri")
             if next_uri is None:
                 return ClientResult(
                     doc.get("id", ""), columns, rows,
                     doc.get("stats", {}).get("state", "FINISHED"),
-                    doc.get("stats", {}).get("elapsedTimeMillis", 0))
+                    doc.get("stats", {}).get("elapsedTimeMillis", 0),
+                    failovers=self.failovers - failovers_at_start)
             if time.time() > deadline:
                 # cancel the server-side query BEFORE raising — a bare
                 # CLIENT_TIMEOUT used to leak the executing query (it
@@ -98,7 +190,8 @@ class Client:
                 # DELETE is best-effort so a dead coordinator can't mask
                 # the timeout error itself
                 try:
-                    self._request("DELETE", next_uri)
+                    self._request("DELETE",
+                                  self._rewrite(next_uri, self.uri))
                 except Exception:     # noqa: BLE001 — best-effort cancel
                     pass
                 raise QueryError("client timeout", "CLIENT_TIMEOUT")
@@ -108,20 +201,37 @@ class Client:
             doc = self._poll(next_uri)
 
     def _poll(self, next_uri: str) -> dict:
-        """One nextUri advance, tolerating a single transient connection
-        failure: a reset/refused/dropped connection mid-poll is retried
-        once after a short pause (nextUri GETs are idempotent — the
-        token pins the page), so a coordinator hiccup doesn't abort a
-        query that is still running fine. HTTP status errors are real
-        answers and propagate (StatementClientV1.advance retries the
-        same way)."""
-        try:
-            return self._request("GET", next_uri)
-        except HTTPError:
-            raise
-        except (OSError, http.client.HTTPException):
-            time.sleep(max(self.poll_interval_s, 0.05))
-            return self._request("GET", next_uri)
+        """One nextUri advance, retried with backoff through the
+        coordinator address list: a reset/refused/dropped connection or
+        an explicit COORDINATOR_UNAVAILABLE answer rotates the target
+        and re-issues the SAME uri against the next address (nextUri
+        GETs are idempotent — the token pins the page, and a promoted
+        standby resumes the query under the original id). The query
+        survives its coordinator dying mid-poll without surfacing an
+        error; HTTP status errors other than 502/503 are real answers
+        and propagate (StatementClientV1.advance retries the same
+        way)."""
+        delays = self.retry_policy.delays()
+        last: Optional[Exception] = None
+        for _ in range(self.retry_policy.max_attempts):
+            base = self.uri
+            try:
+                return self._request("GET",
+                                     self._rewrite(next_uri, base))
+            except HTTPError as e:
+                if not self._retryable_http(e):
+                    raise
+                last = e
+            except (OSError, http.client.HTTPException) as e:
+                last = e
+            self._next_coordinator(base)
+            d = next(delays, None)
+            if d is None:
+                break
+            time.sleep(max(d, self.poll_interval_s))
+        raise last if isinstance(last, HTTPError) else \
+            QueryError(f"lost every coordinator while polling: {last}",
+                       "COORDINATOR_UNAVAILABLE")
 
     def query_info(self, query_id: str) -> dict:
         return self._request("GET", f"{self.uri}/v1/query/{query_id}")

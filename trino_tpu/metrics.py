@@ -540,6 +540,27 @@ TENANT_QUERY_SECONDS = REGISTRY.histogram(
     buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
              15.0, 60.0))
 
+# coordinator crash recovery (server/ledger.py): durable query ledger,
+# warm-standby promotion, client-transparent query resumption
+COORDINATOR_FAILOVERS = REGISTRY.counter(
+    "trino_tpu_coordinator_failovers_total",
+    "Coordinator promotions completed (a standby or restarted node "
+    "claimed the ledger epoch and began accepting traffic)")
+LEDGER_RECORDS = REGISTRY.counter(
+    "trino_tpu_ledger_records_total",
+    "Records appended to the durable query ledger, by record kind",
+    ("kind",))
+LEDGER_BYTES = REGISTRY.gauge(
+    "trino_tpu_ledger_bytes",
+    "Current size of the durable query ledger file")
+QUERIES_RESUMED = REGISTRY.counter(
+    "trino_tpu_queries_resumed_total",
+    "Queries reconstructed from the ledger after a coordinator "
+    "restart/failover, by resumption mode: replayed (pre-execution "
+    "states re-run from admission), reattached (spooled/surviving task "
+    "output reused), reexecuted (re-run from scratch; writes dedup "
+    "through the commit journal)", ("mode",))
+
 # the labeled families acceptance scrapes: seed the hot label values so
 # a cold server's /v1/metrics already carries them at 0
 for _op in ("scan", "output"):
@@ -576,3 +597,8 @@ for _p in ("queued", "plan", "schedule", "exchange-wait", "device",
            "host", "compile", "spill", "retry", "write-commit", "other"):
     CRITICAL_PATH_SECONDS.init_labels(phase=_p)
 TENANT_QUERY_SECONDS.init_labels(tenant="default")
+for _k in ("admit", "state", "assign", "spool", "terminal", "catalog",
+           "promote"):
+    LEDGER_RECORDS.init_labels(kind=_k)
+for _m in ("replayed", "reattached", "reexecuted"):
+    QUERIES_RESUMED.init_labels(mode=_m)

@@ -47,7 +47,17 @@ ROUTES = (
     # secret (TRINO_TPU_INTERNAL_SECRET) so a rogue process with network
     # reach cannot join the cluster and absorb splits
     ("POST", ("v1", "announce"), "_post_announce", "internal"),
+    # buffered terminal-status push from workers: tasks that finished
+    # while the coordinator was unreachable re-deliver here after the
+    # next successful announce (possibly to a promoted standby)
+    ("POST", ("v1", "task-status"), "_post_task_status", "internal"),
     ("GET", ("v1", "info"), "_get_info", False),
+    # coordinator role probe (PRIMARY | PASSIVE | RECONCILING) — the
+    # health/ready surface a standby serves while tailing the ledger
+    ("GET", ("v1", "info", "state"), "_get_info_state", False),
+    # admin promotion (the coordinator mirror of the worker drain
+    # route): PUT {"state": "PRIMARY"} promotes a standby
+    ("PUT", ("v1", "info", "state"), "_put_info_state", "internal"),
     ("GET", ("v1", "status"), "_get_status", False),
     ("GET", ("v1", "metrics"), "_get_metrics", False),
     ("GET", ("v1", "jit"), "_get_jit", False),
@@ -130,6 +140,10 @@ class RegisteredNode:
         # worker spans are rebased by it so stitched-trace intervals
         # cannot go negative under skewed wall clocks
         self.clock_offset: float = 0.0
+        # live task inventory from the last announce ([{taskId, state}])
+        # — a promoted coordinator reconciles the ledger against this
+        # before deciding re-attach vs re-execute
+        self.tasks: Optional[list] = None
 
 
 class Dispatcher:
@@ -159,6 +173,10 @@ class Dispatcher:
         self.retry_policy = retry_policy  # NONE | QUERY
         self.max_retries = max_retries
         self.scheduler = None             # StageScheduler (cluster mode)
+        # durable query ledger (server/ledger.py): set by
+        # CoordinatorState when a ledger path is configured. None keeps
+        # the pre-failover behavior bit-for-bit (no appends, no fsyncs).
+        self.ledger = None
         from ..events import EventListenerManager
         self.event_listeners = EventListenerManager()
         from .resourcegroups import (ResourceGroupConfig,
@@ -184,11 +202,80 @@ class Dispatcher:
         qid = self.tracker.next_query_id()
         tq = TrackedQuery(qid, sql, user, QueryStateMachine(qid),
                           traceparent=traceparent)
+        return self._admit(tq)
+
+    def resume(self, q: dict, mode: str) -> TrackedQuery:
+        """Re-admit a non-terminal query reconstructed from the ledger,
+        under its ORIGINAL query id — the client's nextUri keeps working
+        against the resumed execution. `mode` is the resumption-mode
+        label: replayed (pre-execution), reattached (spooled output
+        survives), reexecuted (re-run; writes dedup via the commit
+        journal)."""
+        from ..metrics import QUERIES_RESUMED
+        qid = q["query_id"]
+        sm = QueryStateMachine(qid)
+        sm.adopt_times(q.get("state_times") or {})
+        tq = TrackedQuery(qid, q.get("sql") or "", q.get("user")
+                          or "anonymous", sm)
+        tq.resumed = mode
+        QUERIES_RESUMED.inc(mode=mode)
+        return self._admit(tq, resumed=True)
+
+    def restore_terminal(self, q: dict) -> TrackedQuery:
+        """Register a query the ledger shows as already terminal —
+        byte-for-byte state reconstruction (state, stamps, error
+        taxonomy, row/elapsed stats), with no listeners and no
+        re-execution: it already completed and already counted. A
+        restored FINISHED query carries result=None; the executing
+        route lazily re-executes on the first data poll."""
+        qid = q["query_id"]
+        sm = QueryStateMachine.restored(
+            qid, q["terminal"], q.get("state_times"),
+            error=q.get("error"),
+            error_name=q.get("error_name") or "GENERIC_INTERNAL_ERROR",
+            error_code=q.get("error_code") or 1)
+        tq = TrackedQuery(qid, q.get("sql") or "", q.get("user")
+                          or "anonymous", sm)
+        tq.tenant = q.get("tenant") or \
+            self.resource_groups.tenant_of(tq.session_user)
+        tq.rows_returned = q.get("rows") or 0
+        tq.elapsed_s = q.get("elapsed_s") or 0.0
+        tq.resumed = "restored"
+        self.tracker.register(tq)
+        return tq
+
+    def _admit(self, tq: TrackedQuery,
+               resumed: bool = False) -> TrackedQuery:
         # tenant = the principal's resource-group leaf; labels metrics,
         # history records, and audit events for per-tenant isolation
-        tq.tenant = self.resource_groups.tenant_of(user)
+        tq.tenant = self.resource_groups.tenant_of(tq.session_user)
         self.tracker.register(tq)
         self.event_listeners.query_created(tq)
+        led = self.ledger
+        if led is not None:
+            if not resumed:
+                # the admission record is durable BEFORE the client sees
+                # a query id: any id a client holds survives replay
+                from .history import plan_fingerprint
+                led.admit(tq.query_id, tq.sql, tq.session_user,
+                          tq.tenant, plan_fingerprint(tq.sql),
+                          getattr(self.session, "properties", {}))
+            sm_led = tq.state_machine
+
+            def on_ledger(state, _tq=tq, _sm=sm_led):
+                ts = _sm.state_times.get(state, time.time())
+                if state in ("FINISHED", "FAILED", "CANCELED"):
+                    led.terminal(
+                        _tq.query_id, state, ts, error=_sm.error,
+                        error_name=_sm.error_name,
+                        error_code=_sm.error_code,
+                        rows=_tq.rows_returned, elapsed_s=_tq.elapsed_s,
+                        catalog_version=getattr(self.session.catalog,
+                                                "version", 0))
+                else:
+                    led.state(_tq.query_id, state, ts)
+
+            sm_led.add_listener(on_ledger)
 
         def on_terminal(state):
             if state in ("FINISHED", "FAILED", "CANCELED"):
@@ -376,9 +463,52 @@ class Dispatcher:
         return (st.spilled_joins + st.spilled_aggregations +
                 st.spilled_sorts)
 
+    def _committed_write_result(self, tq: TrackedQuery):
+        """Exactly-once guard for resumed writes: if a pre-crash attempt
+        of this very query id already published parts (the commit
+        journal's INTENT was durable), return its committed result
+        instead of re-executing — re-running a committed CTAS locally
+        would double-write or trip on the existing table."""
+        import os as _os
+        from ..sql import ast_nodes as A
+        from ..sql.parser import parse
+        from . import writeprotocol as wp
+        try:
+            stmt = parse(tq.sql)
+        except Exception:  # noqa: BLE001 — not parseable here: let the
+            return None    # normal path raise the canonical error
+        if not isinstance(stmt, (A.CreateTable, A.InsertInto)) or \
+                getattr(stmt, "query", None) is None:
+            return None
+        try:
+            cat, sch, tbl = self.session.resolve_table(stmt.table)
+            conn = self.session.catalog.connector(cat)
+        except Exception:  # noqa: BLE001
+            return None
+        if not getattr(conn, "supports_staged_writes", False):
+            return None
+        table_dir = _os.path.abspath(conn._table_dir(sch, tbl))
+        already = wp.published_rows_for(table_dir, tq.query_id)
+        if already is None:
+            return None
+        wp.recover_table_dir(table_dir)
+        conn._cache.pop((sch, tbl), None)
+        self.session.catalog.bump_version()
+        self.session.executor.invalidate_scan_cache()
+        from ..exec.session import QueryResult
+        return QueryResult(["rows"], [(already,)], 0.0)
+
     def _execute_attempt_inner(self, tq: TrackedQuery, t0: float) -> None:
         result = None
         spills0 = self._spill_counter()
+        if getattr(tq, "resumed", None):
+            result = self._committed_write_result(tq)
+            if result is not None:
+                tq.elapsed_s = time.monotonic() - t0
+                tq.result = result
+                tq.rows_returned = len(result.rows)
+                return
+            result = None
         serving = getattr(self, "serving", None)
         if serving is not None:
             # FINISHED page straight from the result cache: no lock, no
@@ -447,7 +577,12 @@ class Dispatcher:
 class CoordinatorState:
     def __init__(self, session: Session, max_concurrency: int = 4,
                  retry_policy: str = "NONE",
-                 telemetry_interval_s: Optional[float] = None):
+                 telemetry_interval_s: Optional[float] = None,
+                 ledger_path: Optional[str] = None,
+                 node_id: str = "coordinator", role: str = "primary",
+                 peer_uri: Optional[str] = None,
+                 spool_root: Optional[str] = None):
+        import os
         self.session = session
         self.tracker = QueryTracker()
         self.dispatcher = Dispatcher(session, self.tracker, max_concurrency,
@@ -456,8 +591,47 @@ class CoordinatorState:
         self.nodes_lock = threading.Lock()
         self.failure_detector = None   # set by HeartbeatFailureDetector
         self.started_at = time.time()
+        # ---- coordinator crash recovery (server/ledger.py) ----
+        self.node_id = node_id
+        self.uri: Optional[str] = None      # set by CoordinatorServer
+        self.peer_uri = peer_uri
+        self.standbys: Dict[str, float] = {}   # standby uri -> last seen
+        self.task_reports: Dict[str, dict] = {}  # worker terminal push
+        self._promote_lock = threading.Lock()
+        self._reexec_lock = threading.Lock()
+        self._reexec_started: set = set()
+        ledger_path = ledger_path or os.environ.get(
+            "TRINO_TPU_LEDGER_PATH")
+        self.ledger = None
+        if ledger_path:
+            from .ledger import QueryLedger
+            self.ledger = QueryLedger(ledger_path, node_id=node_id)
+        self.dispatcher.ledger = self.ledger
+        # PRIMARY serves traffic; PASSIVE tails the ledger (a standby,
+        # or a fenced ex-primary); RECONCILING is the promotion window
+        if role == "standby":
+            self.role = "PASSIVE"
+        elif self.ledger is not None:
+            epoch, owner = self.ledger.read_epoch()
+            if epoch > 0 and owner != node_id:
+                # another instance holds the ledger epoch: a resurrected
+                # old primary must NOT split-brain — boot fenced
+                self.role = "PASSIVE"
+            else:
+                self.role = "PRIMARY"
+                self.ledger.claim_epoch()
+        else:
+            self.role = "PRIMARY"
         from .scheduler import StageScheduler
-        self.scheduler = StageScheduler(self, session)
+        # a durable spool root survives coordinator restarts: resumed
+        # queries re-attach to completed task output instead of
+        # re-running it (exchange_spool.py's durability contract)
+        spool_root = spool_root or os.environ.get("TRINO_TPU_SPOOL_ROOT")
+        spool = None
+        if spool_root:
+            from .exchange_spool import ExchangeSpool
+            spool = ExchangeSpool(root=spool_root)
+        self.scheduler = StageScheduler(self, session, spool=spool)
         self.dispatcher.scheduler = self.scheduler
         from .spooling import SpoolingManager
         self.spooling = SpoolingManager()
@@ -506,18 +680,179 @@ class CoordinatorState:
         # coordinator's state
         from .system_connector import SystemConnector
         session.catalog.register("system", SystemConnector(self))
+        # boot-time recovery: a primary with a ledger replays it before
+        # the HTTP server ever binds — queued/running queries resume
+        # under their original ids, terminal ones are restored
+        if self.role == "PRIMARY" and self.ledger is not None:
+            self._replay_ledger()
+
+    # ---- crash recovery / failover ---------------------------------------
+
+    def accepting(self) -> bool:
+        """May this coordinator serve statement traffic? PRIMARY only —
+        and a primary that lost the ledger epoch (a newer promotion
+        fenced it) demotes itself here, on the serving path, before it
+        can hand out state a newer primary owns."""
+        if self.role != "PRIMARY":
+            return False
+        if self.ledger is not None and not self.ledger.owns_epoch():
+            self.role = "PASSIVE"
+            return False
+        return True
+
+    def coordinator_uris(self) -> List[str]:
+        """The failover address list carried in announce responses:
+        this coordinator first, then every fresh standby."""
+        uris = [self.uri] if self.uri else []
+        cutoff = time.time() - 10.0
+        for u, seen in sorted(self.standbys.items()):
+            if seen >= cutoff and u not in uris:
+                uris.append(u)
+        if self.peer_uri and self.peer_uri not in uris:
+            uris.append(self.peer_uri)
+        return uris
+
+    def promote(self, reason: str = "admin",
+                wait_workers_s: float = 1.5) -> dict:
+        """Standby -> primary: claim the ledger epoch (fencing every
+        previous holder), wait briefly for workers to re-announce,
+        reconcile ledger state against live task inventories, sweep
+        orphaned spool/staging artifacts, then resume every
+        non-terminal query and start accepting traffic."""
+        from ..metrics import COORDINATOR_FAILOVERS
+        with self._promote_lock:
+            if self.role == "PRIMARY":
+                return {"role": self.role, "promoted": False}
+            self.role = "RECONCILING"
+            epoch = 0
+            if self.ledger is not None:
+                epoch = self.ledger.claim_epoch()
+            # workers re-announce to the standby address they learned
+            # from announce responses; give the first wave a moment so
+            # resumed queries can go distributed / re-attach
+            deadline = time.monotonic() + wait_workers_s
+            while not self.active_nodes() and \
+                    time.monotonic() < deadline:
+                time.sleep(0.02)
+            view = None
+            if self.ledger is not None:
+                view, _ = self.ledger.replay()
+                self._sweep_orphans(view)
+            self.memory_manager.on_promotion()
+            if view is not None:
+                self._replay_ledger(view)
+            self.role = "PRIMARY"
+            COORDINATOR_FAILOVERS.inc()
+            return {"role": "PRIMARY", "promoted": True, "epoch": epoch,
+                    "reason": reason}
+
+    def _replay_ledger(self, view=None) -> int:
+        """Fold the ledger into live coordinator state: catalog version,
+        terminal-query registry (with recorded stamps and error
+        taxonomy), and resumption of every non-terminal query. Safe to
+        run twice — already-tracked query ids are skipped, and the
+        view itself is an idempotent fold."""
+        if self.ledger is None:
+            return 0
+        if view is None:
+            view, _ = self.ledger.replay()
+        cat = self.session.catalog
+        while getattr(cat, "version", 0) < view.catalog_version:
+            cat.bump_version()
+        # fence the id namespace: never re-mint a sequence number the
+        # dead primary already issued (ids share the wall-second prefix)
+        for qid in view.queries:
+            parts = qid.split("_")
+            if len(parts) >= 3 and parts[2].isdigit():
+                self.tracker.reserve_seq(int(parts[2]))
+        resumed = 0
+        for qid, q in sorted(view.queries.items()):
+            if self.tracker.get(qid) is not None:
+                continue                 # already live: double replay
+            if q["terminal"] is not None:
+                self.dispatcher.restore_terminal(q)
+            else:
+                self.dispatcher.resume(q, self._resume_mode(q))
+                resumed += 1
+        return resumed
+
+    def _resume_mode(self, q: dict) -> str:
+        """Resumption-mode classification: pre-execution states replay
+        from admission; mid-execution queries re-attach when spooled
+        output or a surviving assigned task exists, else re-execute."""
+        if q["state"] in ("QUEUED", "PLANNING"):
+            return "replayed"
+        if q["spooled"]:
+            return "reattached"
+        live_tasks = set(self.task_reports)
+        with self.nodes_lock:
+            for n in self.nodes.values():
+                for t in getattr(n, "tasks", None) or ():
+                    tid = t.get("taskId") if isinstance(t, dict) else t
+                    if tid:
+                        live_tasks.add(tid)
+        if any(tid in live_tasks for tid in q["assigned"]):
+            return "reattached"
+        return "reexecuted"
+
+    def _sweep_orphans(self, view) -> None:
+        """Promotion-time hygiene: drop result-spool entries no live
+        query can claim, and roll forward / sweep staged-write state in
+        every staged-write catalog (a durable commit INTENT finishes
+        publishing; everything else is swept — re-executed writes then
+        dedup against the published parts)."""
+        keep = set()
+        for q in view.live():
+            keep.update(q["spooled"])
+        try:
+            self.scheduler.spool.sweep(keep=keep)
+        except Exception:  # noqa: BLE001 — sweep is best-effort hygiene
+            pass
+        from . import writeprotocol as wp
+        for conn in self.session.catalog._connectors.values():
+            root = getattr(conn, "root", None)
+            if root and getattr(conn, "supports_staged_writes", False):
+                try:
+                    wp.sweep_root(root)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def reexecute_restored(self, tq: TrackedQuery) -> TrackedQuery:
+        """A ledger-restored FINISHED query got polled for data it no
+        longer holds: re-run it under the original id. Reads are pure
+        (bit-exact result); writes short-circuit through the commit
+        journal's published parts. Triggered at most once per id."""
+        with self._reexec_lock:
+            if tq.query_id in self._reexec_started:
+                return self.tracker.get(tq.query_id) or tq
+            self._reexec_started.add(tq.query_id)
+        times = {k: v for k, v in tq.state_machine.state_times.items()
+                 if k not in ("FINISHED", "FAILED", "CANCELED")}
+        q = {"query_id": tq.query_id, "sql": tq.sql,
+             "user": tq.session_user, "state_times": times}
+        return self.dispatcher.resume(q, "reexecuted")
 
     def announce(self, node_id: str, uri: str,
                  state: str = "ACTIVE",
-                 now: Optional[float] = None) -> None:
+                 now: Optional[float] = None,
+                 tasks: Optional[list] = None) -> None:
         """Register/refresh a worker, honoring its reported lifecycle
         state. LEFT deregisters (the graceful mirror of a failure-
         detector eviction); DRAINING/DRAINED pull the node out of
         placement without the detector penalty; ACTIVE restores a node
         from a canceled drain (FAILED→ACTIVE recovery still goes
         through the detector-ratio gate). Any membership or state
-        change triggers an immediate cluster-memory re-arbitration."""
+        change triggers an immediate cluster-memory re-arbitration.
+
+        STANDBY announces come from a peer coordinator, not a worker:
+        they only refresh the failover address list. `tasks` is the
+        worker's live task inventory — the promoted coordinator's
+        reconciliation input."""
         from ..metrics import NODE_LIFECYCLE_TRANSITIONS
+        if state == "STANDBY":
+            if uri:
+                self.standbys[uri] = time.time()
+            return
         changed = False
         # clock-skew estimate: the worker stamped `now` at send time and
         # we read our clock at receive time — the send/recv midpoint of a
@@ -555,6 +890,9 @@ class CoordinatorState:
                         self._recovery_allowed(node_id):
                     node.state = "ACTIVE"    # recovered
                     changed = True
+            survivor = self.nodes.get(node_id)
+            if survivor is not None and tasks is not None:
+                survivor.tasks = tasks
         if changed:
             NODE_LIFECYCLE_TRANSITIONS.inc(state=state)
             # outside nodes_lock: tick() re-reads the inventory itself
@@ -636,6 +974,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _query_payload(self, tq: TrackedQuery, token: int) -> dict:
         """One protocol page: state + columns + data + nextUri while more."""
+        sm = tq.state_machine
+        if sm.is_done():
+            # terminal pages wait for the completion pipeline (event
+            # listeners, ledger terminal record, metrics) to finish, so
+            # the client's view of "done" is never ahead of the server's
+            sm.settled.wait(5.0)
         base = self._base()
         payload = {
             "id": tq.query_id,
@@ -647,7 +991,6 @@ class _Handler(BaseHTTPRequestHandler):
                 "rows": tq.rows_returned,
             },
         }
-        sm = tq.state_machine
         if sm.state == "FAILED":
             payload["error"] = {"message": sm.error,
                                 "errorCode": sm.error_code,
@@ -717,9 +1060,25 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         dispatch(self, "DELETE", ROUTES, SERVER_NAME)
 
+    def do_PUT(self):
+        dispatch(self, "PUT", ROUTES, SERVER_NAME)
+
+    def _unavailable(self) -> bool:
+        """503 on statement traffic while not PRIMARY — the retryable
+        signal the client's failover poll loop keys on."""
+        if self.state.accepting():
+            return False
+        self._send(503, {"error": {
+            "message": f"coordinator is {self.state.role}",
+            "errorName": "COORDINATOR_UNAVAILABLE",
+            "retryable": True}})
+        return True
+
     # -- routes -----------------------------------------------------------
 
     def _post_statement(self, parts, user):
+        if self._unavailable():
+            return
         sql = self._read_body()
         if not sql.strip():
             self._send(400, {"error": {"message": "empty statement"}})
@@ -730,11 +1089,50 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _post_announce(self, parts, user):
         body = json.loads(self._read_body() or "{}")
-        self.state.announce(body.get("nodeId", "unknown"),
-                            body.get("uri", ""),
-                            state=body.get("state", "ACTIVE"),
-                            now=body.get("now"))
+        st = self.state
+        st.announce(body.get("nodeId", "unknown"),
+                    body.get("uri", ""),
+                    state=body.get("state", "ACTIVE"),
+                    now=body.get("now"),
+                    tasks=body.get("tasks"))
+        # the failover contract: every announce response carries the
+        # coordinator address list (primary first, fresh standbys after)
+        # so workers and clients always know where to re-announce
+        resp = {"ok": True, "role": st.role,
+                "coordinators": st.coordinator_uris()}
+        if st.ledger is not None:
+            resp["epoch"] = st.ledger.read_epoch()[0]
+        self._send(202, resp)
+
+    def _post_task_status(self, parts, user):
+        # buffered terminal-status re-delivery from workers (possibly
+        # reports the old primary never saw) — reconciliation input
+        body = json.loads(self._read_body() or "{}")
+        tid = body.get("taskId")
+        if tid:
+            self.state.task_reports[tid] = body
         self._send(202, {"ok": True})
+
+    def _get_info_state(self, parts, user):
+        st = self.state
+        payload = {"state": st.role, "nodeId": st.node_id,
+                   "ready": st.role == "PRIMARY",
+                   "coordinators": st.coordinator_uris()}
+        if st.ledger is not None:
+            epoch, owner = st.ledger.read_epoch()
+            payload["epoch"] = epoch
+            payload["epochOwner"] = owner
+        self._send(200, payload)
+
+    def _put_info_state(self, parts, user):
+        body = json.loads(self._read_body() or "{}")
+        want = str(body.get("state", "")).upper()
+        if want in ("PRIMARY", "ACTIVE"):
+            self._send(200, self.state.promote(reason="admin"))
+            return
+        self._send(400, {"error": {
+            "message": f"unsupported coordinator state {want!r} "
+                       f"(PUT PRIMARY/ACTIVE to promote)"}})
 
     def _get_info(self, parts, user):
         self._send(200, {
@@ -872,12 +1270,19 @@ class _Handler(BaseHTTPRequestHandler):
                          "samples": rec.since(since)})
 
     def _get_executing(self, parts, user):
+        if self._unavailable():
+            return
         qid = parts[3]
         token = int(parts[4]) if len(parts) > 4 else 0
         tq = self.state.tracker.get(qid)
         if tq is None:
             self._send(404, {"error": {"message": "unknown query"}})
             return
+        if tq.state_machine.state == "FINISHED" and tq.result is None:
+            # ledger-restored FINISHED query without its result pages:
+            # re-run under the original id (pure reads are bit-exact;
+            # writes short-circuit on the published commit)
+            tq = self.state.reexecute_restored(tq)
         # long-poll lite: give the dispatcher a moment before answering
         # (ExecutingStatementResource waits up to ~1s the same way)
         deadline = time.time() + 0.5
@@ -902,15 +1307,29 @@ class CoordinatorServer:
 
     def __init__(self, session: Optional[Session] = None, port: int = 0,
                  max_concurrency: int = 4, retry_policy: str = "NONE",
-                 telemetry_interval_s: Optional[float] = None):
+                 telemetry_interval_s: Optional[float] = None,
+                 ledger_path: Optional[str] = None,
+                 node_id: str = "coordinator", role: str = "primary",
+                 peer_uri: Optional[str] = None,
+                 spool_root: Optional[str] = None,
+                 standby_interval_s: float = 0.25,
+                 auto_promote: bool = True):
         self.state = CoordinatorState(session or Session(),
                                       max_concurrency, retry_policy,
-                                      telemetry_interval_s)
+                                      telemetry_interval_s,
+                                      ledger_path=ledger_path,
+                                      node_id=node_id, role=role,
+                                      peer_uri=peer_uri,
+                                      spool_root=spool_root)
         handler = type("BoundHandler", (_Handler,), {"state": self.state})
         self.httpd = ClusterHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
         self.uri = f"http://127.0.0.1:{self.port}"
+        self.state.uri = self.uri
         self._thread: Optional[threading.Thread] = None
+        self._watcher = None
+        self._standby_interval_s = standby_interval_s
+        self._auto_promote = auto_promote
 
     def start(self) -> "CoordinatorServer":
         self._thread = threading.Thread(target=self.httpd.serve_forever,
@@ -919,11 +1338,46 @@ class CoordinatorServer:
         self._thread.start()
         # no-op unless a telemetry interval is configured
         self.state.telemetry.start()
+        # warm standby: announce ourselves to the primary (so announce
+        # responses carry our address), tail the ledger, and promote on
+        # primary death (detector-driven) — failuredetector.py
+        if self.state.role != "PRIMARY" and self.state.peer_uri:
+            from .failuredetector import StandbyWatcher
+            self._watcher = StandbyWatcher(
+                self.state, self.uri, self.state.peer_uri,
+                interval_s=self._standby_interval_s,
+                auto_promote=self._auto_promote)
+            self._watcher.start()
         return self
 
     def stop(self) -> None:
+        if self._watcher is not None:
+            self._watcher.stop()
         self.state.telemetry.stop()
-        self.httpd.shutdown()
+        # shutdown() blocks until serve_forever acknowledges — which
+        # never happens if start() was never called, so only wave at a
+        # loop that actually exists
+        if self._thread is not None:
+            self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+
+    def kill(self) -> None:
+        """Crash model (the coordinator twin of WorkerServer.kill):
+        stop serving instantly with no drain or goodbye, and seal the
+        ledger so the dead instance can never append another record —
+        in-flight dispatch threads keep running but their world is
+        write-protected, exactly like a machine losing power."""
+        if self.state.ledger is not None:
+            self.state.ledger.seal()
+        self.state.role = "PASSIVE"
+        if self._watcher is not None:
+            self._watcher.stop()
+        self.state.telemetry.stop()
+        try:
+            if self._thread is not None:
+                self.httpd.shutdown()
+            self.httpd.server_close()
+        except Exception:  # noqa: BLE001 — dying twice is fine
+            pass

@@ -136,3 +136,32 @@ class ExchangeSpool:
                     os.unlink(os.path.join(self.root, f))
                 except OSError:
                     pass
+
+    def sweep(self, keep=()) -> int:
+        """Orphan sweep for a durable spool root after a coordinator
+        failover: drop every container whose work key no live (ledger-
+        known, non-terminal) query can claim. Returns the number of
+        containers removed; leftover .tmp files from a crashed writer
+        are always swept."""
+        keep = set(keep)
+        removed = 0
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return 0
+        for f in names:
+            path = os.path.join(self.root, f)
+            if f.endswith(".tmp"):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            if not f.endswith(".spool") or f[:-len(".spool")] in keep:
+                continue
+            try:
+                os.unlink(path)
+                removed += 1
+            except OSError:
+                pass
+        return removed

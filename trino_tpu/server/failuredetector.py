@@ -132,3 +132,83 @@ class HeartbeatFailureDetector:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+
+
+class StandbyWatcher:
+    """Standby-coordinator side of failover: the warm loop that (a)
+    announces the standby to the primary with state=STANDBY so every
+    announce response carries the failover address list, (b) tails the
+    ledger to keep a warm replay view, and (c) counts consecutive
+    probe failures against the primary — `fail_after` misses in a row
+    is the detector-driven promotion trigger (`promote(reason=
+    "detector")`). Admin promotion via PUT /v1/info/state works whether
+    or not this watcher is running."""
+
+    def __init__(self, state: CoordinatorState, own_uri: str,
+                 primary_uri: str, interval_s: float = 0.25,
+                 fail_after: int = 3, auto_promote: bool = True):
+        self.state = state
+        self.own_uri = own_uri
+        self.primary_uri = primary_uri
+        self.interval_s = interval_s
+        self.fail_after = fail_after
+        self.auto_promote = auto_promote
+        self.failures = 0
+        self.records_seen = 0
+        self._tail_off = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self) -> "StandbyWatcher":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="standby-watcher",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def probe_once(self) -> bool:
+        """One announce-as-probe round trip to the primary."""
+        from urllib.request import Request
+        from .security import internal_headers
+        body = json.dumps({"nodeId": self.state.node_id,
+                           "uri": self.own_uri, "state": "STANDBY",
+                           "now": time.time()}).encode()
+        req = Request(f"{self.primary_uri}/v1/announce", data=body,
+                      headers={"Content-Type": "application/json",
+                               **internal_headers()}, method="POST")
+        try:
+            with urlopen(req, timeout=2.0):
+                pass
+            return True
+        except Exception:  # noqa: BLE001 — any probe error is a miss
+            return False
+
+    def tail_ledger(self) -> None:
+        """Consume newly-durable ledger records so promotion starts
+        from a warm view (the full replay at promote() is idempotent
+        on top of this — the tail is a latency optimization and a
+        liveness signal, never a correctness dependency)."""
+        led = self.state.ledger
+        if led is None:
+            return
+        recs, self._tail_off = led.tail_records(self._tail_off)
+        self.records_seen += len(recs)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if self.state.role == "PRIMARY":
+                return                      # promoted out from under us
+            if self.probe_once():
+                self.failures = 0
+            else:
+                self.failures += 1
+            self.tail_ledger()
+            if self.auto_promote and self.failures >= self.fail_after:
+                self.state.promote(reason="detector")
+                return
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

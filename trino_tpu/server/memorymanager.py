@@ -116,6 +116,19 @@ class ClusterMemoryManager:
         except Exception:    # noqa: BLE001 — arbitration must not fail
             pass             # the announce that triggered it
 
+    def on_promotion(self) -> None:
+        """Failover re-arbitration: a promoted coordinator inherits no
+        heartbeat-reported pool snapshots — every worker's `memory`
+        view is stale-from-birth until its first announce lands here.
+        Drop inherited per-node reports and re-arbitrate against
+        whatever the re-announce wave has delivered so far, so the
+        first post-failover admission decision never trusts numbers
+        recorded by the dead primary."""
+        with self.state.nodes_lock:
+            for n in self.state.nodes.values():
+                n.memory = None
+        self.on_membership_change()
+
     def _note_membership(self) -> None:
         with self.state.nodes_lock:
             sig = tuple(sorted((n.node_id, n.state)

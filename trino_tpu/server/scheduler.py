@@ -337,6 +337,30 @@ class StageScheduler:
         # into its critical-path line. None under session-local use.
         self.tracked_lookup = None
 
+    # -- durable query ledger hooks ---------------------------------------
+
+    def _ledger_assign(self, task) -> None:
+        """Record a task/stage assignment in the coordinator's durable
+        query ledger (server/ledger.py) — the promoted coordinator
+        reconciles these against live worker task inventories to decide
+        re-attach vs re-execute. No-op without a ledger."""
+        led = getattr(self.state, "ledger", None)
+        qid = (self.last_query or {}).get("query_id")
+        if led is None or not qid:
+            return
+        led.assign(qid, task.task_id, task.node.node_id,
+                   self._current_stage)
+
+    def _ledger_spool(self, key: str) -> None:
+        """Record a result-spool pointer: after a failover, spooled
+        output keyed here lets a resumed query re-attach instead of
+        re-running the work."""
+        led = getattr(self.state, "ledger", None)
+        qid = (self.last_query or {}).get("query_id")
+        if led is None or not qid:
+            return
+        led.spool(qid, key)
+
     # -- per-query observability rollup -----------------------------------
 
     def _tracer(self):
@@ -723,6 +747,7 @@ class StageScheduler:
                                       injector=self.failure_injector,
                                       traceparent=traceparent)
                     task.start()
+                    self._ledger_assign(task)
                     self.stats["tasks"] += 1
                     SCHED_TASKS.inc()
                     src_tasks.append(task)
@@ -749,6 +774,7 @@ class StageScheduler:
                                       injector=self.failure_injector,
                                       traceparent=traceparent)
                     task.start()
+                    self._ledger_assign(task)
                     self.stats["tasks"] += 1
                     SCHED_TASKS.inc()
                     return task
@@ -1224,6 +1250,7 @@ class StageScheduler:
             losers: List[RemoteTask] = []
             try:
                 task.start()
+                self._ledger_assign(task)
                 self.stats["tasks"] += 1
                 SCHED_TASKS.inc()
                 drained = task.drain(deadline)
@@ -1325,6 +1352,7 @@ class StageScheduler:
                 pages.extend(got)
                 if use_spool:
                     self.spool.put(u.key, got)
+                    self._ledger_spool(u.key)
                 if winner is not None:
                     # TaskStats + worker spans ride the terminal status —
                     # fetched HERE (main thread, before the stage
@@ -1481,6 +1509,7 @@ class StageScheduler:
                                   injector=self.failure_injector,
                                   traceparent=traceparent)
                 task.start()
+                self._ledger_assign(task)
                 self.stats["tasks"] += 1
                 SCHED_TASKS.inc()
                 tasks.append(task)
@@ -1511,6 +1540,7 @@ class StageScheduler:
                               injector=self.failure_injector,
                               traceparent=traceparent)
             task.start()
+            self._ledger_assign(task)
             self.stats["tasks"] += 1
             SCHED_TASKS.inc()
             c_tasks.append(task)

@@ -28,6 +28,11 @@ class QueryStateMachine:
         self._state = "QUEUED"
         self._lock = threading.Lock()
         self._listeners: List[Callable[[str], None]] = []
+        # set once the terminal transition's listeners have all run:
+        # the protocol layer holds a terminal page until then, so a
+        # fast-polling client can never observe FINISHED before the
+        # completion pipeline (events, ledger record, metrics) fires
+        self.settled = threading.Event()
         self.error: Optional[str] = None
         # error taxonomy (the reference's ErrorCode): user errors like
         # QUERY_EXCEEDED_MEMORY carry their own name/code so clients can
@@ -62,8 +67,12 @@ class QueryStateMachine:
             if new_state in TERMINAL:
                 self.ended_at = time.time()
             to_fire = list(self._listeners)
-        for fn in to_fire:
-            fn(new_state)
+        try:
+            for fn in to_fire:
+                fn(new_state)
+        finally:
+            if new_state in TERMINAL:
+                self.settled.set()
         return True
 
     def fail(self, message: str,
@@ -79,8 +88,11 @@ class QueryStateMachine:
             self.state_times.setdefault("FAILED", time.time())
             self.ended_at = time.time()
             to_fire = list(self._listeners)
-        for fn in to_fire:
-            fn("FAILED")
+        try:
+            for fn in to_fire:
+                fn("FAILED")
+        finally:
+            self.settled.set()
         return True
 
     def cancel(self) -> bool:
@@ -88,17 +100,71 @@ class QueryStateMachine:
             if self._state in TERMINAL:
                 return False
             self._state = "CANCELED"
+            # stamped exactly like FAILED above, and carrying the same
+            # error taxonomy the payload serves — so timeline
+            # attribution and ledger replay treat canceled and failed
+            # queries identically
             self.state_times.setdefault("CANCELED", time.time())
             self.error = "Query was canceled"
+            self.error_name = "USER_CANCELED"
+            self.error_code = 2
             self.ended_at = time.time()
             to_fire = list(self._listeners)
-        for fn in to_fire:
-            fn("CANCELED")
+        try:
+            for fn in to_fire:
+                fn("CANCELED")
+        finally:
+            self.settled.set()
         return True
 
     def add_listener(self, fn: Callable[[str], None]) -> None:
         with self._lock:
             self._listeners.append(fn)
+
+    def adopt_times(self, times: Dict[str, float]) -> None:
+        """Merge recorded state-entry stamps (ledger replay): earliest
+        wins per state, so a resumed query's queued/plan attribution
+        spans from its ORIGINAL admission, not from the resume."""
+        with self._lock:
+            for st, ts in (times or {}).items():
+                if st not in ORDER:
+                    continue
+                cur = self.state_times.get(st)
+                if cur is None or ts < cur:
+                    self.state_times[st] = ts
+            q0 = self.state_times.get("QUEUED")
+            if q0 is not None and q0 < self.created_at:
+                self.created_at = q0
+
+    @classmethod
+    def restored(cls, query_id: str, state: str,
+                 state_times: Optional[Dict[str, float]] = None,
+                 error: Optional[str] = None,
+                 error_name: str = "GENERIC_INTERNAL_ERROR",
+                 error_code: int = 1) -> "QueryStateMachine":
+        """Rebuild a state machine from ledger records. Recorded stamps
+        land in state_times byte-for-byte as the live transitions set
+        them — FAILED and CANCELED included — so post-replay timeline
+        attribution sums exactly as it did before the crash."""
+        sm = cls(query_id)
+        sm.adopt_times(state_times or {})
+        sm._state = state if state in ORDER else "FAILED"
+        if sm._state in TERMINAL:
+            sm.ended_at = sm.state_times.get(sm._state) or time.time()
+            sm.state_times.setdefault(sm._state, sm.ended_at)
+            if sm._state == "FAILED":
+                sm.error = error or "Query failed before coordinator " \
+                                    "restart"
+                sm.error_name = error_name
+                sm.error_code = error_code
+            elif sm._state == "CANCELED":
+                sm.error = error or "Query was canceled"
+                sm.error_name = "USER_CANCELED"
+                sm.error_code = 2
+            # terminal from birth: there is no completion pipeline to
+            # wait for, so the protocol layer must not block on it
+            sm.settled.set()
+        return sm
 
 
 @dataclass
@@ -174,6 +240,15 @@ class QueryTracker:
             self._seq += 1
             # Trino ids look like 20240101_000000_00000_abcde
             return time.strftime("%Y%m%d_%H%M%S") + f"_{self._seq:05d}_tpu"
+
+    def reserve_seq(self, seq: int) -> None:
+        """Advance the id sequence past `seq`. A promoted coordinator
+        calls this with the highest sequence found in the replayed
+        ledger: its ids are minted by a FRESH counter in the same
+        wall-second format, so without the bump a sub-second failover
+        could re-issue an id the dead primary already handed out."""
+        with self._lock:
+            self._seq = max(self._seq, seq)
 
     def register(self, q: TrackedQuery) -> None:
         with self._lock:

@@ -257,6 +257,11 @@ class TaskManager:
         self._lock = threading.Lock()
         self.injector = injector          # FailureInjector hook
         self.tasks_run = 0                # observability counter
+        # terminal-status push hook (WorkerServer wires it): fired once
+        # from the task thread when a task reaches FINISHED/FAILED/
+        # CANCELED, after stats finalize — the worker-initiated half of
+        # status delivery that survives a coordinator failover
+        self.on_terminal = None
         # exchange backpressure: per-task output-buffer byte bound — a
         # slow consumer pauses the producer instead of ballooning the
         # worker's memory (PartitionedOutputBuffer's max-buffered-bytes)
@@ -313,6 +318,15 @@ class TaskManager:
         with self._lock:
             return [t.task_id for t in self.tasks.values()
                     if t.state in ("PENDING", "RUNNING")]
+
+    def inventory(self) -> List[dict]:
+        """Compact id/state list of every task this worker holds. Rides
+        each announce body so a promoted coordinator can reconcile its
+        ledger-replayed task assignments against what actually survived
+        the old primary's death."""
+        with self._lock:
+            return [{"taskId": t.task_id, "state": t.state}
+                    for t in self.tasks.values()]
 
     def unflushed(self) -> List[str]:
         """Ids of finished tasks whose output buffers still hold
@@ -601,6 +615,13 @@ class TaskManager:
             # completed; success paths already finalized pre-transition
             if not task.stats:
                 self._finalize_stats(task, tracer, t_start, op_agg)
+            cb = self.on_terminal
+            if cb is not None and task.state in ("FINISHED", "FAILED",
+                                                 "CANCELED"):
+                try:
+                    cb(task)
+                except Exception:  # noqa: BLE001 — push is best-effort;
+                    pass           # the status long-poll still works
 
     # -- exchange consumer: worker<->worker partitioned shuffle ------------
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.request import Request, urlopen
@@ -318,6 +319,16 @@ class WorkerServer:
                  telemetry_interval_s: Optional[float] = None):
         self.node_id = node_id
         self.coordinator_uri = coordinator_uri
+        # coordinator failover address list: seeded with the boot uri,
+        # refreshed from every announce response (the serving
+        # coordinator echoes itself + its standbys), rotated through
+        # when a full announce round fails — this is how a worker finds
+        # the promoted standby after the primary dies without a goodbye
+        self.coordinators = [coordinator_uri]
+        self._coord_lock = threading.Lock()
+        # terminal task reports the coordinator couldn't take (dead or
+        # mid-failover); re-delivered after the next successful announce
+        self._pending_reports: deque = deque(maxlen=256)
         self.state = "ACTIVE"
         self.drain_timeout_s = drain_timeout_s
         # bounded wait for FINISHED tasks' unpulled output buffers
@@ -342,6 +353,7 @@ class WorkerServer:
         from .tasks import TaskManager
         self.catalog = catalog if catalog is not None else default_catalog()
         self.task_manager = TaskManager(self.catalog, node_id=node_id)
+        self.task_manager.on_terminal = self._task_terminal
         handler = type("BoundWorkerHandler", (_WorkerHandler,),
                        {"worker": self})
         from .coordinator import ClusterHTTPServer
@@ -385,22 +397,90 @@ class WorkerServer:
             from .security import internal_headers
             # "now" lets the coordinator estimate this node's clock
             # offset (announce RTT is sub-ms in-process, so the send
-            # stamp ~= receive time on a synchronized clock)
+            # stamp ~= receive time on a synchronized clock); the task
+            # inventory lets a freshly-promoted coordinator reconcile
+            # ledger-assigned work against what actually survived here
             body = json.dumps({"nodeId": self.node_id,
                                "uri": self.uri,
                                "state": state or self.state,
-                               "now": time.time()}).encode()
+                               "now": time.time(),
+                               "tasks":
+                                   self.task_manager.inventory()}).encode()
             req = Request(f"{self.coordinator_uri}/v1/announce", data=body,
                           headers={"Content-Type": "application/json",
                                    **internal_headers()})
-            with urlopen(req, timeout=5):
-                pass
+            with urlopen(req, timeout=5) as r:
+                try:
+                    resp = json.loads(r.read().decode())
+                except ValueError:
+                    resp = {}
+            self._adopt_coordinators(resp.get("coordinators"))
 
         RetryPolicy(base_delay_s=0.1, max_delay_s=1.0,
                     max_attempts=max(1, attempts),
                     name="announce").call(
             post, retry_on=(OSError,),
             sleep=lambda d: self._stop.wait(d))
+        # the announce landed, so the coordinator at this address is
+        # alive: drain any terminal reports it (or its dead predecessor)
+        # missed
+        self._flush_reports()
+
+    def _adopt_coordinators(self, uris) -> None:
+        """Refresh the failover address list from an announce response
+        (serving coordinator first, standbys after). The current target
+        is kept while still listed so the worker doesn't flap between
+        equally-healthy addresses."""
+        if not uris:
+            return
+        with self._coord_lock:
+            self.coordinators = list(dict.fromkeys(uris))
+            if self.coordinator_uri not in self.coordinators:
+                self.coordinator_uri = self.coordinators[0]
+
+    def _rotate_coordinator(self) -> None:
+        """Point announces at the next address after a failed round."""
+        with self._coord_lock:
+            if len(self.coordinators) < 2:
+                return
+            try:
+                i = self.coordinators.index(self.coordinator_uri)
+            except ValueError:
+                i = -1
+            self.coordinator_uri = self.coordinators[
+                (i + 1) % len(self.coordinators)]
+
+    # -- terminal-status delivery ------------------------------------------
+
+    def _task_terminal(self, task) -> None:
+        """Push a task's final report the moment it completes. An
+        undeliverable report — coordinator dead or mid-failover — is
+        buffered and re-delivered after the next successful announce
+        instead of dropped, so a promoted coordinator hears about work
+        that finished while nobody was listening."""
+        report = self.task_manager.status_json(task)
+        if not self._post_report(report):
+            self._pending_reports.append(report)
+
+    def _post_report(self, report: dict) -> bool:
+        from .security import internal_headers
+        body = json.dumps(report).encode()
+        req = Request(f"{self.coordinator_uri}/v1/task-status", data=body,
+                      headers={"Content-Type": "application/json",
+                               **internal_headers()})
+        try:
+            with urlopen(req, timeout=5):
+                pass
+            return True
+        except Exception:  # noqa: BLE001 — buffered for re-delivery
+            return False
+
+    def _flush_reports(self) -> None:
+        while self._pending_reports:
+            report = self._pending_reports.popleft()
+            if not self._post_report(report):
+                self._pending_reports.appendleft(report)
+                return
 
     def prewarm_handshake(self) -> bool:
         """Pull the coordinator's warm-manifest and compile the
@@ -433,7 +513,9 @@ class WorkerServer:
             try:
                 self.announce_once()
             except Exception:
-                pass                      # coordinator down: keep trying
+                # coordinator down: rotate to the next address in the
+                # failover list for the following round and keep trying
+                self._rotate_coordinator()
             self._stop.wait(self.announce_interval_s)
 
     # -- lifecycle state machine -------------------------------------------
@@ -535,7 +617,10 @@ class WorkerServer:
                     time.sleep(0.02)
         self.telemetry.stop()
         self._stop.set()
-        self.httpd.shutdown()
+        # shutdown() handshakes with serve_forever — skip it when
+        # start() was never called or it would block forever
+        if self._threads:
+            self.httpd.shutdown()
         self.httpd.server_close()
 
     def kill(self) -> None:
