@@ -1739,6 +1739,7 @@ def elastic_soak(duration_s=None, out_path="BENCH_soak.json"):
     sched.hedge_min_s, sched.hedge_multiplier = 0.5, 2.0
     workers = [WorkerServer(f"soak-w{i}", coord.uri,
                             announce_interval_s=0.1,
+                            heartbeat_interval_s=0.1,
                             catalog=session.catalog,
                             drain_timeout_s=60.0,
                             telemetry_interval_s=tel_interval).start()
@@ -1847,6 +1848,7 @@ def elastic_soak(duration_s=None, out_path="BENCH_soak.json"):
         if w3 is None and now >= join_at:
             w3 = WorkerServer("soak-w3", coord.uri,
                               announce_interval_s=0.1,
+                              heartbeat_interval_s=0.1,
                               catalog=session.catalog,
                               telemetry_interval_s=tel_interval).start()
             workers.append(w3)
@@ -1946,7 +1948,37 @@ def elastic_soak(duration_s=None, out_path="BENCH_soak.json"):
             "queries": len(vals),
             "p50_ms": round(_percentile(vals, 0.50), 1) if vals else 0.0,
             "p99_ms": p99, "slo_ms": slo_ms[tname], "slo_ok": ok}
+    # --- host/device utilization over the soak (round-21): per-interval
+    # deltas of the cumulative busy counter (trino_tpu_node_busy_ms_total)
+    # out of the flight-recorder ring, normalized to a fleet-wide busy
+    # fraction. The counter form is what works here: the in-process fleet
+    # shares one registry, so the instantaneous busy-fraction gauge is
+    # last-writer-wins across workers, while counter increments from
+    # every worker accumulate — the recorder's delta encoding then yields
+    # exactly the busy-ms each interval saw
+    fam_busy = "trino_tpu_node_busy_ms_total"
+    fleet = max(1, len(workers))
+    busy_series = {}
+    for tier in ("device", "host"):
+        pts = []
+        for s in tel_samples:
+            iv_ms = s.get("interval_s", 0.0) * 1000
+            if iv_ms <= 0:
+                continue
+            delta = s["values"].get(f"{fam_busy}|{tier}", 0.0)
+            pts.append([round(s["ts"], 3),
+                        round(min(1.0, delta / (iv_ms * fleet)), 4)])
+        busy_series[tier] = pts
+    tel_rec["busy_fraction_series"] = busy_series
+    tel_rec["busy_fraction_mean"] = {
+        tier: (round(sum(v for _, v in pts) / len(pts), 4) if pts
+               else None)
+        for tier, pts in busy_series.items()}
     rec["telemetry"] = tel_rec
+    # live-stats folds landed (heartbeats actually streamed) + the
+    # per-node utilization view the folds produced
+    rec["live_stats_folds"] = coord.state.livestats.folds
+    rec["utilization"] = coord.state.livestats.utilization()
     # the fair-share acceptance, stated explicitly: the saturating scan
     # tenant did not push the point tenant past its SLO
     rec["fair_share_held"] = rec["tenants"]["alpha"]["slo_ok"]

@@ -155,6 +155,13 @@ REQUIRED_FAMILIES = (
     "trino_tpu_ledger_records_total",
     "trino_tpu_ledger_bytes",
     "trino_tpu_queries_resumed_total",
+    # round-21 live query observability: heartbeat-streamed task stats,
+    # stuck-query diagnosis, per-node host/device utilization
+    "trino_tpu_task_heartbeats_total",
+    "trino_tpu_live_stats_bytes_total",
+    "trino_tpu_stuck_queries_diagnosed_total",
+    "trino_tpu_node_busy_fraction",
+    "trino_tpu_node_busy_ms_total",
 )
 
 

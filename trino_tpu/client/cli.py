@@ -10,8 +10,58 @@ without a running cluster.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
+
+
+class ProgressLine:
+    """Carriage-return progress line rendered from each polled page's
+    stats: `[=====>      ]  52% RUNNING partitioned`. Monotonic — the
+    shown ratio never moves backward even if a poll races a failover's
+    progress re-derivation — and cleared before the result table so
+    piped output never contains it."""
+
+    WIDTH = 24
+
+    def __init__(self, out=None):
+        self.out = out if out is not None else sys.stderr
+        self.ratio = 0.0
+        self.visible = False
+
+    def update(self, stats: dict) -> None:
+        r = float(stats.get("progressRatio", 0.0) or 0.0)
+        if stats.get("state") == "FINISHED":
+            r = 1.0
+        self.ratio = max(self.ratio, min(1.0, r))
+        filled = int(self.ratio * self.WIDTH)
+        bar = "=" * filled + (">" if filled < self.WIDTH else "")
+        stage = stats.get("stage") or ""
+        line = (f"[{bar:<{self.WIDTH}}] {100 * self.ratio:3.0f}% "
+                f"{stats.get('state', '')} {stage}")
+        self.out.write("\r" + line[:79].ljust(79))
+        self.out.flush()
+        self.visible = True
+
+    def clear(self) -> None:
+        if self.visible:
+            self.out.write("\r" + " " * 79 + "\r")
+            self.out.flush()
+        self.visible = False
+        self.ratio = 0.0
+
+
+def progress_enabled(mode: str, out=None) -> bool:
+    """Resolve --progress: 'always'/'never' are explicit; 'auto' turns
+    the line on only for real interactive terminals — piped output and
+    dumb terminals (no carriage-return rendering) stay clean."""
+    if mode == "always":
+        return True
+    if mode == "never":
+        return False
+    out = out if out is not None else sys.stderr
+    return bool(getattr(out, "isatty", lambda: False)()) and \
+        os.environ.get("TERM", "") != "dumb"
 
 
 def render_table(columns, rows, out=None) -> None:
@@ -44,15 +94,25 @@ class LocalBackend:
 
 
 class RemoteBackend:
-    def __init__(self, uri: str, user: str):
+    def __init__(self, uri: str, user: str, progress: bool = False):
         from .client import Client
+        self.progress_line = ProgressLine() if progress else None
         # --server accepts a comma-separated coordinator list; polling
         # fails over across it (client.py)
-        self.client = Client(uri, user=user)
+        self.client = Client(
+            uri, user=user,
+            on_progress=(self.progress_line.update
+                         if self.progress_line is not None else None))
         self.last_failovers = 0
 
     def execute(self, sql: str):
-        r = self.client.execute(sql)
+        try:
+            r = self.client.execute(sql)
+        finally:
+            # the line must be gone before the table (or the error)
+            # renders, success or not
+            if self.progress_line is not None:
+                self.progress_line.clear()
         self.last_failovers = r.failovers
         return r.columns, r.rows
 
@@ -101,9 +161,16 @@ def main(argv=None) -> int:
     ap.add_argument("--schema", default="tiny",
                     help="tpch schema for in-process mode")
     ap.add_argument("--execute", "-e", help="run one statement and exit")
+    ap.add_argument("--progress", choices=("auto", "always", "never"),
+                    default="auto",
+                    help="live progress line while a remote query runs "
+                         "(auto: only on interactive terminals)")
     args = ap.parse_args(argv)
-    backend = RemoteBackend(args.server, args.user) if args.server \
-        else LocalBackend(args.schema)
+    # local execution is synchronous — there is nothing to poll, so the
+    # progress line only ever applies to --server mode
+    backend = RemoteBackend(args.server, args.user,
+                            progress=progress_enabled(args.progress)) \
+        if args.server else LocalBackend(args.schema)
     if args.execute:
         columns, rows = backend.execute(args.execute.rstrip(";"))
         render_table(columns, rows)

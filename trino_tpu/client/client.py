@@ -40,7 +40,8 @@ class Client:
     def __init__(self, uri, user: str = "anonymous",
                  poll_interval_s: float = 0.05, timeout_s: float = 300.0,
                  spooled: bool = False, password: Optional[str] = None,
-                 traceparent: Optional[str] = None):
+                 traceparent: Optional[str] = None,
+                 on_progress=None):
         # `uri` accepts a single address, a comma-separated list, or a
         # list/tuple — the failover address list. The first entry is
         # the preferred coordinator; nextUri polling rewrites hosts
@@ -62,6 +63,10 @@ class Client:
         # query's trace continues the CALLER's trace instead of rooting
         # a fresh one (utils/tracing.py parses it coordinator-side)
         self.traceparent = traceparent
+        # live-progress hook: called with each polled page's `stats`
+        # dict (state, progressRatio, stage, elapsedTimeMillis) — the
+        # CLI's --progress line renders from this; None costs nothing
+        self.on_progress = on_progress
         # cumulative coordinator-address switches (per-query delta is
         # reported on ClientResult.failovers)
         self.failovers = 0
@@ -163,6 +168,11 @@ class Client:
                 err = doc["error"]
                 raise QueryError(err.get("message", "query failed"),
                                  err.get("errorName", ""))
+            if self.on_progress is not None:
+                try:
+                    self.on_progress(doc.get("stats") or {})
+                except Exception:  # noqa: BLE001 — rendering never
+                    pass           # fails the query
             if "columns" in doc and not columns:
                 columns = [c["name"] for c in doc["columns"]]
             if "data" in doc:

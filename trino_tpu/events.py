@@ -56,6 +56,12 @@ class QueryCompletedEvent:
     # critical-path attribution (server/timeline.py): the phase holding
     # the most elapsed wall, "" when no timeline was built
     dominant_phase: str = ""
+    # live observability (server/livestats.py): the last split-weighted
+    # progress the heartbeat fold computed (1.0 for FINISHED; an
+    # OOM-killed query records how far it got) and the in-flight stage
+    # that held the most remaining work when the query ended
+    progress_ratio: float = 0.0
+    dominant_stage: str = ""
 
 
 class EventListener:
@@ -115,5 +121,8 @@ class EventListenerManager:
             written_bytes=int((st.get("write") or {}).get("bytes", 0)),
             commit_phase=(st.get("write") or {}).get("phase", ""),
             dominant_phase=(getattr(tq, "timeline", None) or
-                            {}).get("dominant", ""))
+                            {}).get("dominant", ""),
+            progress_ratio=(1.0 if tq.state == "FINISHED" else
+                            float(getattr(tq, "progress_ratio", 0.0))),
+            dominant_stage=getattr(tq, "dominant_stage", ""))
         self._dispatch("query_completed", ev)

@@ -561,6 +561,33 @@ QUERIES_RESUMED = REGISTRY.counter(
     "output reused), reexecuted (re-run from scratch; writes dedup "
     "through the commit journal)", ("mode",))
 
+# live query observability (server/livestats.py): streaming task-stat
+# heartbeats, stuck-query diagnosis, host/device busy-fraction gauges
+TASK_HEARTBEATS = REGISTRY.counter(
+    "trino_tpu_task_heartbeats_total",
+    "Incremental live task-stat pushes (announce-piggybacked heartbeat "
+    "payloads sent by workers)")
+LIVE_STATS_BYTES = REGISTRY.counter(
+    "trino_tpu_live_stats_bytes_total",
+    "Encoded bytes of delta-encoded live task stats shipped on the "
+    "heartbeat path")
+STUCK_QUERIES_DIAGNOSED = REGISTRY.counter(
+    "trino_tpu_stuck_queries_diagnosed_total",
+    "Running queries whose live stats stopped advancing for the stuck "
+    "threshold and received an automatic structured diagnosis")
+NODE_BUSY_FRACTION = REGISTRY.gauge(
+    "trino_tpu_node_busy_fraction",
+    "Per-node busy fraction over the last heartbeat interval, by tier: "
+    "device (dispatch wall / wall) and host (interpreter wall / wall) "
+    "— the flight recorder samples this into system.runtime.utilization",
+    ("tier",))
+NODE_BUSY_MS = REGISTRY.counter(
+    "trino_tpu_node_busy_ms_total",
+    "Cumulative busy milliseconds by tier — the counter form of the "
+    "busy-fraction gauge; per-interval deltas of this (what the flight "
+    "recorder records) give the utilization series BENCH_soak emits",
+    ("tier",))
+
 # the labeled families acceptance scrapes: seed the hot label values so
 # a cold server's /v1/metrics already carries them at 0
 for _op in ("scan", "output"):
@@ -602,3 +629,6 @@ for _k in ("admit", "state", "assign", "spool", "terminal", "catalog",
     LEDGER_RECORDS.init_labels(kind=_k)
 for _m in ("replayed", "reattached", "reexecuted"):
     QUERIES_RESUMED.init_labels(mode=_m)
+for _t in ("device", "host"):
+    NODE_BUSY_FRACTION.init_labels(tier=_t)
+    NODE_BUSY_MS.init_labels(tier=_t)

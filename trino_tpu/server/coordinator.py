@@ -665,6 +665,15 @@ class CoordinatorState:
         # looks up the running TrackedQuery (state-machine stamps) to
         # print queued time in the critical-path breakdown line
         self.scheduler.tracked_lookup = self.tracker.get
+        # live query observability (server/livestats.py): the fold of
+        # heartbeat-streamed worker TaskStats into live per-stage
+        # rollups, split-weighted progress, stuck/skew diagnosis and
+        # per-node utilization. Pure fold state — only mutated when a
+        # heartbeat arrives or the scheduler registers a task launch,
+        # so the heartbeat-off path costs nothing.
+        from .livestats import LiveStatsStore
+        self.livestats = LiveStatsStore(tracked_lookup=self.tracker.get)
+        self.scheduler.livestats = self.livestats
         # cluster flight recorder (server/telemetry.py): the local ring
         # plus coordinator-scrape federation of worker rings. The sampler
         # thread only runs when an interval is configured
@@ -773,6 +782,12 @@ class CoordinatorState:
                 self.dispatcher.restore_terminal(q)
             else:
                 self.dispatcher.resume(q, self._resume_mode(q))
+                # live progress re-derivation: re-register the ledger's
+                # task assignments so reattached tasks' next heartbeat
+                # folds back into THIS coordinator's progress estimate
+                self.livestats.begin(qid)
+                for tid in q.get("assigned", ()):
+                    self.livestats.register_task(qid, tid)
                 resumed += 1
         return resumed
 
@@ -835,7 +850,9 @@ class CoordinatorState:
     def announce(self, node_id: str, uri: str,
                  state: str = "ACTIVE",
                  now: Optional[float] = None,
-                 tasks: Optional[list] = None) -> None:
+                 tasks: Optional[list] = None,
+                 live_stats: Optional[dict] = None,
+                 memory: Optional[dict] = None) -> None:
         """Register/refresh a worker, honoring its reported lifecycle
         state. LEFT deregisters (the graceful mirror of a failure-
         detector eviction); DRAINING/DRAINED pull the node out of
@@ -893,10 +910,19 @@ class CoordinatorState:
             survivor = self.nodes.get(node_id)
             if survivor is not None and tasks is not None:
                 survivor.tasks = tasks
+            if survivor is not None and memory is not None:
+                # heartbeat pool snapshot: refreshes the same field the
+                # failure detector's pings write, shrinking the memory
+                # manager's staleness window between status polls
+                survivor.memory = memory
         if changed:
             NODE_LIFECYCLE_TRANSITIONS.inc(state=state)
             # outside nodes_lock: tick() re-reads the inventory itself
             self.memory_manager.on_membership_change()
+        if live_stats is not None:
+            # fold the piggybacked live task stats outside nodes_lock
+            # (the fold takes its own lock and may log)
+            self.livestats.fold(node_id, live_stats)
 
     def _recovery_allowed(self, node_id: str) -> bool:
         """A FAILED node may only rejoin on announce when the failure
@@ -981,6 +1007,20 @@ class _Handler(BaseHTTPRequestHandler):
             # the client's view of "done" is never ahead of the server's
             sm.settled.wait(5.0)
         base = self._base()
+        # split-weighted live progress (server/livestats.py): monotonic
+        # per query (the store high-waters, TrackedQuery remembers),
+        # 1.0 exactly at FINISHED. Queries the store never saw (local
+        # execution, heartbeats off) ride their remembered ratio — 0.0
+        # until terminal, so the CLI progress line still behaves.
+        ls = self.state.livestats
+        progress = ls.progress(tq.query_id)
+        if progress is not None and progress > tq.progress_ratio:
+            tq.progress_ratio = progress
+        stage = ls.dominant_stage(tq.query_id)
+        if stage:
+            tq.dominant_stage = stage
+        if sm.state == "FINISHED":
+            tq.progress_ratio = 1.0
         payload = {
             "id": tq.query_id,
             "infoUri": f"{base}/v1/query/{tq.query_id}",
@@ -989,6 +1029,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "queued": tq.state == "QUEUED",
                 "elapsedTimeMillis": int(tq.elapsed_s * 1000),
                 "rows": tq.rows_returned,
+                "progressRatio": round(tq.progress_ratio, 6),
+                "stage": tq.dominant_stage,
             },
         }
         if sm.state == "FAILED":
@@ -1094,7 +1136,9 @@ class _Handler(BaseHTTPRequestHandler):
                     body.get("uri", ""),
                     state=body.get("state", "ACTIVE"),
                     now=body.get("now"),
-                    tasks=body.get("tasks"))
+                    tasks=body.get("tasks"),
+                    live_stats=body.get("liveStats"),
+                    memory=body.get("memory"))
         # the failover contract: every announce response carries the
         # coordinator address list (primary first, fresh standbys after)
         # so workers and clients always know where to re-announce
@@ -1207,6 +1251,20 @@ class _Handler(BaseHTTPRequestHandler):
             return
         sm = tq.state_machine
         st = tq.stage_stats or {}
+        # live observability (server/livestats.py): high-water the
+        # heartbeat-fed progress onto the tracked query, then serve the
+        # in-flight per-stage rollup + stuck diagnosis alongside the
+        # terminal stage stats — mid-flight GETs see real numbers
+        ls = self.state.livestats
+        progress = ls.progress(tq.query_id)
+        if progress is not None and progress > tq.progress_ratio:
+            tq.progress_ratio = progress
+        dom = ls.dominant_stage(tq.query_id)
+        if dom:
+            tq.dominant_stage = dom
+        if sm.state == "FINISHED":
+            tq.progress_ratio = 1.0
+        rollup = ls.query_rollup(tq.query_id)
         self._send(200, {
             "queryId": tq.query_id, "state": tq.state, "query": tq.sql,
             "user": tq.session_user, "error": sm.error,
@@ -1215,6 +1273,10 @@ class _Handler(BaseHTTPRequestHandler):
             "distributed": tq.distributed,
             "fallbackReason": tq.fallback_reason,
             "route": tq.route, "routeReason": tq.route_reason,
+            "progressRatio": round(tq.progress_ratio, 6),
+            "dominantStage": tq.dominant_stage,
+            "liveStats": rollup,
+            "diagnosis": tq.live_diagnosis,
             "stageStats": {
                 "stages": st.get("stages", 0),
                 "tasks": len(st.get("tasks", ())),

@@ -245,6 +245,14 @@ class QueryHistoryStore:
                 "spills": int(getattr(tq, "spills", 0)),
                 "dominant_phase": (getattr(tq, "timeline", None) or
                                    {}).get("dominant", ""),
+                # live-observability post-mortem context: how far the
+                # query got (1.0 when FINISHED) and the stage that held
+                # the most in-flight work when it ended — the fields an
+                # OOM-killed query's autopsy starts from
+                "progress_ratio": (1.0 if tq.state == "FINISHED" else
+                                   float(getattr(tq, "progress_ratio",
+                                                 0.0))),
+                "dominant_stage": getattr(tq, "dominant_stage", ""),
             })
         except Exception:    # noqa: BLE001 — eviction must never fail
             log.exception("history eviction flush failed for %s",
@@ -327,5 +335,8 @@ class HistoryEventListener:
             "bytes_shuffled": int(event.bytes_shuffled),
             "spills": int(getattr(event, "spills", 0)),
             "dominant_phase": getattr(event, "dominant_phase", ""),
+            "progress_ratio": float(getattr(event, "progress_ratio",
+                                            0.0)),
+            "dominant_stage": getattr(event, "dominant_stage", ""),
             "end_time": event.end_time,
         })

@@ -180,6 +180,18 @@ class ClusterMemoryManager:
         from ..exec.memory import ExceededMemoryLimitError
         msg = (f"Query killed by the cluster low-memory killer: {why} "
                f"(dominant reservation {tq.query_id})")
+        # post-mortem context BEFORE the kill lands: snapshot the live
+        # progress ratio and dominant stage (server/livestats.py) onto
+        # the tracked query so history + QueryCompletedEvent record how
+        # far the victim got and where it was when it died
+        ls = getattr(self.state, "livestats", None)
+        if ls is not None:
+            progress = ls.progress(tq.query_id)
+            if progress is not None and progress > tq.progress_ratio:
+                tq.progress_ratio = progress
+            stage = ls.dominant_stage(tq.query_id)
+            if stage:
+                tq.dominant_stage = stage
         ex = getattr(self.state.session, "executor", None)
         if ex is not None and hasattr(ex, "request_kill"):
             ex.request_kill(msg)      # stops the running plan promptly
@@ -190,8 +202,10 @@ class ClusterMemoryManager:
         from ..metrics import QUERIES_KILLED_OOM
         QUERIES_KILLED_OOM.inc()
         from ..utils.log import tq_context
-        log.warning("%skilled by the cluster low-memory killer: %s",
-                    tq_context(tq), why)
+        log.warning("%skilled by the cluster low-memory killer: %s "
+                    "(progress %.1f%%, dominant stage %s)",
+                    tq_context(tq), why, 100 * tq.progress_ratio,
+                    tq.dominant_stage or "?")
         return tq.query_id
 
     # -- lifecycle ---------------------------------------------------------

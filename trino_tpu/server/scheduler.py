@@ -336,6 +336,11 @@ class StageScheduler:
         # EXPLAIN ANALYZE can fold queued time (state-machine stamps)
         # into its critical-path line. None under session-local use.
         self.tracked_lookup = None
+        # wired by CoordinatorState: the live-stats store
+        # (server/livestats.py) heartbeat folds land in. Launched tasks
+        # register so mid-flight rollups know stage/node/split counts
+        # before the first heartbeat arrives. None under session-local use.
+        self.livestats = None
 
     # -- durable query ledger hooks ---------------------------------------
 
@@ -350,6 +355,18 @@ class StageScheduler:
             return
         led.assign(qid, task.task_id, task.node.node_id,
                    self._current_stage)
+
+    def _livestats_register(self, task) -> None:
+        """Pre-register a launched task with the live-stats store so the
+        per-stage rollup carries stage/node/split-count attribution from
+        launch, not from the first heartbeat. No-op without a store."""
+        ls = self.livestats
+        qid = (self.last_query or {}).get("query_id")
+        if ls is None or not qid:
+            return
+        ls.register_task(qid, task.task_id, stage=self._current_stage,
+                         node=task.node.node_id,
+                         splits_total=len(task.splits))
 
     def _ledger_spool(self, key: str) -> None:
         """Record a result-spool pointer: after a failover, spooled
@@ -374,6 +391,8 @@ class StageScheduler:
                            "tasks": [], "operators": {},
                            "bytes_shuffled": 0}
         self._current_stage = "source"
+        if self.livestats is not None and query_id:
+            self.livestats.begin(query_id)
 
     def _finalize_rollup(self) -> None:
         """Compute the per-query deltas of the cumulative counters and
@@ -384,6 +403,8 @@ class StageScheduler:
         if lq is None or lq.get("_final"):
             return
         lq["_final"] = True
+        if self.livestats is not None and lq.get("query_id"):
+            self.livestats.finish(lq["query_id"])
         snap = getattr(self, "_stats_snap", {})
         for k in ("task_retries", "hedged_tasks", "hedge_wins",
                   "checksum_failures", "spool_hits", "splits_migrated"):
@@ -748,6 +769,7 @@ class StageScheduler:
                                       traceparent=traceparent)
                     task.start()
                     self._ledger_assign(task)
+                    self._livestats_register(task)
                     self.stats["tasks"] += 1
                     SCHED_TASKS.inc()
                     src_tasks.append(task)
@@ -775,6 +797,7 @@ class StageScheduler:
                                       traceparent=traceparent)
                     task.start()
                     self._ledger_assign(task)
+                    self._livestats_register(task)
                     self.stats["tasks"] += 1
                     SCHED_TASKS.inc()
                     return task
@@ -1251,6 +1274,7 @@ class StageScheduler:
             try:
                 task.start()
                 self._ledger_assign(task)
+                self._livestats_register(task)
                 self.stats["tasks"] += 1
                 SCHED_TASKS.inc()
                 drained = task.drain(deadline)
@@ -1316,8 +1340,19 @@ class StageScheduler:
             with self.state.nodes_lock:
                 draining = {nid for nid, n in self.state.nodes.items()
                             if n.state in ("DRAINING", "DRAINED")}
+            # live-evidence straggler feed (server/livestats.py): a
+            # RUNNING task whose heartbeat-observed per-split pace trails
+            # its stage peers past the hedge multiplier is treated like a
+            # draining node — its unit hedges NOW on live skew evidence
+            # rather than waiting out the wall-clock threshold
+            live_skew: Set[str] = set()
+            if self.livestats is not None:
+                lq_qid = (self.last_query or {}).get("query_id")
+                if lq_qid:
+                    live_skew = self.livestats.straggler_task_ids(
+                        lq_qid, self.hedge_multiplier)
             if self.hedge_multiplier > 0 and \
-                    (med is not None or draining):
+                    (med is not None or draining or live_skew):
                 threshold = max(self.hedge_min_s,
                                 self.hedge_multiplier * med) \
                     if med is not None else float("inf")
@@ -1325,7 +1360,9 @@ class StageScheduler:
                 for u in unresolved:
                     candidate = None
                     with lock:
-                        urgent = bool(u.nodes_used & draining)
+                        urgent = bool(u.nodes_used & draining) or \
+                            any(t.task_id in live_skew
+                                for t in u.tasks)
                         if u.hedged or u.pages is not None or \
                                 (not urgent and
                                  now - u.started < threshold):
@@ -1510,6 +1547,7 @@ class StageScheduler:
                                   traceparent=traceparent)
                 task.start()
                 self._ledger_assign(task)
+                self._livestats_register(task)
                 self.stats["tasks"] += 1
                 SCHED_TASKS.inc()
                 tasks.append(task)
@@ -1541,6 +1579,7 @@ class StageScheduler:
                               traceparent=traceparent)
             task.start()
             self._ledger_assign(task)
+            self._livestats_register(task)
             self.stats["tasks"] += 1
             SCHED_TASKS.inc()
             c_tasks.append(task)

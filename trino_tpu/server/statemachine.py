@@ -209,6 +209,14 @@ class TrackedQuery:
     # summing exactly to elapsed wall, built at terminal transition and
     # served at GET /v1/query/{id}/timeline + system.runtime.query_timeline
     timeline: Optional[dict] = None
+    # live observability (server/livestats.py): the last computed
+    # split-weighted progress (monotonic; survives into OOM-kill
+    # post-mortems via history + QueryCompletedEvent), the dominant
+    # in-flight stage behind it, and the stuck-query diagnosis the
+    # live-stats fold attached (None when the query never stalled)
+    progress_ratio: float = 0.0
+    dominant_stage: str = ""
+    live_diagnosis: Optional[dict] = None
 
     @property
     def state(self) -> str:

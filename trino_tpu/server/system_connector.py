@@ -32,7 +32,8 @@ class SystemConnector:
         if schema == "runtime":
             return ["queries", "nodes", "tasks", "operator_stats",
                     "resource_groups", "jit_cache", "query_history",
-                    "plan_cache", "query_timeline", "metrics_history"]
+                    "plan_cache", "query_timeline", "metrics_history",
+                    "live_queries", "utilization"]
         return []
 
     def get_table(self, schema: str, table: str) -> TableData:
@@ -58,10 +59,18 @@ class SystemConnector:
             return self._query_timeline_table()
         if table == "metrics_history":
             return self._metrics_history_table()
+        if table == "live_queries":
+            return self._live_queries_table()
+        if table == "utilization":
+            return self._utilization_table()
         raise KeyError(f"system table {table!r} not found")
 
     def _scheduler(self):
         return getattr(self.state, "scheduler", None) if self.state \
+            else None
+
+    def _livestats(self):
+        return getattr(self.state, "livestats", None) if self.state \
             else None
 
     def _queries_table(self) -> TableData:
@@ -118,9 +127,26 @@ class SystemConnector:
 
     def _tasks_table(self) -> TableData:
         """Recent remote tasks with their merged TaskStats (the
-        system.runtime.tasks view of the reference)."""
+        system.runtime.tasks view of the reference). Live records from
+        the heartbeat fold (server/livestats.py) lead the view, so
+        in-flight tasks are queryable BEFORE their terminal stats are
+        drained back — the reference's tasks view is live the same way."""
         sched = self._scheduler()
         recs = list(sched.task_history) if sched is not None else []
+        ls = self._livestats()
+        if ls is not None:
+            seen = {r["task_id"] for r in recs}
+            live = [{"query_id": r.get("query_id") or "",
+                     "task_id": r["task_id"], "node": r.get("node", ""),
+                     "stage": r.get("stage", ""),
+                     "state": r.get("state", ""),
+                     "splits": int(r.get("splits_done", 0)),
+                     "rows": int(r.get("rows", 0)),
+                     "bytes": int(r.get("bytes", 0)),
+                     "wall_ms": float(r.get("wall_ms", 0.0))}
+                    for r in ls.live_tasks()
+                    if r["task_id"] not in seen]
+            recs = live + recs
         base = _strings_table(
             "tasks",
             [("query_id", [r["query_id"] for r in recs]),
@@ -329,6 +355,68 @@ class SystemConnector:
             Schema(base.schema.fields +
                    (Field("ts", DOUBLE), Field("value", DOUBLE))),
             base.columns + [ts, value])
+
+    def _live_queries_table(self) -> TableData:
+        """In-flight query summaries from the live-stats fold
+        (server/livestats.py): split-weighted progress, per-stage task
+        and split counts, and the stuck-query diagnosis — the SQL twin
+        of the web UI's live cluster overview."""
+        ls = self._livestats()
+        recs = ls.live_queries() if ls is not None else []
+        base = _strings_table(
+            "live_queries",
+            [("query_id", [r["query_id"] for r in recs]),
+             ("state", [r["state"] for r in recs]),
+             ("stuck_stage", [r["diagnosis"] for r in recs])])
+        progress = np.array([r["progress"] for r in recs],
+                            dtype=np.float64)
+        stages = np.array([r["stages"] for r in recs], dtype=np.int64)
+        tasks = np.array([r["tasks"] for r in recs], dtype=np.int64)
+        tasks_done = np.array([r["tasks_done"] for r in recs],
+                              dtype=np.int64)
+        splits_done = np.array([r["splits_done"] for r in recs],
+                               dtype=np.int64)
+        splits_total = np.array([r["splits_total"] for r in recs],
+                                dtype=np.int64)
+        rows = np.array([r["rows"] for r in recs], dtype=np.int64)
+        byts = np.array([r["bytes"] for r in recs], dtype=np.int64)
+        stuck = np.array([int(r["stuck"]) for r in recs],
+                         dtype=np.int64)
+        return TableData(
+            "live_queries",
+            Schema(base.schema.fields +
+                   (Field("progress", DOUBLE),
+                    Field("stages", BIGINT), Field("tasks", BIGINT),
+                    Field("tasks_done", BIGINT),
+                    Field("splits_done", BIGINT),
+                    Field("splits_total", BIGINT),
+                    Field("rows", BIGINT), Field("bytes", BIGINT),
+                    Field("stuck", BIGINT))),
+            base.columns + [progress, stages, tasks, tasks_done,
+                            splits_done, splits_total, rows, byts,
+                            stuck])
+
+    def _utilization_table(self) -> TableData:
+        """Per-(node, tier) busy fractions from worker heartbeats
+        (server/livestats.py): how much of each node's recent wall the
+        device and host tiers spent doing split work."""
+        ls = self._livestats()
+        recs = ls.utilization() if ls is not None else []
+        base = _strings_table(
+            "utilization",
+            [("node_id", [r["node_id"] for r in recs]),
+             ("tier", [r["tier"] for r in recs])])
+        frac = np.array([r["busy_fraction"] for r in recs],
+                        dtype=np.float64)
+        busy_ms = np.array([r["busy_ms"] for r in recs],
+                           dtype=np.float64)
+        ts = np.array([r["ts"] for r in recs], dtype=np.float64)
+        return TableData(
+            "utilization",
+            Schema(base.schema.fields +
+                   (Field("busy_fraction", DOUBLE),
+                    Field("busy_ms", DOUBLE), Field("ts", DOUBLE))),
+            base.columns + [frac, busy_ms, ts])
 
     def _query_history_table(self) -> TableData:
         """The coordinator's persistent completed-query ring

@@ -227,6 +227,16 @@ class WorkerTask:
     # staged-file manifest for write tasks (rides terminal status stats;
     # publication is the coordinator's commit, never this worker's)
     manifest: Optional[dict] = None
+    # live observability (round-21): a manager-global change sequence
+    # stamped on every counter move (the heartbeat's delta cursor), the
+    # task's start stamp (live wall), and device/host/compile ms
+    # accumulated so far — terminal status_json never reads these, so
+    # the terminal wire format stays byte-identical with heartbeats off
+    live_seq: int = 0
+    started_at: float = 0.0
+    device_ms: float = 0.0
+    host_ms: float = 0.0
+    compile_ms: float = 0.0
 
     def __post_init__(self):
         # producer/consumer rendezvous sharing the task lock: _emit
@@ -279,6 +289,16 @@ class TaskManager:
         # same seeded schedule covers threads the task manager spawns
         self._executor.failure_injector = injector
         self._exec_lock = threading.Lock()
+        # live observability (round-21): one monotonically-increasing
+        # change sequence across ALL tasks (the heartbeat delta cursor)
+        # plus cumulative split-execution busy time split by tier —
+        # device (fenced dispatch wall from profiled runs) vs host
+        # (interpreter wall). Both are plain counters bumped on the task
+        # thread; no thread, no timer, nothing runs unless read.
+        self._live_lock = threading.Lock()
+        self._live_seq = 0
+        self.busy_device_ms = 0.0
+        self.busy_host_ms = 0.0
 
     def create_or_update(self, task_id: str, fragment_blob: str,
                          splits: List[Split], partition: dict = None,
@@ -312,6 +332,7 @@ class TaskManager:
                     task.state = "CANCELED"
                 # wake a producer paused on a full output buffer
                 task.cond.notify_all()
+            self._note_live_change(task)
 
     def inflight(self) -> List[str]:
         """Ids of tasks still PENDING/RUNNING (drain bookkeeping)."""
@@ -327,6 +348,65 @@ class TaskManager:
         with self._lock:
             return [{"taskId": t.task_id, "state": t.state}
                     for t in self.tasks.values()]
+
+    # -- live observability (round-21) -------------------------------------
+
+    def _note_live_change(self, task: WorkerTask) -> None:
+        """Stamp `task` with the next global change sequence. Called on
+        the task thread whenever a live-visible counter moves (split
+        done, page staged, state transition) so the heartbeat's delta
+        encoder can ship ONLY tasks that changed since its cursor."""
+        with self._live_lock:
+            self._live_seq += 1
+            task.live_seq = self._live_seq
+
+    def _note_busy(self, device_ms: float, host_ms: float) -> None:
+        with self._live_lock:
+            self.busy_device_ms += device_ms
+            self.busy_host_ms += host_ms
+
+    def busy_ms(self) -> dict:
+        """Cumulative split-execution busy time by tier — the worker's
+        utilization numerator (the heartbeat divides deltas of this by
+        wall to get the per-interval busy fraction)."""
+        with self._live_lock:
+            return {"deviceMs": round(self.busy_device_ms, 3),
+                    "hostMs": round(self.busy_host_ms, 3)}
+
+    def live_status(self, task: WorkerTask) -> dict:
+        """Bounded incremental TaskStats for one task: fixed scalar
+        fields only (no operators/spans/manifest), so a 100-task fanout
+        heartbeat stays byte-bounded."""
+        with task.lock:
+            if task.started_at and task.state == "RUNNING":
+                wall_ms = (time.monotonic() - task.started_at) * 1000
+            else:
+                wall_ms = float(task.stats.get("wallMs", 0.0)) \
+                    if task.stats else 0.0
+            return {"taskId": task.task_id, "state": task.state,
+                    "seq": task.live_seq,
+                    "splitsDone": task.splits_done,
+                    "splitsTotal": len(task.splits),
+                    "rowsOut": task.rows_out,
+                    "bytesOut": task.bytes_out,
+                    "wallMs": round(wall_ms, 3),
+                    "deviceMs": round(task.device_ms, 3),
+                    "hostMs": round(task.host_ms, 3),
+                    "compileMs": round(task.compile_ms, 3)}
+
+    def live_delta(self, since: int = 0) -> tuple:
+        """(cursor, entries): live status of every task whose change
+        sequence advanced past `since`, plus the cursor to pass next
+        time. Entries carry ABSOLUTE counter values (folds are
+        idempotent), the delta encoding is in which tasks ship at all —
+        an idle worker's heartbeat is an empty list."""
+        with self._lock:
+            tasks = list(self.tasks.values())
+        with self._live_lock:
+            cursor = self._live_seq
+        entries = [self.live_status(t) for t in tasks
+                   if t.live_seq > since]
+        return cursor, entries
 
     def unflushed(self) -> List[str]:
         """Ids of finished tasks whose output buffers still hold
@@ -393,6 +473,7 @@ class TaskManager:
             task.bytes_out += len(page)
         TASK_OUTPUT_ROWS.inc(rows)
         TASK_OUTPUT_BYTES.inc(len(page))
+        self._note_live_change(task)
 
     def _emit(self, task: WorkerTask, arrs, vals) -> None:
         """Stage one result batch into the task's output buffers,
@@ -442,6 +523,14 @@ class TaskManager:
                 acc[5] += st[4] * 1000
         ex.node_stats = {}
 
+    @staticmethod
+    def _live_totals(op_agg: Dict[str, list]) -> tuple:
+        """(device_ms, host_ms, compile_ms) totals of an op_agg rollup —
+        differenced per split for the live tier attribution."""
+        return (sum(v[3] for v in op_agg.values()),
+                sum(v[4] for v in op_agg.values()),
+                sum(v[5] for v in op_agg.values()))
+
     def _finalize_stats(self, task: WorkerTask, tracer: Tracer,
                         t_start: float, op_agg: Dict[str, list]) -> None:
         """Roll this task's TaskStats (rows/bytes/wall/operators) and its
@@ -479,6 +568,8 @@ class TaskManager:
             if task.state != "PENDING":   # canceled before the thread ran
                 return
             task.state = "RUNNING"
+            task.started_at = time.monotonic()
+        self._note_live_change(task)
         self.tasks_run += 1
         tracer = self._tracer_for(task)
         t_start = time.monotonic()
@@ -536,6 +627,7 @@ class TaskManager:
                             ex._subst[id(sub)] = ex.run(sub)
                     if profiling:
                         self._fold_node_stats(ex, names, op_agg)
+                    live_prev = self._live_totals(op_agg)
                     for si, split in enumerate(task.splits):
                         if task.state == "CANCELED":
                             return
@@ -564,6 +656,7 @@ class TaskManager:
                                                  capacity=cap)
                         ex._subst[id(driver_scan)] = chunk
                         ex._subst_opaque.add(id(driver_scan))
+                        sp_t0 = time.monotonic()
                         try:
                             with tracer.span("split", index=si,
                                              rows=split.count):
@@ -579,8 +672,27 @@ class TaskManager:
                             self._fold_node_stats(ex, names, op_agg)
                         arrs, vals = batch_to_numpy(out)
                         self._emit(task, arrs, vals)
+                        # live tier attribution: fenced device/host/
+                        # compile deltas when profiling; unprofiled
+                        # splits ride entirely in host (the round-10
+                        # convention), so the live so-far numbers match
+                        # what _finalize_stats will report
+                        sp_wall_ms = (time.monotonic() - sp_t0) * 1000
+                        d_dev, d_host, d_comp = 0.0, sp_wall_ms, 0.0
+                        if profiling:
+                            tot = self._live_totals(op_agg)
+                            d_dev = max(0.0, tot[0] - live_prev[0])
+                            d_host = max(0.0, tot[1] - live_prev[1])
+                            d_comp = max(0.0, tot[2] - live_prev[2])
+                            live_prev = tot
                         with task.lock:
                             task.splits_done += 1
+                            task.device_ms += d_dev
+                            task.host_ms += d_host
+                            task.compile_ms += d_comp
+                        self._note_live_change(task)
+                        self._note_busy(
+                            d_dev, max(0.0, sp_wall_ms - d_dev))
                 finally:
                     ex.profile = saved_profile
                     ex.node_stats = saved_node_stats
@@ -615,6 +727,7 @@ class TaskManager:
             # completed; success paths already finalized pre-transition
             if not task.stats:
                 self._finalize_stats(task, tracer, t_start, op_agg)
+            self._note_live_change(task)   # terminal state is a change
             cb = self.on_terminal
             if cb is not None and task.state in ("FINISHED", "FAILED",
                                                  "CANCELED"):

@@ -42,6 +42,10 @@ ROUTES = (
     ("GET", ("v1", "info", "state"), "_get_state", False),
     ("GET", ("v1", "metrics"), "_get_metrics", False),
     ("GET", ("v1", "task", STAR), "_get_task", "internal"),
+    # incremental live TaskStats (round-21): ?since=<seq> returns the
+    # bounded live record only when the task changed past the cursor
+    ("GET", ("v1", "task", STAR, "status"), "_get_task_status",
+     "internal"),
     ("GET", ("v1", "task", STAR, "results", STAR), "_get_results",
      "internal"),
     ("GET", ("v1", "task", STAR, "results", STAR, STAR), "_get_results",
@@ -177,6 +181,30 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         task = self._task_or_404(parts[2])
         if task is not None:
             self._send(200, self.worker.task_manager.status_json(task))
+
+    # GET /v1/task/{id}/status?since=<seq> — the pull twin of the
+    # announce-piggybacked heartbeat: a bounded live TaskStats record
+    # when the task's change sequence advanced past `since`, a
+    # fixed-size unchanged ack otherwise. Unlike GET /v1/task/{id} this
+    # never ships operators/spans, so polling it is O(1) per task.
+    def _get_task_status(self, parts, user):
+        task = self._task_or_404(parts[2])
+        if task is None:
+            return
+        from urllib.parse import parse_qs, urlparse
+        try:
+            since = int(parse_qs(urlparse(self.path).query)
+                        .get("since", ["0"])[0])
+        except ValueError:
+            since = 0
+        live = self.worker.task_manager.live_status(task)
+        if live["seq"] <= since:
+            self._send(200, {"taskId": task.task_id,
+                             "seq": live["seq"], "changed": False})
+        else:
+            self._send(200, {"taskId": task.task_id,
+                             "seq": live["seq"], "changed": True,
+                             "task": live})
 
     # GET /v1/task/{id}/results/{token}            — buffer 0
     # GET /v1/task/{id}/results/{buffer}/{token}   — partitioned
@@ -316,7 +344,8 @@ class WorkerServer:
                  announce_interval_s: float = 1.0, catalog=None,
                  drain_timeout_s: float = 30.0,
                  flush_grace_s: float = 1.0,
-                 telemetry_interval_s: Optional[float] = None):
+                 telemetry_interval_s: Optional[float] = None,
+                 heartbeat_interval_s: Optional[float] = None):
         self.node_id = node_id
         self.coordinator_uri = coordinator_uri
         # coordinator failover address list: seeded with the boot uri,
@@ -371,6 +400,16 @@ class WorkerServer:
         from .telemetry import FlightRecorder
         self.telemetry = FlightRecorder(node_id,
                                         interval_s=telemetry_interval_s)
+        # live-stats heartbeat (round-21): when set, every announce
+        # piggybacks delta-encoded live task stats + a pool snapshot and
+        # the announce loop ticks at min(announce, heartbeat) interval.
+        # Unset (the default): NO extra thread, the announce body stays
+        # byte-identical to the heartbeat-less wire form, and terminal
+        # task status is untouched — the telemetry zero-overhead
+        # contract applied to the task-status path.
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self._live_cursor = 0             # last DELIVERED change seq
+        self._busy_prev = None            # (monotonic, busy_ms) sample
 
     def start(self) -> "WorkerServer":
         t1 = threading.Thread(target=self.httpd.serve_forever,
@@ -393,6 +432,13 @@ class WorkerServer:
         the coordinator without waiting for a heartbeat round trip."""
         from .retrypolicy import RetryPolicy
 
+        # heartbeat piggyback (round-21): computed ONCE per announce so
+        # retries re-ship the same delta; the cursor commits only after
+        # the announce lands, so a failed round loses nothing
+        hb_cursor = hb = None
+        if self.heartbeat_interval_s is not None:
+            hb_cursor, hb = self._heartbeat_payload()
+
         def post():
             from .security import internal_headers
             # "now" lets the coordinator estimate this node's clock
@@ -400,12 +446,17 @@ class WorkerServer:
             # stamp ~= receive time on a synchronized clock); the task
             # inventory lets a freshly-promoted coordinator reconcile
             # ledger-assigned work against what actually survived here
-            body = json.dumps({"nodeId": self.node_id,
-                               "uri": self.uri,
-                               "state": state or self.state,
-                               "now": time.time(),
-                               "tasks":
-                                   self.task_manager.inventory()}).encode()
+            doc = {"nodeId": self.node_id,
+                   "uri": self.uri,
+                   "state": state or self.state,
+                   "now": time.time(),
+                   "tasks": self.task_manager.inventory()}
+            if hb is not None:
+                doc["liveStats"] = hb
+                # pool snapshot between failure-detector pings: shrinks
+                # the memory manager's staleness window
+                doc["memory"] = self.task_manager.memory_info()
+            body = json.dumps(doc).encode()
             req = Request(f"{self.coordinator_uri}/v1/announce", data=body,
                           headers={"Content-Type": "application/json",
                                    **internal_headers()})
@@ -421,10 +472,48 @@ class WorkerServer:
                     name="announce").call(
             post, retry_on=(OSError,),
             sleep=lambda d: self._stop.wait(d))
+        if hb_cursor is not None:
+            self._live_cursor = hb_cursor
         # the announce landed, so the coordinator at this address is
         # alive: drain any terminal reports it (or its dead predecessor)
         # missed
         self._flush_reports()
+
+    def _heartbeat_payload(self) -> tuple:
+        """(cursor, payload): delta-encoded live task stats — only
+        tasks whose change sequence moved past the last DELIVERED
+        cursor ship, with absolute counter values so folds are
+        idempotent — plus this node's per-interval device/host busy
+        fractions (sampled into the node_busy_fraction gauges so the
+        flight recorder picks them up)."""
+        from ..metrics import (LIVE_STATS_BYTES, NODE_BUSY_FRACTION,
+                               NODE_BUSY_MS, TASK_HEARTBEATS)
+        cursor, entries = self.task_manager.live_delta(self._live_cursor)
+        now = time.monotonic()
+        busy = self.task_manager.busy_ms()
+        util = {}
+        if self._busy_prev is not None:
+            prev_t, prev_busy = self._busy_prev
+            wall_ms = max(1e-9, (now - prev_t) * 1000)
+            for tier, key in (("device", "deviceMs"), ("host", "hostMs")):
+                delta = max(0.0, busy[key] - prev_busy[key])
+                frac = min(1.0, delta / wall_ms)
+                util[tier] = round(frac, 4)
+                NODE_BUSY_FRACTION.set(round(frac, 4), tier=tier)
+                # cumulative form: a delta-encoding scraper (the flight
+                # recorder) turns this into per-interval busy time,
+                # which survives several in-process workers sharing one
+                # registry where the instantaneous gauge is last-writer-
+                # wins
+                if delta:
+                    NODE_BUSY_MS.inc(delta, tier=tier)
+        self._busy_prev = (now, busy)
+        payload = {"seq": cursor, "tasks": entries, "busy": busy,
+                   "utilization": util}
+        TASK_HEARTBEATS.inc()
+        LIVE_STATS_BYTES.inc(
+            len(json.dumps(payload, separators=(",", ":"))))
+        return cursor, payload
 
     def _adopt_coordinators(self, uris) -> None:
         """Refresh the failover address list from an announce response
@@ -516,7 +605,12 @@ class WorkerServer:
                 # coordinator down: rotate to the next address in the
                 # failover list for the following round and keep trying
                 self._rotate_coordinator()
-            self._stop.wait(self.announce_interval_s)
+            interval = self.announce_interval_s
+            if self.heartbeat_interval_s is not None:
+                # heartbeats ride the announcer thread (no new thread):
+                # tick at the faster of the two cadences
+                interval = min(interval, self.heartbeat_interval_s)
+            self._stop.wait(interval)
 
     # -- lifecycle state machine -------------------------------------------
 
