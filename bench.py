@@ -953,6 +953,237 @@ def chaos_soak(n_seeds=None, cluster=None, out_path="BENCH_chaos.json"):
 
 
 # ---------------------------------------------------------------------------
+# --overload: deadlines / cancellation / admission-control soak (round-22)
+# ---------------------------------------------------------------------------
+
+def overload_soak(cluster=None, out_path="BENCH_overload.json"):
+    """Query-lifetime enforcement soak: saturating admission against a
+    shrunken resource group (queue-full + queued-time rejections),
+    HANG-wedged distributed queries that only the coordinator-stamped
+    deadline can unstick, and a mass-cancel wave DELETEing mid-flight
+    queries. Hard gates: 0 wrong answers among everything that
+    FINISHED, every expired/canceled query terminal on every node
+    within grace, and worker memory pools drained to zero. Emits
+    BENCH_overload.json; the cancel-to-terminal and deadline-overshoot
+    walls gate as their own --check-regressions series."""
+    from trino_tpu.client.client import Client, QueryError
+    from trino_tpu.exec.session import Session
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.failureinjector import (DELAY, HANG,
+                                                  FailureInjector)
+    from trino_tpu.server.worker import WorkerServer
+
+    t_start = time.monotonic()
+    owns = cluster is None
+    if owns:
+        session = Session(default_schema="tiny")
+        coord = CoordinatorServer(session, retry_policy="QUERY").start()
+        coord.state.scheduler.split_rows = 8192
+        workers = [WorkerServer(f"ovl-w{i}", coord.uri,
+                                announce_interval_s=0.1,
+                                catalog=session.catalog).start()
+                   for i in range(3)]
+    else:
+        coord, workers, session = cluster
+    sched = coord.state.scheduler
+    deadline = time.time() + 5
+    while len(coord.state.active_nodes()) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+
+    q_agg, _ = CHAOS_QUERIES["agg"]
+    # fault-free baseline THROUGH the cluster (rows as the protocol
+    # serializes them) — also warms the worker fragments so XLA compile
+    # never eats a deadline
+    want = _chaos_rows(
+        Client(coord.uri, user="overload").execute(q_agg).rows)
+
+    rec = {"metric": "overload", "submitted": 0, "finished": 0,
+           "wrong_answers": 0, "rejected_queue_full": 0,
+           "rejected_queued_deadline": 0, "deadline_kills": 0,
+           "canceled": 0, "unexpected_errors": 0, "errors": []}
+
+    def note_error(stage, e):
+        rec["unexpected_errors"] += 1
+        if len(rec["errors"]) < 8:
+            rec["errors"].append(f"{stage}: {e}")
+
+    # -- wave 1: saturating admission against a shrunken root group ----
+    client_sets = Client(coord.uri, user="overload")
+    client_sets.execute("SET SESSION query_max_queued_time_s = 0.5")
+    root = coord.state.dispatcher.resource_groups.root
+    saved_rg = (root.config.hard_concurrency_limit,
+                root.config.max_queued)
+    root.config.hard_concurrency_limit = 1
+    root.config.max_queued = 2
+    lock = threading.Lock()
+
+    def one_query():
+        rec["submitted"] += 1
+        try:
+            r = Client(coord.uri, user="overload",
+                       timeout_s=120).execute(q_agg)
+        except QueryError as e:
+            with lock:
+                if e.error_name == "QUERY_QUEUE_FULL":
+                    rec["rejected_queue_full"] += 1
+                elif e.error_name == "QUERY_EXCEEDED_QUEUED_TIME":
+                    rec["rejected_queued_deadline"] += 1
+                else:
+                    note_error("admission", e)
+            return
+        with lock:
+            rec["finished"] += 1
+            if _chaos_rows(r.rows) != want:
+                rec["wrong_answers"] += 1
+
+    try:
+        threads = [threading.Thread(target=one_query)
+                   for _ in range(10)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        root.config.hard_concurrency_limit, root.config.max_queued = \
+            saved_rg
+        # reset via the session dict, not a SET statement: a SET issued
+        # while the deadline property is still armed gets stamped with
+        # that deadline and can itself be killed mid-drain
+        session.properties.pop("query_max_queued_time_s", None)
+
+    # -- wave 2: HANG-wedged queries unstuck only by their deadline ----
+    n_hang = 3
+    deadline_s = 1.0
+    client_sets.execute(
+        f"SET SESSION query_max_run_time_s = {deadline_s}")
+    inj = FailureInjector(seed=722)
+    inj.inject("WORKER_TASK_RUN", times=4 * n_hang, fault=HANG,
+               delay_s=8.0)
+    for w in workers:
+        w.task_manager.injector = inj
+    overshoots = []
+    try:
+        for _ in range(n_hang):
+            # drop spooled task results so the query actually re-runs
+            # on the workers (and hits the HANG) instead of being
+            # served from the exchange spool
+            sched.spool.clear()
+            rec["submitted"] += 1
+            t0 = time.monotonic()
+            try:
+                Client(coord.uri, user="overload",
+                       timeout_s=30).execute(q_agg)
+                note_error("hang", "wedged query FINISHED under a "
+                                   "deadline that should have fired")
+            except QueryError as e:
+                wall = time.monotonic() - t0
+                if e.error_name == "QUERY_EXCEEDED_RUN_TIME":
+                    rec["deadline_kills"] += 1
+                    overshoots.append(
+                        round(max(0.0, wall - deadline_s) * 1000, 1))
+                else:
+                    note_error("hang", e)
+    finally:
+        inj.clear()                       # release every live HANG
+        for w in workers:
+            w.task_manager.injector = None
+        session.properties.pop("query_max_run_time_s", None)
+
+    # -- wave 3: mass-cancel of mid-flight distributed queries ---------
+    n_cancel = 4
+    inj = FailureInjector(seed=723)
+    inj.inject("WORKER_TASK_RUN", times=8 * n_cancel, fault=DELAY,
+               delay_s=1.0)
+    for w in workers:
+        w.task_manager.injector = inj
+    cancel_walls = []
+    try:
+        # same spool hazard as wave 2: released wave-2 tasks may have
+        # spooled their pages, and a spool-served query FINISHES before
+        # the DELETE can land
+        sched.spool.clear()
+        cancel_client = Client(coord.uri, user="overload")
+        live = []
+        for _ in range(n_cancel):
+            rec["submitted"] += 1
+            doc = cancel_client._submit(q_agg)
+            live.append((doc["id"], doc.get("nextUri")))
+        # wait until the wave is mid-flight (remote tasks dispatched —
+        # the exec lock serializes dispatch, so the rest of the wave is
+        # canceled wherever it stands: queued, planning, or waiting),
+        # then DELETE everything back-to-back
+        deadline = time.time() + 15
+        while time.time() < deadline and not any(
+                sched._live_tasks.get(qid) for qid, _ in live):
+            time.sleep(0.02)
+        for qid, next_uri in live:
+            t0 = time.monotonic()
+            try:
+                cancel_client._request("DELETE", next_uri)
+            except Exception as e:  # noqa: BLE001
+                note_error("cancel", e)
+                continue
+            tq = coord.state.tracker.get(qid)
+            deadline = time.time() + 10
+            while not tq.state_machine.is_done() and \
+                    time.time() < deadline:
+                time.sleep(0.01)
+            if tq.state == "CANCELED":
+                rec["canceled"] += 1
+                cancel_walls.append(
+                    round((time.monotonic() - t0) * 1000, 1))
+            else:
+                note_error("cancel", f"{qid} ended {tq.state}")
+    finally:
+        inj.clear()
+        for w in workers:
+            w.task_manager.injector = None
+
+    # -- grace: every node terminal, every pool drained ----------------
+    def all_tasks_terminal():
+        return all(t.state not in ("PENDING", "RUNNING")
+                   for w in workers
+                   for t in list(w.task_manager.tasks.values()))
+
+    def pools_drained():
+        return all(w.task_manager.memory_info().get("reserved", 0) == 0
+                   for w in workers)
+
+    grace = time.time() + 15
+    while not (all_tasks_terminal() and pools_drained()) and \
+            time.time() < grace:
+        time.sleep(0.05)
+    rec["tasks_terminal"] = all_tasks_terminal()
+    rec["pools_drained"] = pools_drained()
+
+    cancel_walls.sort()
+    overshoots.sort()
+    rec["cancel_terminal_p50_ms"] = \
+        cancel_walls[len(cancel_walls) // 2] if cancel_walls else None
+    rec["cancel_terminal_max_ms"] = \
+        cancel_walls[-1] if cancel_walls else None
+    rec["deadline_overshoot_p50_ms"] = \
+        overshoots[len(overshoots) // 2] if overshoots else None
+    rec["rejected_total"] = (rec["rejected_queue_full"] +
+                             rec["rejected_queued_deadline"])
+    rec["elapsed_s"] = round(time.monotonic() - t_start, 1)
+    rec["passed"] = bool(
+        rec["wrong_answers"] == 0 and rec["unexpected_errors"] == 0 and
+        rec["deadline_kills"] == n_hang and
+        rec["canceled"] == n_cancel and rec["finished"] >= 1 and
+        rec["tasks_terminal"] and rec["pools_drained"])
+    if owns:
+        for w in workers:
+            w.stop()
+        coord.stop()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+# ---------------------------------------------------------------------------
 # --write-chaos: exactly-once distributed-write soak (round-18 PR)
 # ---------------------------------------------------------------------------
 
@@ -2213,6 +2444,20 @@ def load_bench_round(path):
             if isinstance(d, dict) and "p50_ms" in d:
                 out[f"write_chaos_{point.lower()}_p50"] = float(d["p50_ms"])
         return out or None
+    if str(doc.get("metric", "")) == "overload":
+        # --overload rounds gate on the enforcement latencies: a slower
+        # cancel-to-terminal fan-out or a bigger deadline overshoot in
+        # a later round reads as a regressed overload_* config
+        # (correctness — wrong answers, leaked tasks, undrained pools —
+        # already hard-fails the soak itself)
+        out = {}
+        for key, cfg in (("cancel_terminal_p50_ms", "overload_cancel_p50"),
+                         ("cancel_terminal_max_ms", "overload_cancel_max"),
+                         ("deadline_overshoot_p50_ms",
+                          "overload_deadline_overshoot_p50")):
+            if doc.get(key) is not None:
+                out[cfg] = float(doc[key])
+        return out or None
     if str(doc.get("metric", "")) == "coordinator_chaos":
         # --coordinator-chaos rounds gate on the failover-to-first-
         # result walls: a slower promotion/replay/resume path in a
@@ -2405,6 +2650,10 @@ def build_parser():
                            "WRITE_STAGE/WRITE_COMMIT/WRITE_PUBLISH, "
                            "0 lost/0 dup rows + 0 orphans required -> "
                            "BENCH_write_chaos.json")
+    mode.add_argument("--overload", action="store_true",
+                      help="deadline/cancellation/admission-control "
+                           "soak: saturating load + HANG faults + "
+                           "mass-cancel wave -> BENCH_overload.json")
     mode.add_argument("--coordinator-chaos", action="store_true",
                       help="seeded coordinator-kill failover soak "
                            "(primary + warm standby, kill at every "
@@ -2481,6 +2730,9 @@ def main(argv=None):
         return 0
     if args.write_chaos:
         rec = write_chaos_soak()
+        return 0 if rec["passed"] else 1
+    if args.overload:
+        rec = overload_soak()
         return 0 if rec["passed"] else 1
     if args.coordinator_chaos:
         rec = coordinator_chaos_soak()
@@ -2561,6 +2813,16 @@ def main(argv=None):
                                              mad_k=args.mad_k)
             report["write_chaos"] = report8
             ok = ok and ok8
+        # the lifecycle-enforcement trajectory gates as its own series
+        # (BENCH_overload.json + later rounds' BENCH_overload_r*.json):
+        # a slower cancel fan-out or deadline overshoot fails here
+        ovl_paths = sorted(_glob.glob("BENCH_overload*.json"))
+        if ovl_paths:
+            ok10, report10 = check_regressions(ovl_paths,
+                                               ratio=args.ratio,
+                                               mad_k=args.mad_k)
+            report["overload"] = report10
+            ok = ok and ok10
         # the coordinator-failover trajectory gates as its own series
         # (BENCH_coordinator_chaos.json + later rounds'
         # BENCH_coordinator_chaos_r*.json): a slower failover-to-first-

@@ -1,0 +1,491 @@
+"""Query-lifetime enforcement: deadlines, cancellation propagation,
+orphan reaping, overload admission control (round-22).
+
+Reference: Trino's QueryTracker enforces query_max_run_time /
+query_max_queued_time against QueryInfo timestamps and SqlTaskManager
+abandons tasks no coordinator call referenced for
+task.info-update-interval-derived timeouts (failTaskOnAbandonment);
+LowMemoryKiller, user DELETE and enforcement all converge on the same
+QueryStateMachine terminal transition, which fans task cancellation out
+to every worker.
+
+Unit tier: terminate() taxonomy per reason, the deadline-enforcer
+sweep, queued-time timeline attribution for queries that died while
+QUEUED, the load-shed admission gate, the micro-batch follower's
+deadline/cancel-aware wait, and the orphan reaper. Cluster tier (real
+HTTP, 3 workers): a user DELETE fans task DELETEs out to every
+in-flight worker task, a HANG-stuck distributed query is terminated by
+its deadline end-to-end, and overload rejections surface as retryable
+protocol errors.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from trino_tpu.client.client import Client, QueryError
+from trino_tpu.exec.session import Session
+from trino_tpu.server.coordinator import CoordinatorServer, CoordinatorState
+from trino_tpu.server.failureinjector import DELAY, HANG, FailureInjector
+from trino_tpu.server.statemachine import QueryStateMachine, TrackedQuery
+from trino_tpu.server.worker import WorkerServer
+
+
+def _tracked(state, sql="SELECT 1", user="u"):
+    """Register a bare TrackedQuery with the dispatcher (no execution)."""
+    disp = state.dispatcher
+    qid = disp.tracker.next_query_id()
+    tq = TrackedQuery(qid, sql, user, QueryStateMachine(qid))
+    disp.tracker.register(tq)
+    return tq
+
+
+# ---------------------------------------------------------------------------
+# terminate(): the single cancellation path, per-reason taxonomy
+# ---------------------------------------------------------------------------
+
+def test_deadline_stamped_at_admission():
+    session = Session(default_schema="tiny")
+    session.execute("SET SESSION query_max_run_time_s = 2.5")
+    session.execute("SET SESSION query_max_queued_time_s = 0.5")
+    state = CoordinatorState(session)
+    t0 = time.time()
+    tq = state.dispatcher.submit("SELECT count(*) FROM nation", "u")
+    assert tq.deadline is not None
+    assert t0 + 2.0 < tq.deadline <= time.time() + 2.5
+    assert tq.queued_deadline is not None
+    assert tq.queued_deadline < tq.deadline
+    # the enforcer thread lazily starts with the first deadline
+    assert state.dispatcher._enforcer is not None
+    state.dispatcher.pool.shutdown(wait=True)
+
+
+def test_no_deadline_without_session_property():
+    state = CoordinatorState(Session(default_schema="tiny"))
+    tq = _tracked(state)
+    assert tq.deadline is None and tq.queued_deadline is None
+    # a sweep over deadline-free queries terminates nothing
+    assert state.dispatcher.enforce_deadlines() == 0
+    assert not tq.state_machine.is_done()
+
+
+def test_terminate_taxonomy_per_reason():
+    from trino_tpu.exec.memory import ExceededMemoryLimitError
+    from trino_tpu.metrics import CANCEL_PROPAGATIONS
+    state = CoordinatorState(Session(default_schema="tiny"))
+    disp = state.dispatcher
+
+    want = [
+        ("user", "CANCELED", "USER_CANCELED", 2),
+        ("deadline", "FAILED", "QUERY_EXCEEDED_RUN_TIME", 4),
+        ("queued_deadline", "FAILED", "QUERY_EXCEEDED_QUEUED_TIME", 6),
+        ("oom", "FAILED", ExceededMemoryLimitError.error_name,
+         ExceededMemoryLimitError.error_code),
+        ("stuck", "FAILED", "GENERIC_INTERNAL_ERROR", 1),
+    ]
+    for reason, terminal, error_name, error_code in want:
+        before = CANCEL_PROPAGATIONS.value(reason=reason)
+        tq = _tracked(state)
+        assert disp.terminate(tq.query_id, reason=reason) is True
+        sm = tq.state_machine
+        assert sm.state == terminal
+        assert tq.terminate_reason == reason
+        if terminal == "FAILED":
+            assert sm.error_name == error_name
+            assert sm.error_code == error_code
+        assert CANCEL_PROPAGATIONS.value(reason=reason) == before + 1
+        # the race-safety contract: a second terminator loses cleanly
+        assert disp.terminate(tq.query_id, reason=reason) is False
+        assert CANCEL_PROPAGATIONS.value(reason=reason) == before + 1
+    assert disp.terminate("no-such-query") is False
+
+
+def test_deadline_expiry_is_not_retryable_queue_errors_are():
+    """Protocol taxonomy: QUERY_EXCEEDED_RUN_TIME must not be retried
+    (the re-run would expire again), while the two admission rejections
+    are explicitly safe to resubmit."""
+    from trino_tpu.server.resourcegroups import (
+        QueryQueueFullError, QueryQueuedTimeExceededError)
+    assert QueryQueueFullError.retryable is True
+    assert QueryQueuedTimeExceededError.retryable is True
+    state = CoordinatorState(Session(default_schema="tiny"))
+    tq = _tracked(state)
+    state.dispatcher.terminate(tq.query_id, reason="deadline")
+    assert "query_max_run_time_s" in tq.state_machine.error
+
+
+# ---------------------------------------------------------------------------
+# the deadline-enforcer sweep
+# ---------------------------------------------------------------------------
+
+def test_enforce_deadlines_sweep():
+    from trino_tpu.metrics import QUERIES_DEADLINE_EXCEEDED
+    state = CoordinatorState(Session(default_schema="tiny"))
+    disp = state.dispatcher
+    before = QUERIES_DEADLINE_EXCEEDED.value()
+
+    expired = _tracked(state)
+    expired.deadline = time.time() - 0.1
+    queued_expired = _tracked(state)
+    queued_expired.queued_deadline = time.time() - 0.1
+    alive = _tracked(state)
+    alive.deadline = time.time() + 60
+
+    assert disp.enforce_deadlines() == 2
+    assert expired.state == "FAILED"
+    assert expired.state_machine.error_name == "QUERY_EXCEEDED_RUN_TIME"
+    assert queued_expired.state == "FAILED"
+    assert queued_expired.state_machine.error_name == \
+        "QUERY_EXCEEDED_QUEUED_TIME"
+    assert not alive.state_machine.is_done()
+    assert QUERIES_DEADLINE_EXCEEDED.value() == before + 2
+    # idempotent: the next sweep finds nothing left to terminate
+    assert disp.enforce_deadlines() == 0
+
+
+def test_queued_deadline_only_applies_while_queued():
+    state = CoordinatorState(Session(default_schema="tiny"))
+    tq = _tracked(state)
+    tq.queued_deadline = time.time() - 0.1
+    tq.state_machine.transition("PLANNING")
+    tq.state_machine.transition("RUNNING")
+    # the query escaped the queue before the bound: it keeps running
+    assert state.dispatcher.enforce_deadlines() == 0
+    assert not tq.state_machine.is_done()
+
+
+def test_expired_queued_query_charges_queue_wait():
+    """Satellite: a query that died while QUEUED must attribute its
+    whole wall to the `queued` phase (dominant phase included), not
+    launder the admission hold into `other`."""
+    from trino_tpu.server.timeline import build_timeline
+    state = CoordinatorState(Session(default_schema="tiny"))
+    tq = _tracked(state)
+    tq.queued_deadline = time.time()
+    time.sleep(0.05)
+    assert state.dispatcher.enforce_deadlines() == 1
+    tl = build_timeline(tq)
+    assert tl["state"] == "FAILED"
+    assert tl["wall_s"] > 0
+    assert tl["phases"]["queued"] == pytest.approx(tl["wall_s"])
+    assert tl["dominant"] == "queued"
+    assert sum(tl["phases"].values()) == pytest.approx(tl["wall_s"])
+
+
+# ---------------------------------------------------------------------------
+# overload admission: the load-shed gate
+# ---------------------------------------------------------------------------
+
+def test_load_shed_gate_sheds_heaviest_tenant_only(monkeypatch):
+    from trino_tpu.metrics import QUERIES_REJECTED
+    monkeypatch.setenv("TRINO_TPU_LOAD_SHED_QUEUE_DEPTH", "1")
+    state = CoordinatorState(Session(default_schema="tiny"))
+    disp = state.dispatcher
+    # force the overload condition and a fair-share view in which the
+    # submitting tenant ("default") already holds the most device work
+    disp.resource_groups.total_queued = lambda: 5
+    disp.serving.fair_share.inflight = \
+        lambda: {"default": 3, "light": 0}
+    before = QUERIES_REJECTED.value(reason="load_shed")
+    tq = disp.submit("SELECT 1", "u")
+    assert tq.state == "FAILED"
+    assert tq.state_machine.error_name == "QUERY_QUEUE_FULL"
+    assert QUERIES_REJECTED.value(reason="load_shed") == before + 1
+
+    # the least-loaded tenant keeps admission even under overload
+    disp.serving.fair_share.inflight = \
+        lambda: {"default": 0, "heavy": 4}
+    tq2 = disp.submit("SELECT count(*) FROM nation", "u")
+    deadline = time.time() + 15
+    while not tq2.state_machine.is_done() and time.time() < deadline:
+        time.sleep(0.02)
+    assert tq2.state == "FINISHED", tq2.state_machine.error
+    disp.pool.shutdown(wait=True)
+
+
+def test_load_shed_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("TRINO_TPU_LOAD_SHED_QUEUE_DEPTH", raising=False)
+    state = CoordinatorState(Session(default_schema="tiny"))
+    disp = state.dispatcher
+    disp.resource_groups.total_queued = lambda: 10 ** 6
+    tq = _tracked(state)
+    assert disp._should_shed(tq) is False
+
+
+# ---------------------------------------------------------------------------
+# micro-batch follower: deadline/cancel-aware window wait
+# ---------------------------------------------------------------------------
+
+def _wedged_batcher():
+    """A MicroBatcher whose window leader never flushes (wedged)."""
+    from trino_tpu.server.serving import MicroBatcher, _Window
+    serving = SimpleNamespace(
+        session=SimpleNamespace(properties={}),
+        route_and_run=lambda entry, tq: "degraded")
+    mb = MicroBatcher(serving)
+    mb._windows["shape"] = _Window()        # open, never flushed
+    entry = SimpleNamespace(point_shape=("shape", "k", "'x'"))
+    return mb, entry
+
+
+def test_microbatch_follower_bails_when_query_terminated():
+    from trino_tpu.exec.executor import QueryTerminatedError
+    mb, entry = _wedged_batcher()
+    sm = QueryStateMachine("q-mb-1")
+    tq = SimpleNamespace(state_machine=sm, deadline=None)
+
+    def cancel_soon():
+        time.sleep(0.15)
+        sm.cancel()
+
+    threading.Thread(target=cancel_soon, daemon=True).start()
+    t0 = time.monotonic()
+    with pytest.raises(QueryTerminatedError):
+        mb.submit(entry, tq)
+    # the follower noticed between poll slices, not after the 60s bound
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_microbatch_follower_deadline_expiry_counted():
+    from trino_tpu.exec.executor import QueryDeadlineError
+    from trino_tpu.metrics import MICROBATCH_FOLLOWER_TIMEOUTS
+    mb, entry = _wedged_batcher()
+    tq = SimpleNamespace(state_machine=QueryStateMachine("q-mb-2"),
+                         deadline=time.time() + 0.2)
+    before = MICROBATCH_FOLLOWER_TIMEOUTS.value()
+    with pytest.raises(QueryDeadlineError, match="query_max_run_time_s"):
+        mb.submit(entry, tq)
+    assert MICROBATCH_FOLLOWER_TIMEOUTS.value() == before + 1
+
+
+# ---------------------------------------------------------------------------
+# orphan reaping (worker task manager)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def finished_task_factory():
+    from trino_tpu.server.tasks import TaskManager, encode_fragment
+    session = Session(default_schema="tiny")
+    _stmt, pr = session.plan("SELECT count(*) FROM nation")
+    frag = encode_fragment({"root": pr.node, "driver": None})
+    tm = TaskManager(session.catalog, node_id="reap-w")
+
+    def make(task_id):
+        task = tm.create_or_update(task_id, frag, [])
+        deadline = time.time() + 30
+        while task.state in ("PENDING", "RUNNING") and \
+                time.time() < deadline:
+            time.sleep(0.02)
+        assert task.state == "FINISHED"
+        return task
+
+    return tm, make
+
+
+def test_orphan_reaper_abandons_unreferenced_tasks(finished_task_factory):
+    from trino_tpu.metrics import TASKS_ABANDONED
+    tm, make = finished_task_factory
+    task = make("t-reap-1")
+    # recently referenced: never reaped
+    assert tm.reap_orphans(timeout_s=60.0) == []
+    assert task.state == "FINISHED"
+    # stale: abandoned, buffers freed
+    task.last_referenced = time.monotonic() - 100
+    before = TASKS_ABANDONED.value()
+    assert tm.reap_orphans(timeout_s=60.0) == ["t-reap-1"]
+    assert task.state == "ABANDONED"
+    assert task.buffers == {} and task.buffered_bytes == 0
+    assert TASKS_ABANDONED.value() == before + 1
+    # already-abandoned tasks are not reaped twice
+    assert tm.reap_orphans(timeout_s=60.0) == []
+
+
+def test_touch_is_the_reapers_liveness_signal(finished_task_factory):
+    tm, make = finished_task_factory
+    task = make("t-reap-2")
+    task.last_referenced = time.monotonic() - 100
+    # a coordinator reference (status/results/delete pull) resets the
+    # abandonment clock
+    tm.touch("t-reap-2")
+    assert tm.reap_orphans(timeout_s=60.0) == []
+    assert task.state == "FINISHED"
+    tm.touch("no-such-task")              # unknown ids are a no-op
+
+
+# ---------------------------------------------------------------------------
+# cluster tier: real HTTP, 3 workers
+# ---------------------------------------------------------------------------
+
+Q_AGG = ("SELECT l_returnflag, l_linestatus, sum(l_quantity) AS q, "
+         "count(*) AS c FROM lineitem WHERE l_shipdate <= DATE "
+         "'1998-09-02' GROUP BY l_returnflag, l_linestatus "
+         "ORDER BY l_returnflag, l_linestatus")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    session = Session(default_schema="tiny")
+    coord = CoordinatorServer(session, retry_policy="QUERY").start()
+    sched = coord.state.scheduler
+    sched.split_rows = 8192
+    workers = [WorkerServer(f"dl-worker-{i}", coord.uri,
+                            announce_interval_s=0.1,
+                            catalog=session.catalog).start()
+               for i in range(3)]
+    deadline = time.time() + 5
+    while len(coord.state.active_nodes()) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+    yield coord, workers, session
+    for w in workers:
+        w.stop()
+    coord.stop()
+
+
+@pytest.fixture(autouse=True)
+def _clean(request):
+    if "cluster" not in request.fixturenames:
+        yield
+        return
+    coord, workers, session = request.getfixturevalue("cluster")
+    sched = coord.state.scheduler
+    sched.spool.clear()
+    yield
+    sched.failure_injector = None
+    for w in workers:
+        inj = w.task_manager.injector
+        if inj is not None:
+            inj.clear()                   # releases any live HANGs
+        w.task_manager.injector = None
+    # session properties are shared module-wide: drop the deadline knobs
+    session.properties.pop("query_max_run_time_s", None)
+    session.properties.pop("query_max_queued_time_s", None)
+    deadline = time.time() + 5
+    while len(coord.state.active_nodes()) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+
+
+def _wait(pred, timeout_s, interval_s=0.02):
+    deadline = time.time() + timeout_s
+    while not pred() and time.time() < deadline:
+        time.sleep(interval_s)
+    return pred()
+
+
+def test_user_cancel_fans_out_task_deletes(cluster):
+    """Satellite regression: canceling a mid-flight distributed query
+    must DELETE every in-flight worker task (hedge twins included) —
+    the workers see CANCELED tasks, not abandoned RUNNING ones."""
+    from trino_tpu.metrics import CANCEL_PROPAGATIONS
+    coord, workers, session = cluster
+    sched = coord.state.scheduler
+    inj = FailureInjector(seed=221)
+    inj.inject("WORKER_TASK_RUN", times=3, fault=DELAY, delay_s=1.5)
+    for w in workers:
+        w.task_manager.injector = inj
+    client = Client(coord.uri, user="dl")
+    doc = client._submit(Q_AGG)
+    qid = doc["id"]
+    # wait until the scheduler has live remote tasks for this query
+    assert _wait(lambda: sched._live_tasks.get(qid), 10.0), \
+        "query never dispatched remote tasks"
+    task_ids = [t.task_id for t in sched._live_tasks[qid]]
+    assert task_ids
+    before = CANCEL_PROPAGATIONS.value(reason="user")
+    client._request("DELETE", client._rewrite(doc["nextUri"], client.uri))
+    tq = coord.state.tracker.get(qid)
+    assert _wait(tq.state_machine.is_done, 10.0)
+    assert tq.state == "CANCELED"
+    assert tq.terminate_reason == "user"
+    assert CANCEL_PROPAGATIONS.value(reason="user") == before + 1
+    # every assigned worker task reaches a terminal state within grace
+    # (the injected 1.5s delay bounds how long a split can linger)
+    held = [t for w in workers for t in [w.task_manager.get(tid)
+                                         for tid in task_ids]
+            if t is not None]
+    assert held, "no worker held any of the query's tasks"
+    assert _wait(lambda: all(t.state not in ("PENDING", "RUNNING")
+                             for t in held), 10.0), \
+        [(t.task_id, t.state) for t in held]
+    assert any(t.state == "CANCELED" for t in held)
+
+
+def test_hang_stuck_query_terminated_by_deadline_end_to_end(cluster):
+    """Acceptance: a distributed query wedged by a HANG fault is
+    terminated cluster-wide by its coordinator-stamped deadline —
+    QUERY_EXCEEDED_RUN_TIME to the client, terminal tasks and zero
+    memory reservations on every worker within grace."""
+    coord, workers, session = cluster
+    client = Client(coord.uri, user="dl")
+    client.execute("SET SESSION query_max_run_time_s = 1.0")
+    inj = FailureInjector(seed=222)
+    # hang every worker's split loop; delay_s is the safety bound, well
+    # past the 1.0s deadline that must fire first
+    inj.inject("WORKER_TASK_RUN", times=3, fault=HANG, delay_s=4.0)
+    for w in workers:
+        w.task_manager.injector = inj
+    t0 = time.monotonic()
+    with pytest.raises(QueryError) as ei:
+        client.execute(Q_AGG)
+    assert ei.value.error_name == "QUERY_EXCEEDED_RUN_TIME"
+    assert "query_max_run_time_s" in str(ei.value)
+    # the deadline fired, not the HANG's 4s safety release
+    assert time.monotonic() - t0 < 3.5
+    tq = next(t for t in reversed(coord.state.tracker.all())
+              if t.sql == Q_AGG)
+    assert tq.state == "FAILED"
+    assert tq.terminate_reason == "deadline"
+    inj.release_hangs()
+    # all worker tasks terminal and pools drained within grace
+    for w in workers:
+        tm = w.task_manager
+        assert _wait(lambda: all(t.state not in ("PENDING", "RUNNING")
+                                 for t in tm.tasks.values()), 10.0), \
+            [(t.task_id, t.state) for t in tm.tasks.values()]
+    assert _wait(lambda: all(
+        w.task_manager.memory_info().get("reserved", 0) == 0
+        for w in workers), 10.0)
+
+
+def test_queue_full_rejection_is_retryable_over_protocol(cluster):
+    """Overload degrades to fast rejection: past the queue bound the
+    statement fails QUERY_QUEUE_FULL with the payload-level retryable
+    flag set, and the client surfaces actionable guidance."""
+    import json
+    from urllib.request import Request, urlopen
+    coord, workers, session = cluster
+    root = coord.state.dispatcher.resource_groups.root
+    saved = (root.config.hard_concurrency_limit, root.config.max_queued)
+    root.config.hard_concurrency_limit = 0
+    root.config.max_queued = 0
+    try:
+        req = Request(f"{coord.uri}/v1/statement", data=b"SELECT 1",
+                      headers={"X-Trino-User": "dl"})
+        with urlopen(req, timeout=10) as resp:
+            doc = json.loads(resp.read().decode())
+        assert doc["error"]["errorName"] == "QUERY_QUEUE_FULL"
+        assert doc["error"]["errorCode"] == 5
+        assert doc["error"]["retryable"] is True
+        with pytest.raises(QueryError, match="retryable") as ei:
+            Client(coord.uri, user="dl").execute("SELECT 1")
+        assert ei.value.error_name == "QUERY_QUEUE_FULL"
+    finally:
+        root.config.hard_concurrency_limit, root.config.max_queued = saved
+
+
+# ---------------------------------------------------------------------------
+# full overload soak (slow tier; bench.py --overload is the standalone
+# runner that emits BENCH_overload.json for the regression gate)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_overload_soak(cluster):
+    from bench import overload_soak
+    coord, workers, session = cluster
+    rec = overload_soak(cluster=(coord, workers, session), out_path=None)
+    assert rec["passed"], rec
+    assert rec["wrong_answers"] == 0
+    assert rec["rejected_total"] > 0
+    assert rec["deadline_kills"] == 3 and rec["canceled"] == 4
+    assert rec["tasks_terminal"] and rec["pools_drained"]

@@ -636,3 +636,53 @@ def test_worker_buffers_terminal_reports_until_announce(tmp_path):
             coord.stop()
     finally:
         w.kill()
+
+
+def test_orphan_reaper_fenced_during_failover_reattachment():
+    """Round-22 x round-20 composition: the worker announce loop must
+    NEVER reap tasks while its coordinator answers as a non-PRIMARY (a
+    promotee still reconciling our inventory against its replayed
+    ledger) — and after the coordinator is PRIMARY again, the fence
+    lapses and the reaper resumes, so a genuinely orphaned task is
+    still eventually abandoned."""
+    from trino_tpu.server.tasks import encode_fragment
+    session = Session(default_schema="tiny")
+    coord = CoordinatorServer(session).start()
+    w = WorkerServer("fence-w", coord.uri, announce_interval_s=0.1,
+                     catalog=session.catalog).start()
+    try:
+        deadline = time.time() + 5
+        while not coord.state.active_nodes() and time.time() < deadline:
+            time.sleep(0.05)
+        _stmt, pr = session.plan(SQL)
+        frag = encode_fragment({"root": pr.node, "driver": None})
+        task = w.task_manager.create_or_update("t-fence", frag, [])
+        deadline = time.time() + 30
+        while task.state in ("PENDING", "RUNNING") and \
+                time.time() < deadline:
+            time.sleep(0.02)
+        assert task.state == "FINISHED"
+        # make the task reapable: stale far past a tiny abandonment
+        # timeout, with a short post-failover fence so the test can see
+        # the reaper resume
+        w.task_manager.task_abandonment_timeout_s = 0.2
+        w.reap_fence_s = 0.3
+        task.last_referenced = time.monotonic() - 100
+        # mid-failover: the coordinator answers announces as a
+        # still-reconciling promotee — several announce/reap rounds
+        # pass and the stale task must survive every one of them
+        coord.state.role = "RECONCILING"
+        time.sleep(0.8)
+        assert task.state == "FINISHED", \
+            "reaper fired during failover reattachment"
+        # promotion settles: announces say PRIMARY again, the fence
+        # lapses, and the orphan is finally reaped
+        coord.state.role = "PRIMARY"
+        deadline = time.time() + 10
+        while task.state != "ABANDONED" and time.time() < deadline:
+            time.sleep(0.05)
+        assert task.state == "ABANDONED"
+    finally:
+        w.kill()
+        coord.state.dispatcher.pool.shutdown(wait=False)
+        coord.stop()

@@ -162,6 +162,15 @@ REQUIRED_FAMILIES = (
     "trino_tpu_stuck_queries_diagnosed_total",
     "trino_tpu_node_busy_fraction",
     "trino_tpu_node_busy_ms_total",
+    # round-22 query-lifetime enforcement: deadlines, cancellation
+    # fan-out, orphan reaping, overload admission control
+    "trino_tpu_queries_deadline_exceeded_total",
+    "trino_tpu_queries_rejected_total",
+    "trino_tpu_tasks_abandoned_total",
+    "trino_tpu_cancel_propagations_total",
+    "trino_tpu_retry_budget_exhausted_total",
+    "trino_tpu_microbatch_follower_timeouts_total",
+    "trino_tpu_backpressure_deadline_degrades_total",
 )
 
 

@@ -84,9 +84,15 @@ def render_table(columns, rows, out=None) -> None:
 class LocalBackend:
     """In-process engine (no server)."""
 
-    def __init__(self, schema: str = "tiny"):
+    def __init__(self, schema: str = "tiny",
+                 timeout_s: float = 0.0):
         from ..exec.session import Session
         self.session = Session(default_schema=schema)
+        if timeout_s > 0:
+            # --timeout maps onto the engine's own deadline property so
+            # local and remote modes bound queries the same way
+            self.session.execute(
+                f"SET SESSION query_max_run_time_s = {timeout_s}")
 
     def execute(self, sql: str):
         r = self.session.execute(sql)
@@ -94,7 +100,8 @@ class LocalBackend:
 
 
 class RemoteBackend:
-    def __init__(self, uri: str, user: str, progress: bool = False):
+    def __init__(self, uri: str, user: str, progress: bool = False,
+                 timeout_s: float = 0.0):
         from .client import Client
         self.progress_line = ProgressLine() if progress else None
         # --server accepts a comma-separated coordinator list; polling
@@ -104,6 +111,12 @@ class RemoteBackend:
             on_progress=(self.progress_line.update
                          if self.progress_line is not None else None))
         self.last_failovers = 0
+        if timeout_s > 0:
+            # server-side deadline: the coordinator stamps it at
+            # admission and enforces it end-to-end (workers included) —
+            # strictly stronger than a client-side poll timeout
+            self.client.execute(
+                f"SET SESSION query_max_run_time_s = {timeout_s}")
 
     def execute(self, sql: str):
         try:
@@ -141,6 +154,11 @@ def repl(backend, inp=sys.stdin, out=sys.stdout) -> None:
         t0 = time.monotonic()
         try:
             columns, rows = backend.execute(sql)
+        except KeyboardInterrupt:
+            # the client already sent the server-side DELETE before
+            # re-raising (client.py); keep the REPL alive
+            out.write("Query canceled.\n")
+            continue
         except Exception as e:           # noqa: BLE001 — REPL boundary
             out.write(f"Query failed: {e}\n")
             continue
@@ -165,14 +183,25 @@ def main(argv=None) -> int:
                     default="auto",
                     help="live progress line while a remote query runs "
                          "(auto: only on interactive terminals)")
+    ap.add_argument("--timeout", type=float, default=0.0,
+                    help="per-query run-time budget in seconds (maps to "
+                         "SET SESSION query_max_run_time_s; the server "
+                         "enforces it end-to-end)")
     args = ap.parse_args(argv)
     # local execution is synchronous — there is nothing to poll, so the
     # progress line only ever applies to --server mode
     backend = RemoteBackend(args.server, args.user,
-                            progress=progress_enabled(args.progress)) \
-        if args.server else LocalBackend(args.schema)
+                            progress=progress_enabled(args.progress),
+                            timeout_s=args.timeout) \
+        if args.server else LocalBackend(args.schema,
+                                         timeout_s=args.timeout)
     if args.execute:
-        columns, rows = backend.execute(args.execute.rstrip(";"))
+        try:
+            columns, rows = backend.execute(args.execute.rstrip(";"))
+        except KeyboardInterrupt:
+            # client.py already DELETEd the server-side query
+            sys.stderr.write("Query canceled.\n")
+            return 130
         render_table(columns, rows)
         fo = getattr(backend, "last_failovers", 0)
         if fo:

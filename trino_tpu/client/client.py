@@ -70,6 +70,8 @@ class Client:
         # cumulative coordinator-address switches (per-query delta is
         # reported on ClientResult.failovers)
         self.failovers = 0
+        # the most recent nextUri — the Ctrl-C cancel target
+        self._last_next_uri: Optional[str] = None
         from ..server.retrypolicy import RetryPolicy
         # the retry window must outlast a standby promotion (detector
         # misses + ledger replay + worker re-announce), not just a
@@ -163,11 +165,40 @@ class Client:
         columns: List[str] = []
         rows: List[list] = []
         deadline = time.time() + self.timeout_s
+        self._last_next_uri = None
+        try:
+            return self._drain(doc, columns, rows, deadline,
+                               failovers_at_start)
+        except KeyboardInterrupt:
+            # Ctrl-C cancels the SERVER-side query before the client
+            # exits — otherwise the interrupted query keeps burning
+            # cluster slots until its own deadline fires
+            nu = self._last_next_uri
+            if nu:
+                try:
+                    self._request("DELETE", self._rewrite(nu, self.uri))
+                except Exception:  # noqa: BLE001 — best-effort cancel
+                    pass
+            raise
+
+    def _drain(self, doc: dict, columns: List[str], rows: List[list],
+               deadline: float, failovers_at_start: int) -> ClientResult:
         while True:
             if "error" in doc:
                 err = doc["error"]
-                raise QueryError(err.get("message", "query failed"),
-                                 err.get("errorName", ""))
+                name = err.get("errorName", "")
+                msg = err.get("message", "query failed")
+                if name == "QUERY_EXCEEDED_RUN_TIME":
+                    msg += (" — the query hit its query_max_run_time_s "
+                            "budget: raise it (SET SESSION "
+                            "query_max_run_time_s = N, or the CLI's "
+                            "--timeout) or narrow the query")
+                elif name in ("QUERY_QUEUE_FULL",
+                              "QUERY_EXCEEDED_QUEUED_TIME"):
+                    msg += (" — the cluster is overloaded and this "
+                            "rejection is retryable: resubmit after a "
+                            "backoff")
+                raise QueryError(msg, name)
             if self.on_progress is not None:
                 try:
                     self.on_progress(doc.get("stats") or {})
@@ -187,6 +218,7 @@ class Client:
                 self._request("DELETE",
                               self._rewrite(seg["uri"], self.uri))
             next_uri = doc.get("nextUri")
+            self._last_next_uri = next_uri
             if next_uri is None:
                 return ClientResult(
                     doc.get("id", ""), columns, rows,

@@ -708,8 +708,17 @@ class _PrefetchPipeline:
 
     def next(self, expected_start: int) -> Batch:
         from ..metrics import SCAN_PREFETCH_STALL_SECONDS
+        import queue as _q
         t0 = time.monotonic()
-        kind, val = self._queue.get()
+        while True:
+            # bounded waits so a stuck prefetch worker (chaos HANG, dead
+            # source) can't pin a canceled query on the exec lock — the
+            # cooperative check raises and close() reaps the thread
+            try:
+                kind, val = self._queue.get(timeout=0.25)
+                break
+            except _q.Empty:
+                self.executor.check_cancel()
         wait = time.monotonic() - t0
         if wait > 1e-4:
             self.executor.stats.scan_prefetch_stalls += 1
@@ -948,6 +957,9 @@ def execute_chunked(executor, root: L.OutputNode) -> Optional[Batch]:
     executor.enter_chunk_mode()
     try:
         for start in starts_list:
+            # chunk-boundary cooperative cancel: a terminate()/deadline
+            # on a long chunked scan frees the exec lock between chunks
+            executor.check_cancel()
             if fact is not None:
                 chunk = _slice_widen(
                     cap, fact_wide, fact_datas, fact_valids, start,

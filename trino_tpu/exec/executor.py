@@ -91,7 +91,23 @@ class ExecStats:
 
 
 class QueryDeadlineError(RuntimeError):
-    """query_max_run_time_s exceeded (QUERY_MAX_RUN_TIME's role)."""
+    """query_max_run_time_s exceeded (QUERY_MAX_RUN_TIME's role).
+
+    Non-retryable user error: retrying cannot beat a wall clock that
+    already ran out, so the dispatcher surfaces it straight to the
+    client (same taxonomy path as QUERY_EXCEEDED_MEMORY)."""
+    error_name = "QUERY_EXCEEDED_RUN_TIME"
+    error_code = 4
+
+
+class QueryTerminatedError(RuntimeError):
+    """terminate() requested cancellation of the running query; the
+    executor raises this at the next cooperative check point (plan-node,
+    chunk, spill-partition, or prefetch boundary) so the exec lock frees
+    within a bounded grace. Carries USER_CANCELED taxonomy — the state
+    machine has usually already recorded the real reason."""
+    error_name = "USER_CANCELED"
+    error_code = 2
 
 
 # serializes ExecStats->metrics snapshot diffs across task threads
@@ -138,6 +154,7 @@ class Executor:
         self.spill_force_disk = False     # tests/chaos: all spills to disk
         self.spiller = None               # lazy HostSpiller
         self._kill_reason: Optional[str] = None   # LowMemoryKiller's flag
+        self._cancel_reason: Optional[str] = None  # terminate() fan-out
         self._no_decisions = 0            # >0: bypass the decision cache
                                           # (partition-wise spill phases)
         # executor-owned caches hold REVOCABLE reservations: under
@@ -276,6 +293,28 @@ class Executor:
         raises MemoryKilledError (surfaced as QUERY_EXCEEDED_MEMORY)."""
         self._kill_reason = reason
 
+    def request_cancel(self, reason: str) -> None:
+        """terminate() fan-out's hook: the next cooperative check point
+        raises QueryTerminatedError so a locally-executing query frees
+        the exec lock within a bounded grace."""
+        self._cancel_reason = reason
+
+    def check_cancel(self) -> None:
+        """Cooperative cancellation/deadline check, called between plan
+        nodes (run()), between driver chunks (exec/chunked.py), between
+        spill partitions (exec/spill.py), and by the prefetch pipeline —
+        the boundaries where a stuck query can actually be stopped."""
+        if self._kill_reason is not None:
+            from .memory import MemoryKilledError
+            raise MemoryKilledError(self._kill_reason)
+        if self._cancel_reason is not None:
+            raise QueryTerminatedError(self._cancel_reason)
+        if self.deadline is not None:
+            import time as _t
+            if _t.monotonic() > self.deadline:
+                raise QueryDeadlineError(
+                    "query exceeded query_max_run_time_s")
+
     class _NoDecisions:
         def __init__(self, ex):
             self.ex = ex
@@ -347,6 +386,7 @@ class Executor:
         from .profiler import RECORDER
         RECORDER.bind_stats(self.stats)
         self._kill_reason = None
+        self._cancel_reason = None
         self.strategy_decisions = {}
         # release reservations surviving from the previous query (the root
         # batch lives until its results are drained)
@@ -380,14 +420,7 @@ class Executor:
         sub = self._subst.get(id(node))
         if sub is not None:
             return sub
-        if self._kill_reason is not None:
-            from .memory import MemoryKilledError
-            raise MemoryKilledError(self._kill_reason)
-        if self.deadline is not None:
-            import time as _t
-            if _t.monotonic() > self.deadline:
-                raise QueryDeadlineError(
-                    "query exceeded query_max_run_time_s")
+        self.check_cancel()
         from .memory import ExceededMemoryLimitError, MemoryKilledError, \
             batch_bytes
         try:

@@ -112,6 +112,10 @@ SESSION_PROPERTY_DEFAULTS = {
     "broadcast_join_threshold_mb": (32, int),
     # wall-clock budget; exceeded -> QueryDeadlineError (QUERY_MAX_RUN_TIME)
     "query_max_run_time_s": (0.0, float),
+    # admission-queue budget (query.max-queued-time's role): a query
+    # still QUEUED past this is rejected with a retryable
+    # QUERY_EXCEEDED_QUEUED_TIME instead of waiting forever (0 = off)
+    "query_max_queued_time_s": (0.0, float),
     # build-side min/max pruning of probe scans (ENABLE_DYNAMIC_FILTERING)
     "dynamic_filtering": (True, _bool),
     # escape hatch for the batched mesh filter collectives; the old
@@ -146,6 +150,10 @@ SESSION_PROPERTY_DEFAULTS = {
     # a survivor; first success wins. multiplier <= 0 disables.
     "hedge_multiplier": (4.0, float),
     "hedge_min_s": (2.0, float),
+    # per-query retry/hedge amplification cap: extra task attempts past
+    # this fail the query (retries) or are declined (hedges) instead of
+    # multiplying load on a struggling cluster
+    "task_amplification_budget": (16, int),
     # control-plane retry backoff (server/retrypolicy.py: exponential +
     # decorrelated jitter) between task-retry rounds
     "retry_backoff_base_s": (0.05, float),

@@ -357,6 +357,8 @@ def spill_join(executor, node: L.JoinNode) -> Optional[Batch]:
     out_arrays: List[list] = []
     out_valids: List[list] = []
     for pf, bf in zip(pkeys_files, bkeys_files):
+        # partition-boundary cooperative cancel (terminate()/deadline)
+        executor.check_cancel()
         pa, pv = spiller.get(pf)
         ba, bv = spiller.get(bf)
         arrs, vals = _host_equi_join(pa, pv, ba, bv, node.left_keys,
@@ -431,6 +433,8 @@ def spill_aggregate(executor, node: L.AggregateNode) -> Optional[Batch]:
     from .memory import batch_bytes
     with executor.no_decisions():
         for f in files:
+            # partition-boundary cooperative cancel
+            executor.check_cancel()
             pa, pv = spiller.get(f)
             part = batch_from_numpy(pa, valids=pv)
             executor.pool.reserve(batch_bytes(part))

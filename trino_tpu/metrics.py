@@ -588,6 +588,47 @@ NODE_BUSY_MS = REGISTRY.counter(
     "recorder records) give the utilization series BENCH_soak emits",
     ("tier",))
 
+# query-lifetime enforcement (deadlines, cancellation propagation,
+# orphan reaping, overload admission control): coordinator-stamped
+# deadlines ride every task dispatch, terminate() fans cancellation out
+# to every assigned worker, workers abandon tasks their coordinator
+# forgot, and overload degrades to fast rejection
+QUERIES_DEADLINE_EXCEEDED = REGISTRY.counter(
+    "trino_tpu_queries_deadline_exceeded_total",
+    "Queries terminated because their coordinator-stamped deadline "
+    "(query_max_run_time_s) expired — surfaced to clients as "
+    "QUERY_EXCEEDED_RUN_TIME")
+QUERIES_REJECTED = REGISTRY.counter(
+    "trino_tpu_queries_rejected_total",
+    "Queries rejected before execution by admission control, by reason: "
+    "queue_full (resource-group queue bound), queued_deadline "
+    "(query_max_queued_time_s expired while QUEUED), load_shed "
+    "(coordinator overload gate)", ("reason",))
+TASKS_ABANDONED = REGISTRY.counter(
+    "trino_tpu_tasks_abandoned_total",
+    "Worker tasks abandoned by the orphan reaper (no coordinator "
+    "status pull or heartbeat ack referenced them within "
+    "task_abandonment_timeout_s) — buffers and pool reservations freed")
+CANCEL_PROPAGATIONS = REGISTRY.counter(
+    "trino_tpu_cancel_propagations_total",
+    "terminate() fan-outs run by the coordinator, by trigger: user "
+    "(client DELETE), deadline, queued_deadline, oom (low-memory "
+    "killer), stuck (diagnoser escalation)", ("reason",))
+RETRY_BUDGET_EXHAUSTED = REGISTRY.counter(
+    "trino_tpu_retry_budget_exhausted_total",
+    "Queries failed because their per-query retry/hedge amplification "
+    "budget ran out — the anti-retry-storm valve under sustained chaos")
+MICROBATCH_FOLLOWER_TIMEOUTS = REGISTRY.counter(
+    "trino_tpu_microbatch_follower_timeouts_total",
+    "Micro-batch followers that stopped waiting on their window leader "
+    "(leader dead/slow, query canceled, or deadline expired) and "
+    "degraded to an individual run")
+BACKPRESSURE_DEADLINE_DEGRADES = REGISTRY.counter(
+    "trino_tpu_backpressure_deadline_degrades_total",
+    "Exchange backpressure waits that hit their (deadline-capped) "
+    "bound and degraded to unbounded buffering — logged with the "
+    "owning query so the silent 300 s degrade is observable")
+
 # the labeled families acceptance scrapes: seed the hot label values so
 # a cold server's /v1/metrics already carries them at 0
 for _op in ("scan", "output"):
@@ -632,3 +673,7 @@ for _m in ("replayed", "reattached", "reexecuted"):
 for _t in ("device", "host"):
     NODE_BUSY_FRACTION.init_labels(tier=_t)
     NODE_BUSY_MS.init_labels(tier=_t)
+for _r in ("queue_full", "queued_deadline", "load_shed"):
+    QUERIES_REJECTED.init_labels(reason=_r)
+for _r in ("user", "deadline", "queued_deadline", "oom", "stuck"):
+    CANCEL_PROPAGATIONS.init_labels(reason=_r)

@@ -110,13 +110,20 @@ def _is_retryable(e: Exception) -> bool:
     """User errors (bad SQL, missing columns) never retry; runtime/injected
     failures do — the reference draws the same line via error categories
     (USER_ERROR vs INTERNAL_ERROR/EXTERNAL). Memory kills are user
-    errors too: retrying an OOM reproduces it."""
+    errors too: retrying an OOM reproduces it. Deadline expiry and
+    termination never retry (a rerun restarts the clock the user
+    bounded), and an exhausted task-amplification budget means retrying
+    is exactly what the budget forbade."""
+    from ..exec.executor import QueryDeadlineError, QueryTerminatedError
     from ..exec.memory import ExceededMemoryLimitError
     from ..planner.analyzer import AnalysisError
     from ..sql.tokenizer import SqlSyntaxError
+    from .scheduler import RetryBudgetExhaustedError
     return not isinstance(e, (AnalysisError, SqlSyntaxError,
                               AssertionError, QueryDeclinedError,
-                              ExceededMemoryLimitError))
+                              ExceededMemoryLimitError,
+                              QueryDeadlineError, QueryTerminatedError,
+                              RetryBudgetExhaustedError))
 
 
 class RegisteredNode:
@@ -173,6 +180,14 @@ class Dispatcher:
         self.retry_policy = retry_policy  # NONE | QUERY
         self.max_retries = max_retries
         self.scheduler = None             # StageScheduler (cluster mode)
+        # ClusterMemoryManager back-ref (set by CoordinatorState): the
+        # load-shed admission gate reads its pressure snapshot
+        self.memory_manager = None
+        # lazy deadline-enforcer sweep: started by the first admission
+        # that carries a run/queued deadline, so deadline-free sessions
+        # never pay for the thread
+        self._enforcer: Optional[threading.Thread] = None
+        self._enforcer_lock = threading.Lock()
         # durable query ledger (server/ledger.py): set by
         # CoordinatorState when a ledger path is configured. None keeps
         # the pre-failover behavior bit-for-bit (no appends, no fsyncs).
@@ -304,14 +319,190 @@ class Dispatcher:
                 self.event_listeners.query_completed(tq)
 
         tq.state_machine.add_listener(on_terminal)
+        # absolute wall deadlines stamped AT ADMISSION: every downstream
+        # hop (scheduler dispatch, worker split loops, exchange drains,
+        # retry backoffs) budgets against these, and the enforcer sweep
+        # is the backstop for work stuck where no cooperative check runs
+        props = getattr(self.session, "properties", {})
+        now = time.time()
+        max_run = float(props.get("query_max_run_time_s", 0) or 0)
+        if max_run > 0 and tq.deadline is None:
+            tq.deadline = now + max_run
+        max_queued = float(props.get("query_max_queued_time_s", 0) or 0)
+        if max_queued > 0 and tq.queued_deadline is None:
+            tq.queued_deadline = now + max_queued
+        if tq.deadline is not None or tq.queued_deadline is not None:
+            self._ensure_enforcer()
+        from ..metrics import QUERIES_REJECTED
         from .resourcegroups import QueryQueueFullError
+        if self._should_shed(tq):
+            QUERIES_REJECTED.inc(reason="load_shed")
+            tq.state_machine.fail(
+                "Query rejected: coordinator overloaded (load shed; "
+                f"tenant {tq.tenant!r} is above its fair share) — "
+                "retry when load drops",
+                error_name=QueryQueueFullError.error_name,
+                error_code=QueryQueueFullError.error_code)
+            return tq
         try:
             self.resource_groups.submit(
                 tq.session_user,
-                lambda: self.pool.submit(self._run_admitted, tq))
+                lambda: self.pool.submit(self._run_admitted, tq),
+                is_dead=tq.state_machine.is_done)
         except QueryQueueFullError as e:
-            tq.state_machine.fail(str(e))
+            QUERIES_REJECTED.inc(reason="queue_full")
+            tq.state_machine.fail(str(e), error_name=e.error_name,
+                                  error_code=e.error_code)
         return tq
+
+    # ---- termination / deadlines / overload ------------------------------
+
+    def terminate(self, query_id: str, reason: str = "user",
+                  message: Optional[str] = None) -> bool:
+        """The single cancellation path: user DELETE, deadline expiry,
+        the low-memory killer and the stuck-diagnoser all converge here.
+        Moves the state machine to the terminal state the reason's
+        taxonomy demands, interrupts a locally-executing attempt at its
+        next cooperative check point, fans best-effort task DELETEs out
+        to every live remote task (hedge twins included), and prunes
+        dead queue entries so a terminated queued query never runs.
+        Returns True when this call performed the termination."""
+        tq = self.tracker.get(query_id)
+        if tq is None:
+            return False
+        sm = tq.state_machine
+        if sm.is_done():
+            return False
+        tq.terminate_reason = reason
+        from ..metrics import (CANCEL_PROPAGATIONS,
+                               QUERIES_DEADLINE_EXCEEDED)
+        if reason == "user":
+            did = sm.cancel()
+        elif reason == "deadline":
+            did = sm.fail(
+                message or "Query exceeded the maximum run time "
+                           "(query_max_run_time_s)",
+                error_name="QUERY_EXCEEDED_RUN_TIME", error_code=4)
+            if did:
+                QUERIES_DEADLINE_EXCEEDED.inc()
+        elif reason == "queued_deadline":
+            from .resourcegroups import QueryQueuedTimeExceededError
+            did = sm.fail(
+                message or "Query exceeded the maximum queued time "
+                           "(query_max_queued_time_s) — retry when "
+                           "load drops",
+                error_name=QueryQueuedTimeExceededError.error_name,
+                error_code=QueryQueuedTimeExceededError.error_code)
+            if did:
+                QUERIES_DEADLINE_EXCEEDED.inc()
+        elif reason == "oom":
+            from ..exec.memory import ExceededMemoryLimitError
+            did = sm.fail(
+                message or "Query killed by the cluster low-memory "
+                           "killer",
+                error_name=ExceededMemoryLimitError.error_name,
+                error_code=ExceededMemoryLimitError.error_code)
+        else:                       # "stuck" and future reasons
+            did = sm.fail(message or f"Query terminated ({reason})")
+        if not did:
+            return False            # lost the race to another terminator
+        CANCEL_PROPAGATIONS.inc(reason=reason)
+        # a locally-executing attempt holds the exec lock: request a
+        # cooperative cancel so the next chunk/partition/prefetch
+        # boundary raises and frees the lock within a bounded grace
+        ex = getattr(self.session, "executor", None)
+        pool = getattr(ex, "pool", None)
+        if ex is not None and pool is not None and \
+                getattr(pool, "_current_tag", "") == query_id:
+            ex.request_cancel(
+                f"query {query_id} terminated ({reason})")
+        # fan out best-effort DELETEs to every live remote task — the
+        # worker side frees buffers, pool reservations and wakes its
+        # backpressure waiters
+        if self.scheduler is not None:
+            try:
+                self.scheduler.cancel_query_tasks(query_id)
+            except Exception:  # noqa: BLE001 — fan-out is best-effort
+                pass
+        try:
+            self.resource_groups.prune_dead()
+        except Exception:  # noqa: BLE001
+            pass
+        return True
+
+    def _should_shed(self, tq: TrackedQuery) -> bool:
+        """Overload admission gate: once cluster-wide queue depth (or
+        reported memory pressure) crosses the shed threshold, new work
+        from tenants already holding the most in-flight device work —
+        the ones with the least remaining fair-share claim — is rejected
+        with a retryable QUERY_QUEUE_FULL instead of queued into a
+        pile-up. Disabled unless TRINO_TPU_LOAD_SHED_QUEUE_DEPTH is
+        set."""
+        import os
+        try:
+            depth_cap = int(os.environ.get(
+                "TRINO_TPU_LOAD_SHED_QUEUE_DEPTH", "0"))
+        except ValueError:
+            depth_cap = 0
+        if depth_cap <= 0:
+            return False
+        overloaded = self.resource_groups.total_queued() >= depth_cap
+        mm = self.memory_manager
+        if not overloaded and mm is not None and \
+                mm.cluster_limit_bytes is not None:
+            reserved = sum(m.get("reserved", 0)
+                           for m in mm.last_snapshot.values())
+            overloaded = reserved >= mm.cluster_limit_bytes
+        if not overloaded:
+            return False
+        fair = getattr(getattr(self, "serving", None), "fair_share",
+                       None)
+        infl = fair.inflight() if fair is not None else {}
+        mine = infl.get(tq.tenant, 0)
+        # the least-loaded tenant keeps admission even under overload —
+        # shedding it would starve exactly the principal fair share
+        # exists to protect
+        return bool(infl) and mine > min(infl.values())
+
+    def _ensure_enforcer(self) -> None:
+        if self._enforcer is not None:
+            return
+        with self._enforcer_lock:
+            if self._enforcer is not None:
+                return
+            t = threading.Thread(target=self._deadline_loop,
+                                 name="deadline-enforcer", daemon=True)
+            self._enforcer = t
+            t.start()
+
+    def _deadline_loop(self) -> None:
+        while True:
+            time.sleep(0.1)
+            try:
+                self.enforce_deadlines()
+            except Exception:  # noqa: BLE001 — the sweep must survive
+                pass
+
+    def enforce_deadlines(self) -> int:
+        """One enforcement sweep over every live query: expire run
+        deadlines (any state) and queued-time deadlines (QUEUED only),
+        then prune the dead queue entries. Returns the number of queries
+        terminated — exposed so tests and ops can tick synchronously."""
+        n = 0
+        now = time.time()
+        for tq in self.tracker.all():
+            sm = tq.state_machine
+            if sm.is_done():
+                continue
+            if tq.deadline is not None and now >= tq.deadline:
+                if self.terminate(tq.query_id, reason="deadline"):
+                    n += 1
+            elif tq.queued_deadline is not None and \
+                    now >= tq.queued_deadline and sm.state == "QUEUED":
+                if self.terminate(tq.query_id,
+                                  reason="queued_deadline"):
+                    n += 1
+        return n
 
     def _run_admitted(self, tq: TrackedQuery) -> None:
         group_path = self.resource_groups.select(tq.session_user).path
@@ -538,6 +729,10 @@ class Dispatcher:
                 tq.fallback_reason = self.scheduler.fallback_reason \
                     if result is None else None
             except TaskFailedError as te:
+                from .scheduler import RetryBudgetExhaustedError
+                if isinstance(te, RetryBudgetExhaustedError):
+                    raise    # the budget forbade more attempts: fail,
+                             # don't silently degrade to local re-run
                 result = None   # degrade to local execution
                 tq.fallback_reason = f"task failure: {te}"
             finally:
@@ -640,6 +835,9 @@ class CoordinatorState:
         # tick() on demand) to enforce a cluster limit
         from .memorymanager import ClusterMemoryManager
         self.memory_manager = ClusterMemoryManager(self)
+        # the dispatcher's load-shed admission gate reads the manager's
+        # last pressure snapshot
+        self.dispatcher.memory_manager = self.memory_manager
         # query history + regression detection (server/history.py): fed
         # from QueryCompletedEvent, flushed-to on tracker eviction, and
         # served as system.runtime.query_history
@@ -674,6 +872,9 @@ class CoordinatorState:
         from .livestats import LiveStatsStore
         self.livestats = LiveStatsStore(tracked_lookup=self.tracker.get)
         self.scheduler.livestats = self.livestats
+        # stuck-query escalation routes through the dispatcher's single
+        # termination path (off unless TRINO_TPU_STUCK_ESCALATE_FOLDS)
+        self.livestats.terminate = self.dispatcher.terminate
         # cluster flight recorder (server/telemetry.py): the local ring
         # plus coordinator-scrape federation of worker rings. The sampler
         # thread only runs when an interval is configured
@@ -1037,6 +1238,12 @@ class _Handler(BaseHTTPRequestHandler):
             payload["error"] = {"message": sm.error,
                                 "errorCode": sm.error_code,
                                 "errorName": sm.error_name}
+            if sm.error_name in ("QUERY_QUEUE_FULL",
+                                 "QUERY_EXCEEDED_QUEUED_TIME"):
+                # overload rejections are safe to retry later/elsewhere
+                # — the statement-level mirror of the 503 contract the
+                # client's failover loop already keys on
+                payload["error"]["retryable"] = True
             return payload
         if sm.state == "CANCELED":
             payload["error"] = {"message": "Query was canceled",
@@ -1357,9 +1564,12 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(204, {})
 
     def _delete_executing(self, parts, user):
+        # route through the dispatcher's single termination path: a bare
+        # state_machine.cancel() here used to leave every in-flight
+        # worker task running to completion (and its buffers pinned)
         tq = self.state.tracker.get(parts[3])
         if tq is not None:
-            tq.state_machine.cancel()
+            self.state.dispatcher.terminate(tq.query_id, reason="user")
         self._send(204, {})
 
 

@@ -65,6 +65,13 @@ CRASH = "CRASH"
 DELAY = "DELAY"
 DROP = "DROP"
 CORRUPT = "CORRUPT"
+# Infinite-delay straggler: the site blocks until the injector's hangs
+# are released (clear() / release_hangs()) or the rule's delay_s safety
+# bound passes. Kept OUT of the from_seed default rotation — adding it
+# would rewrite every historical seeded chaos schedule — so only the
+# deadline/overload soaks (`bench.py --overload`) and targeted tests
+# schedule it explicitly.
+HANG = "HANG"
 
 FAULTS = (RAISE, CRASH, DELAY, DROP, CORRUPT)
 
@@ -108,6 +115,9 @@ class FailureInjector:
         # these with recovery latencies
         self.events: List[tuple] = []
         self._rng = random.Random(seed)
+        # HANG faults block on this event; release_hangs()/clear() set
+        # it so soak teardown can unstick every hung thread at once
+        self._hang_release = threading.Event()
 
     # -- scheduling --------------------------------------------------------
 
@@ -192,6 +202,12 @@ class FailureInjector:
         if rule.fault == DELAY:
             time.sleep(rule.delay_s)
             return
+        if rule.fault == HANG:
+            # infinite-delay straggler: block until released (or the
+            # rule's delay_s safety bound — schedule HANG rules with a
+            # large delay_s; the default 0.05 makes a mere hiccup)
+            self._hang_release.wait(rule.delay_s)
+            return
         if rule.fault == CRASH:
             raise InjectedCrash(
                 f"injected {point} crash ({rule.remaining} left)")
@@ -215,6 +231,11 @@ class FailureInjector:
         buf[bit >> 3] ^= 1 << (bit & 7)
         return bytes(buf)
 
+    def release_hangs(self) -> None:
+        """Unblock every thread currently stuck in a HANG fault."""
+        self._hang_release.set()
+
     def clear(self) -> None:
         with self._lock:
             self._rules.clear()
+        self._hang_release.set()

@@ -97,6 +97,18 @@ class LiveStatsStore:
         # query is diagnosed as stuck
         self.stuck_after = max(1, int(stuck_after))
         self.folds = 0                    # observability counter
+        # stuck-query escalation: after a diagnosed query stays stalled
+        # this many MORE folds, it is terminated through the hook below
+        # (reason="stuck"). 0 disables — diagnosis stays report-only.
+        import os
+        try:
+            self.escalate_after = int(os.environ.get(
+                "TRINO_TPU_STUCK_ESCALATE_FOLDS", "0"))
+        except ValueError:
+            self.escalate_after = 0
+        # terminate(query_id, reason=..., message=...) — CoordinatorState
+        # wires the dispatcher's single termination path here
+        self.terminate = None
 
     # -- registration (scheduler launch sites + failover reattach) --------
 
@@ -173,6 +185,7 @@ class LiveStatsStore:
             return
         now = time.time() if now is None else now
         diagnoses = []
+        escalations = []
         with self._lock:
             self.folds += 1
             util = payload.get("utilization") or {}
@@ -242,10 +255,26 @@ class LiveStatsStore:
                         q["diagnosed"] = True
                         q["diagnosis"] = d
                         diagnoses.append(d)
+                if self.escalate_after > 0 and q["diagnosed"] and \
+                        not q.get("escalated") and q["stale_folds"] >= \
+                        self.stuck_after + self.escalate_after:
+                    q["escalated"] = True
+                    escalations.append((qid, q["stale_folds"]))
         # attach + log OUTSIDE the lock (tracked_lookup takes the
         # tracker's lock; the log handler may block)
         for d in diagnoses:
             self._publish_diagnosis(d)
+        for qid, stale in escalations:
+            if self.terminate is None:
+                continue
+            try:
+                self.terminate(
+                    qid, reason="stuck",
+                    message="Query terminated by the stuck-query "
+                            f"escalator: live stats stalled for {stale} "
+                            "consecutive heartbeats past diagnosis")
+            except Exception:  # noqa: BLE001 — escalation must not
+                pass           # fail the heartbeat fold
 
     def _diagnose_locked(self, qid: str, q: dict,
                          recs: List[dict]) -> Optional[dict]:

@@ -195,9 +195,17 @@ class ClusterMemoryManager:
         ex = getattr(self.state.session, "executor", None)
         if ex is not None and hasattr(ex, "request_kill"):
             ex.request_kill(msg)      # stops the running plan promptly
-        tq.state_machine.fail(
-            msg, error_name=ExceededMemoryLimitError.error_name,
-            error_code=ExceededMemoryLimitError.error_code)
+        # the dispatcher's single termination path: taxonomy on the
+        # state machine, worker task fan-out, cancel-propagation
+        # accounting — an OOM kill of a distributed query must free its
+        # remote buffers, not just the local plan
+        term = getattr(self.state.dispatcher, "terminate", None)
+        if term is not None:
+            term(tq.query_id, reason="oom", message=msg)
+        else:
+            tq.state_machine.fail(
+                msg, error_name=ExceededMemoryLimitError.error_name,
+                error_code=ExceededMemoryLimitError.error_code)
         self.queries_killed += 1
         from ..metrics import QUERIES_KILLED_OOM
         QUERIES_KILLED_OOM.inc()

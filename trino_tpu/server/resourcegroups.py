@@ -32,7 +32,21 @@ from typing import Callable, Dict, List, Optional
 
 
 class QueryQueueFullError(RuntimeError):
-    pass
+    """Admission rejected past the queue bound. Retryable by design:
+    the query never started, so a client backing off and resubmitting
+    is always safe — overload degrades to fast rejection, not collapse."""
+    error_name = "QUERY_QUEUE_FULL"
+    error_code = 5
+    retryable = True
+
+
+class QueryQueuedTimeExceededError(RuntimeError):
+    """query_max_queued_time_s expired while the query was still
+    QUEUED. Retryable like the queue-full rejection — nothing executed,
+    the cluster was simply too busy to start it in time."""
+    error_name = "QUERY_EXCEEDED_QUEUED_TIME"
+    error_code = 6
+    retryable = True
 
 
 @dataclass
@@ -171,16 +185,20 @@ class ResourceGroupManager:
         return "default" if group is self.root \
             else group.config.name
 
-    def submit(self, user: str, run: Callable[[], None]) -> str:
+    def submit(self, user: str, run: Callable[[], None],
+               is_dead: Optional[Callable[[], bool]] = None) -> str:
         """Admit or queue `run`; returns the chosen group path. Raises
-        QueryQueueFullError past the queue bound."""
+        QueryQueueFullError past the queue bound. `is_dead` (optional)
+        lets admission skip entries that died while QUEUED (queued-time
+        deadline, user cancel) instead of running a terminal query."""
         with self._lock:
             group = self.select(user)
+            self._prune_dead_locked(group)
             if group.can_run():
                 group.acquire()
                 to_run = run
             elif len(group.queue) < group.config.max_queued:
-                group.queue.append((run, time.monotonic()))
+                group.queue.append((run, time.monotonic(), is_dead))
                 group.stats_peak_queued = max(group.stats_peak_queued,
                                               len(group.queue))
                 return group.path
@@ -190,18 +208,41 @@ class ResourceGroupManager:
         to_run()
         return group.path
 
+    @staticmethod
+    def _prune_dead_locked(group: ResourceGroup) -> None:
+        """Drop queue entries whose query reached a terminal state while
+        waiting — their slot frees immediately, so a wave of expired/
+        canceled queued queries cannot wedge admission."""
+        if any(dead is not None and dead() for _, _, dead in group.queue):
+            group.queue = deque(e for e in group.queue
+                                if e[2] is None or not e[2]())
+
     def _pop_runnable_locked(self, group: ResourceGroup) \
             -> Optional[Callable[[], None]]:
         """Admit the group's next queued query if it can run now,
         recording its queue wait (the accounting `finished()` used to
         skip entirely)."""
+        self._prune_dead_locked(group)
         if group.queue and group.can_run():
-            run, t0 = group.queue.popleft()
+            run, t0, _dead = group.queue.popleft()
             group.acquire()
             group.stats_total_queue_wait_s += time.monotonic() - t0
             group.stats_dequeued += 1
             return run
         return None
+
+    def prune_dead(self) -> None:
+        """Sweep every group's queue for dead entries (the coordinator's
+        deadline enforcer calls this after failing queued queries)."""
+        with self._lock:
+            for g in self._groups():
+                self._prune_dead_locked(g)
+
+    def total_queued(self) -> int:
+        """Cluster-wide queued-query count — the load-shed gate's queue-
+        depth signal."""
+        with self._lock:
+            return sum(len(g.queue) for g in self._groups())
 
     def finished(self, group_path: str) -> Optional[Callable[[], None]]:
         """Release a slot; returns the next queued query to start (the

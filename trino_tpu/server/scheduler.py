@@ -49,6 +49,13 @@ class TaskFailedError(RuntimeError):
     pass
 
 
+class RetryBudgetExhaustedError(TaskFailedError):
+    """The query burned through its per-query retry/hedge amplification
+    budget. NOT retryable at the query level: a query whose task
+    attempts keep multiplying is amplifying load on a struggling
+    cluster, and another full-query attempt would amplify further."""
+
+
 class PageIntegrityError(TaskFailedError):
     """A drained page failed its CRC32C check: corruption detected on the
     wire/buffer and converted into a retryable task failure (the split
@@ -149,7 +156,8 @@ class RemoteTask:
                  splits: List[Split], http_timeout_s: float = 30.0,
                  partition: Optional[dict] = None,
                  sources: Optional[dict] = None, injector=None,
-                 traceparent: Optional[str] = None):
+                 traceparent: Optional[str] = None,
+                 deadline: Optional[float] = None):
         self.node = node
         self.task_id = task_id
         self.fragment_blob = fragment_blob
@@ -159,6 +167,9 @@ class RemoteTask:
         self.sources = sources
         self.injector = injector          # chaos hook (EXCHANGE_DRAIN)
         self.traceparent = traceparent    # W3C context for every hop
+        # absolute query deadline (coordinator wall clock, None = no
+        # cap); start() ships it normalized to the worker's clock
+        self.deadline = deadline
         self.pages: List[dict] = []
         self.bytes_drained = 0            # frame bytes pulled (shuffle)
         self.done = False
@@ -194,6 +205,12 @@ class RemoteTask:
             payload["partition"] = self.partition
         if self.sources is not None:
             payload["sources"] = self.sources
+        if self.deadline is not None:
+            # ship the remaining budget on the WORKER's wall clock: the
+            # announce-estimated offset rebases the coordinator-absolute
+            # deadline so a skewed worker enforces the same instant
+            payload["deadline"] = self.deadline + \
+                getattr(self.node, "clock_offset", 0.0)
         body = json.dumps(payload).encode()
         self._request(self._url(), data=body, method="POST")
 
@@ -341,6 +358,17 @@ class StageScheduler:
         # register so mid-flight rollups know stage/node/split counts
         # before the first heartbeat arrives. None under session-local use.
         self.livestats = None
+        # cancellation fan-out (round-22): every in-flight RemoteTask of
+        # the current query — hedge twins included — so terminate() can
+        # DELETE them all on every assigned worker. Cleared per query.
+        self._live_tasks: Dict[str, List[RemoteTask]] = {}
+        self._live_tasks_lock = threading.Lock()
+        # per-query retry/hedge amplification budget: extra attempts
+        # (retry rounds + hedges) past this fail the query instead of
+        # multiplying load on a struggling cluster
+        self.max_task_amplification = int(
+            props.get("task_amplification_budget", 16))
+        self._amplification = 0
 
     # -- durable query ledger hooks ---------------------------------------
 
@@ -360,6 +388,7 @@ class StageScheduler:
         """Pre-register a launched task with the live-stats store so the
         per-stage rollup carries stage/node/split-count attribution from
         launch, not from the first heartbeat. No-op without a store."""
+        self._track_live(task)
         ls = self.livestats
         qid = (self.last_query or {}).get("query_id")
         if ls is None or not qid:
@@ -367,6 +396,68 @@ class StageScheduler:
         ls.register_task(qid, task.task_id, stage=self._current_stage,
                          node=task.node.node_id,
                          splits_total=len(task.splits))
+
+    # -- cancellation fan-out + amplification budget (round-22) ------------
+
+    def _track_live(self, task: "RemoteTask") -> None:
+        """Register a launched task in the per-query live registry —
+        the terminate() fan-out's worker-task DELETE target list."""
+        qid = (self.last_query or {}).get("query_id")
+        if not qid:
+            return
+        with self._live_tasks_lock:
+            self._live_tasks.setdefault(qid, []).append(task)
+
+    def cancel_query_tasks(self, query_id: str) -> List[str]:
+        """Best-effort DELETE of every in-flight worker task launched
+        for `query_id` — hedge twins included. Returns the task ids the
+        fan-out covered (the DELETEs themselves never raise)."""
+        with self._live_tasks_lock:
+            tasks = list(self._live_tasks.get(query_id, ()))
+        for t in tasks:
+            t.cancel()
+        return [t.task_id for t in tasks]
+
+    def _amplify(self, n: int = 1, required: bool = True) -> bool:
+        """Charge `n` extra task attempts (a retry round, a hedge)
+        against the query's amplification budget. Past the cap:
+        required attempts (retries) raise RetryBudgetExhaustedError —
+        non-retryable, the query fails rather than multiplying load —
+        while optional ones (hedges) are simply declined."""
+        if self._amplification + n > self.max_task_amplification:
+            from ..metrics import RETRY_BUDGET_EXHAUSTED
+            RETRY_BUDGET_EXHAUSTED.inc()
+            if required:
+                raise RetryBudgetExhaustedError(
+                    f"query exceeded its retry/hedge amplification "
+                    f"budget ({self.max_task_amplification} extra "
+                    f"attempts)")
+            return False
+        self._amplification += n
+        return True
+
+    def _query_deadline(self) -> Optional[float]:
+        """The current query's absolute run deadline (coordinator wall
+        clock), or None. Caps every stage/drain/wait deadline so no
+        scheduler wait outlives the query, and rides every task POST."""
+        lookup = self.tracked_lookup
+        qid = (self.last_query or {}).get("query_id")
+        if lookup is None or not qid:
+            return None
+        tq = lookup(qid)
+        return getattr(tq, "deadline", None) if tq is not None else None
+
+    def _query_dead(self) -> bool:
+        """True once the current query's state machine went terminal
+        (terminate() fan-out, deadline expiry) — drain loops poll this
+        so a canceled query's dispatch stops instead of retrying work
+        nobody will read."""
+        lookup = self.tracked_lookup
+        qid = (self.last_query or {}).get("query_id")
+        if lookup is None or not qid:
+            return False
+        tq = lookup(qid)
+        return tq is not None and tq.state_machine.is_done()
 
     def _ledger_spool(self, key: str) -> None:
         """Record a result-spool pointer: after a failover, spooled
@@ -391,6 +482,12 @@ class StageScheduler:
                            "tasks": [], "operators": {},
                            "bytes_shuffled": 0}
         self._current_stage = "source"
+        self._amplification = 0
+        if query_id:
+            # fresh attempt: drop the previous attempt's task registry
+            # (those tasks are already terminal or canceled)
+            with self._live_tasks_lock:
+                self._live_tasks.pop(query_id, None)
         if self.livestats is not None and query_id:
             self.livestats.begin(query_id)
 
@@ -403,6 +500,9 @@ class StageScheduler:
         if lq is None or lq.get("_final"):
             return
         lq["_final"] = True
+        if lq.get("query_id"):
+            with self._live_tasks_lock:
+                self._live_tasks.pop(lq["query_id"], None)
         if self.livestats is not None and lq.get("query_id"):
             self.livestats.finish(lq["query_id"])
         snap = getattr(self, "_stats_snap", {})
@@ -743,6 +843,9 @@ class StageScheduler:
             # single write partition avoids empty-part churn
             P = 1
         t_deadline = time.time() + self.task_timeout_s
+        qd = self._query_deadline()
+        if qd is not None:
+            t_deadline = min(t_deadline, qd)
         traceparent = self._tracer().traceparent()
         splits = self._make_splits(analysis)
         blob = encode_fragment({"root": src_root,
@@ -766,7 +869,8 @@ class StageScheduler:
                     task = RemoteTask(w, tid, blob, sp,
                                       partition={"keys": keys, "count": P},
                                       injector=self.failure_injector,
-                                      traceparent=traceparent)
+                                      traceparent=traceparent,
+                                      deadline=qd)
                     task.start()
                     self._ledger_assign(task)
                     self._livestats_register(task)
@@ -794,7 +898,8 @@ class StageScheduler:
                                       "buffer": p} for t in src_tasks]}
                     task = RemoteTask(w, tid, wblob, [], sources=sources,
                                       injector=self.failure_injector,
-                                      traceparent=traceparent)
+                                      traceparent=traceparent,
+                                      deadline=qd)
                     task.start()
                     self._ledger_assign(task)
                     self._livestats_register(task)
@@ -845,6 +950,7 @@ class StageScheduler:
                             if state in ("FAILED", "CANCELED"):
                                 live[p].remove(t)
                                 failed_nodes.append(t.node.node_id)
+                                self._amplify(1)
                                 self.stats["task_retries"] += 1
                                 SCHED_TASK_RETRIES.inc()
                             else:
@@ -1180,6 +1286,10 @@ class StageScheduler:
         retries = 0
         migration_rounds = 0
         while pending:
+            if self._query_dead():
+                from ..exec.executor import QueryTerminatedError
+                raise QueryTerminatedError(
+                    "query terminated during stage drain")
             units: List[_HedgedUnit] = []
             for nid, sp in list(pending.items()):
                 # durable-exchange hit: a prior attempt already produced
@@ -1212,6 +1322,7 @@ class StageScheduler:
             else:
                 # task retry: reassign failed nodes' splits to survivors
                 # (EventDrivenFaultTolerantQueryScheduler's per-task retry)
+                self._amplify(1)
                 retries += 1
                 self.stats["task_retries"] += 1
                 SCHED_TASK_RETRIES.inc()
@@ -1254,6 +1365,9 @@ class StageScheduler:
         if not units:
             return [], set(), 0
         deadline = time.time() + self.task_timeout_s
+        qd = self._query_deadline()
+        if qd is not None:
+            deadline = min(deadline, qd)
         lock = threading.Lock()
         durations: List[float] = []
         # capture the trace context ON THIS THREAD (the source-stage span
@@ -1267,7 +1381,8 @@ class StageScheduler:
                 tid = f"t{self._seq}"
             task = RemoteTask(node, tid, blob, unit.splits,
                               injector=self.failure_injector,
-                              traceparent=traceparent)
+                              traceparent=traceparent,
+                              deadline=qd)
             with lock:
                 unit.tasks.append(task)
             losers: List[RemoteTask] = []
@@ -1326,6 +1441,8 @@ class StageScheduler:
             launch(u, by_id[u.first_node])
 
         while time.time() < deadline + 5.0:
+            if self._query_dead():
+                break    # terminate() fan-out already DELETEd the tasks
             with lock:
                 unresolved = [u for u in units
                               if u.pages is None and u.live > 0]
@@ -1374,6 +1491,10 @@ class StageScheduler:
                         if candidate is None:
                             continue
                         u.hedged = True
+                    if not self._amplify(required=False):
+                        # amplification budget spent: no more hedges
+                        # this query (the original attempt still runs)
+                        continue
                     self.stats["hedged_tasks"] += 1
                     SCHED_HEDGES.inc()
                     launch(u, candidate)
@@ -1521,6 +1642,9 @@ class StageScheduler:
         join, merge_agg, probe_driver, build_driver = desc
         P = len(workers)
         t_deadline = time.time() + self.task_timeout_s
+        qd = self._query_deadline()
+        if qd is not None:
+            t_deadline = min(t_deadline, qd)
         traceparent = self._tracer().traceparent()
 
         def stage_tasks(side_root, driver, keys):
@@ -1544,7 +1668,8 @@ class StageScheduler:
                                   partition={"keys": list(keys),
                                              "count": P},
                                   injector=self.failure_injector,
-                                  traceparent=traceparent)
+                                  traceparent=traceparent,
+                                  deadline=qd)
                 task.start()
                 self._ledger_assign(task)
                 self._livestats_register(task)
@@ -1576,7 +1701,8 @@ class StageScheduler:
             task = RemoteTask(workers[p % len(workers)], tid, blob_c, [],
                               sources=sources,
                               injector=self.failure_injector,
-                              traceparent=traceparent)
+                              traceparent=traceparent,
+                              deadline=qd)
             task.start()
             self._ledger_assign(task)
             self._livestats_register(task)

@@ -357,8 +357,32 @@ class MicroBatcher:
                 self._windows[shape_key] = w
                 follower = False
         if follower:
-            if not ev.wait(timeout=60.0):
-                raise RuntimeError("micro-batch leader never flushed")
+            # deadline/cancel-aware wait: capped by the query's wall
+            # deadline, polled so a terminate() lands between slices,
+            # and degrading to an individual run on a wedged leader
+            # instead of failing the query outright
+            sm = getattr(tq, "state_machine", None)
+            qdl = getattr(tq, "deadline", None)
+            bound = time.time() + 60.0
+            if qdl is not None:
+                bound = min(bound, qdl)
+            flushed = ev.wait(timeout=0.05)
+            while not flushed and time.time() < bound:
+                if sm is not None and sm.is_done():
+                    from ..exec.executor import QueryTerminatedError
+                    raise QueryTerminatedError(
+                        "query terminated while waiting on a "
+                        "micro-batch window")
+                flushed = ev.wait(timeout=0.05)
+            if not flushed:
+                from ..metrics import MICROBATCH_FOLLOWER_TIMEOUTS
+                MICROBATCH_FOLLOWER_TIMEOUTS.inc()
+                if qdl is not None and time.time() >= qdl:
+                    from ..exec.executor import QueryDeadlineError
+                    raise QueryDeadlineError(
+                        "query deadline expired waiting on a "
+                        "micro-batch window (query_max_run_time_s)")
+                return self.serving.route_and_run(entry, tq)
             if box[1] is not None:
                 raise box[1]
             if tq is not None:

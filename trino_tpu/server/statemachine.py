@@ -217,6 +217,18 @@ class TrackedQuery:
     progress_ratio: float = 0.0
     dominant_stage: str = ""
     live_diagnosis: Optional[dict] = None
+    # query-lifetime enforcement: absolute wall-clock deadlines stamped
+    # at admission (coordinator time.time()). `deadline` bounds total
+    # run time (query_max_run_time_s), `queued_deadline` bounds how long
+    # the query may sit QUEUED (query_max_queued_time_s); None = no cap.
+    # The remaining budget rides every task dispatch, clock-skew
+    # normalized per node, and the deadline enforcer terminates the
+    # query cluster-wide once either expires.
+    deadline: Optional[float] = None
+    queued_deadline: Optional[float] = None
+    # why terminate() fired ("user" | "deadline" | "queued_deadline" |
+    # "oom" | "stuck"); None when the query ended on its own
+    terminate_reason: Optional[str] = None
 
     @property
     def state(self) -> str:

@@ -20,6 +20,7 @@ PartitionedOutputBuffer.java:42) reduced to its sequential-consumer core.
 from __future__ import annotations
 
 import base64
+import logging
 import threading
 import time
 import traceback
@@ -30,6 +31,8 @@ import numpy as np
 
 from ..metrics import TASK_OUTPUT_BYTES, TASK_OUTPUT_ROWS
 from ..utils.tracing import NOOP, Tracer
+
+log = logging.getLogger("trino_tpu.tasks")
 
 
 # --------------------------------------------------------------------------
@@ -187,7 +190,8 @@ def partition_assignment(arrays, valids, key_idxs, count: int):
 # task state + manager
 # --------------------------------------------------------------------------
 
-TASK_STATES = ("PENDING", "RUNNING", "FINISHED", "FAILED", "CANCELED")
+TASK_STATES = ("PENDING", "RUNNING", "FINISHED", "FAILED", "CANCELED",
+               "ABANDONED")
 
 
 @dataclass
@@ -237,8 +241,17 @@ class WorkerTask:
     device_ms: float = 0.0
     host_ms: float = 0.0
     compile_ms: float = 0.0
+    # query-lifetime enforcement (round-22): worker-monotonic execution
+    # cutoff derived from the coordinator's clock-skew-normalized wall
+    # deadline shipped with the task POST (None = no cap), and the
+    # orphan reaper's liveness stamp — the last monotonic time a
+    # coordinator request (status/results/delete/update) referenced
+    # this task
+    deadline: Optional[float] = None
+    last_referenced: float = 0.0
 
     def __post_init__(self):
+        self.last_referenced = time.monotonic()
         # producer/consumer rendezvous sharing the task lock: _emit
         # waits on it when the buffer is full, the results route
         # notifies as acks drain pages
@@ -278,8 +291,19 @@ class TaskManager:
         self.max_buffer_bytes = int(os.environ.get(
             "TRINO_TPU_TASK_BUFFER_BYTES", 64 << 20))
         # hard cap on one producer pause so a dead consumer degrades to
-        # an unbounded buffer (memory risk) rather than a hung task
+        # an unbounded buffer (memory risk) rather than a hung task;
+        # per-task deadlines cap it further, and the degrade is counted
+        # + logged (round-22) so it is never silent
         self.backpressure_timeout_s = 300.0
+        # orphan reaping (round-22): tasks no coordinator request has
+        # referenced for this long are abandoned — buffers freed, state
+        # ABANDONED — so a dead coordinator cannot leak worker memory
+        self.task_abandonment_timeout_s = float(os.environ.get(
+            "TRINO_TPU_TASK_ABANDONMENT_S", 600.0))
+        # the task currently holding the exec lock (cancel propagation
+        # target: a DELETE for it interrupts the running split
+        # cooperatively via the executor's check_cancel points)
+        self._current_task_id: Optional[str] = None
         # one Executor per worker: kernels are jitted process-wide anyway;
         # the lock serializes device use within this worker
         from ..exec.executor import Executor
@@ -303,7 +327,8 @@ class TaskManager:
     def create_or_update(self, task_id: str, fragment_blob: str,
                          splits: List[Split], partition: dict = None,
                          sources: dict = None,
-                         traceparent: str = None) -> WorkerTask:
+                         traceparent: str = None,
+                         deadline: float = None) -> WorkerTask:
         if self.injector is not None:
             # chaos: fail/delay/drop task intake (the worker dies or
             # hangs between accept and ack — TaskResource's createOrUpdate
@@ -315,24 +340,83 @@ class TaskManager:
                 task = WorkerTask(task_id, fragment_blob, splits,
                                   partition=partition, sources=sources,
                                   traceparent=traceparent)
+                if deadline is not None:
+                    # `deadline` is wall time on THIS worker's clock (the
+                    # coordinator normalized its absolute deadline by the
+                    # node's announce-measured clock offset); convert to
+                    # a monotonic cutoff so wall jumps can't extend it
+                    task.deadline = time.monotonic() + max(
+                        0.0, deadline - time.time())
                 self.tasks[task_id] = task
                 t = threading.Thread(target=self._run, args=(task,),
                                      name=f"task-{task_id}", daemon=True)
                 t.start()
+            else:
+                task.last_referenced = time.monotonic()
             return task
 
     def get(self, task_id: str) -> Optional[WorkerTask]:
         return self.tasks.get(task_id)
 
+    def touch(self, task_id: str) -> None:
+        """Stamp a coordinator reference (status/results/delete pull) —
+        the orphan reaper's liveness signal."""
+        task = self.tasks.get(task_id)
+        if task is not None:
+            task.last_referenced = time.monotonic()
+
     def cancel(self, task_id: str) -> None:
         task = self.tasks.get(task_id)
         if task is not None:
             with task.cond:
+                task.last_referenced = time.monotonic()
                 if task.state in ("PENDING", "RUNNING"):
                     task.state = "CANCELED"
                 # wake a producer paused on a full output buffer
                 task.cond.notify_all()
+            # cooperative interrupt: if this task holds the exec lock,
+            # the running split bails at the executor's next
+            # check_cancel point (chunk/partition/prefetch boundary)
+            # instead of running the split to completion
+            if self._current_task_id == task_id:
+                self._executor.request_cancel(
+                    f"task {task_id} canceled")
             self._note_live_change(task)
+
+    def reap_orphans(self, timeout_s: Optional[float] = None) -> List[str]:
+        """Abandon tasks no coordinator request has referenced for
+        `timeout_s`: free their staged output buffers and mark them
+        ABANDONED so running split loops bail at the next boundary.
+        Returns the reaped task ids. The worker's announce loop drives
+        this — and fences it off entirely around coordinator failover
+        (worker.py) so a promoted standby reattaching to live tasks is
+        never raced by the reaper."""
+        if timeout_s is None:
+            timeout_s = self.task_abandonment_timeout_s
+        now = time.monotonic()
+        reaped: List[str] = []
+        with self._lock:
+            tasks = list(self.tasks.values())
+        for t in tasks:
+            with t.cond:
+                if t.state not in ("PENDING", "RUNNING", "FINISHED"):
+                    continue
+                if now - t.last_referenced < timeout_s:
+                    continue
+                t.state = "ABANDONED"
+                t.buffers.clear()
+                t.buffered_bytes = 0
+                t.cond.notify_all()
+            if self._current_task_id == t.task_id:
+                self._executor.request_cancel(
+                    f"task {t.task_id} abandoned (orphaned)")
+            reaped.append(t.task_id)
+            from ..metrics import TASKS_ABANDONED
+            TASKS_ABANDONED.inc()
+            log.warning("reaped orphaned task %s (unreferenced %.1fs)",
+                        t.task_id, now - t.last_referenced)
+            self._note_live_change(t)
+        return reaped
 
     def inflight(self) -> List[str]:
         """Ids of tasks still PENDING/RUNNING (drain bookkeeping)."""
@@ -455,6 +539,10 @@ class TaskManager:
         guarantee), as does a task leaving RUNNING."""
         import time as _time
         deadline = _time.monotonic() + self.backpressure_timeout_s
+        if task.deadline is not None:
+            # the query's deadline caps the pause: a query about to
+            # expire must not sit 300s behind a dead consumer first
+            deadline = min(deadline, task.deadline)
         with task.cond:
             waited = False
             while task.buffered_bytes + len(page) > self.max_buffer_bytes \
@@ -467,6 +555,18 @@ class TaskManager:
                     from ..metrics import BACKPRESSURE_WAITS
                     BACKPRESSURE_WAITS.inc()
                 task.cond.wait(0.05)
+            if waited and task.state == "RUNNING" \
+                    and task.buffered_bytes + len(page) > \
+                    self.max_buffer_bytes \
+                    and _time.monotonic() >= deadline:
+                # the degrade-to-unbounded escape hatch fired: count it
+                # and name the task so the memory risk is attributable
+                from ..metrics import BACKPRESSURE_DEADLINE_DEGRADES
+                BACKPRESSURE_DEADLINE_DEGRADES.inc()
+                log.warning(
+                    "task %s: backpressure wait expired; staging page "
+                    "past the %d-byte buffer bound (consumer stalled)",
+                    task.task_id, self.max_buffer_bytes)
             task.buffers.setdefault(buffer, []).append(page)
             task.buffered_bytes += len(page)
             task.rows_out += rows
@@ -613,6 +713,13 @@ class TaskManager:
                 ex = self._executor
                 ex._subst.clear()
                 ex._subst_opaque.clear()
+                # per-task lifetime enforcement: the executor's
+                # check_cancel points (chunk/partition/prefetch
+                # boundaries) observe this task's deadline and any
+                # cancel posted while it runs
+                ex._cancel_reason = None
+                ex.deadline = task.deadline
+                self._current_task_id = task.task_id
                 saved_profile = ex.profile
                 saved_node_stats = ex.node_stats
                 if profiling:
@@ -629,8 +736,14 @@ class TaskManager:
                         self._fold_node_stats(ex, names, op_agg)
                     live_prev = self._live_totals(op_agg)
                     for si, split in enumerate(task.splits):
-                        if task.state == "CANCELED":
+                        if task.state in ("CANCELED", "ABANDONED"):
                             return
+                        if task.deadline is not None and \
+                                time.monotonic() > task.deadline:
+                            from ..exec.executor import QueryDeadlineError
+                            raise QueryDeadlineError(
+                                "task deadline exceeded "
+                                "(query_max_run_time_s)")
                         if self.injector is not None:
                             # chaos mid-split: CRASH kills the executor
                             # with work half-done (partial pages already
@@ -696,6 +809,9 @@ class TaskManager:
                 finally:
                     ex.profile = saved_profile
                     ex.node_stats = saved_node_stats
+                    ex.deadline = None
+                    ex._cancel_reason = None
+                    self._current_task_id = None
                     ex._subst.clear()
                     ex._subst_opaque.clear()
                     for b in ex._node_bytes.values():
@@ -720,7 +836,7 @@ class TaskManager:
         except Exception as e:        # noqa: BLE001 — task failure boundary
             task.error = f"{type(e).__name__}: {e}\n" + traceback.format_exc()
             with task.lock:
-                if task.state != "CANCELED":
+                if task.state not in ("CANCELED", "ABANDONED"):
                     task.state = "FAILED"
         finally:
             # failure/cancel paths (and early returns) still record what
@@ -759,7 +875,7 @@ class TaskManager:
         pages: List[bytes] = []
         token = 0
         while _time.time() < deadline:
-            if task.state == "CANCELED":
+            if task.state in ("CANCELED", "ABANDONED"):
                 raise RuntimeError("task canceled during exchange pull")
             req = Request(
                 f"{uri}/v1/task/{task_id}/results/{buffer}/{token}"
@@ -807,6 +923,11 @@ class TaskManager:
             # to an attempt file instead of emitting exchange pages
             writer, root = root, root.child
         deadline = _time.time() + float(fragment.get("timeout_s", 300.0))
+        if task.deadline is not None:
+            # the query deadline caps exchange pulls too: a consumer must
+            # not out-wait the query it feeds
+            deadline = min(deadline, _time.time() + max(
+                0.0, task.deadline - time.monotonic()))
 
         from ..planner.fragmenter import _subtree_nodes
         by_fid = {}
@@ -837,6 +958,9 @@ class TaskManager:
             ex = self._executor
             ex._subst.clear()
             ex._subst_opaque.clear()
+            ex._cancel_reason = None
+            ex.deadline = task.deadline
+            self._current_task_id = task.task_id
             saved_merge = ex.enable_merge_join
             saved_profile = ex.profile
             saved_node_stats = ex.node_stats
@@ -863,6 +987,9 @@ class TaskManager:
                 ex.enable_merge_join = saved_merge
                 ex.profile = saved_profile
                 ex.node_stats = saved_node_stats
+                ex.deadline = None
+                ex._cancel_reason = None
+                self._current_task_id = None
                 ex._subst.clear()
                 ex._subst_opaque.clear()
                 for b in ex._node_bytes.values():
@@ -910,7 +1037,8 @@ class TaskManager:
 
     def status_json(self, task: WorkerTask) -> dict:
         with task.lock:      # buffers/acked mutate on the task thread
-            done = task.state in ("FINISHED", "FAILED", "CANCELED")
+            done = task.state in ("FINISHED", "FAILED", "CANCELED",
+                                  "ABANDONED")
             stats = dict(task.stats) if task.stats else {
                 "rowsOut": task.rows_out, "bytesOut": task.bytes_out,
                 "splitsDone": task.splits_done}

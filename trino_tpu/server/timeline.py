@@ -245,7 +245,16 @@ def build_timeline(tq) -> dict:
     ended = sm.ended_at if sm.ended_at is not None else time.time()
     wall = max(0.0, ended - created)
     state_times = getattr(sm, "state_times", {}) or {}
-    queued = max(0.0, state_times.get("PLANNING", created) - created)
+    if "PLANNING" in state_times:
+        queued = max(0.0, state_times["PLANNING"] - created)
+    elif sm.is_done():
+        # the query died while QUEUED (queued-time deadline, queue-full
+        # rejection, cancel-before-dispatch): every second of its wall
+        # was queue wait — charging zero here would silently launder
+        # admission holds into `other`
+        queued = wall
+    else:
+        queued = 0.0
     spans = tq.trace
     if spans is None and getattr(tq, "tracer", None) is not None:
         spans = tq.tracer.export()

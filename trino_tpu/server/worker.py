@@ -173,6 +173,10 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         task = self.worker.task_manager.get(task_id)
         if task is None:
             self._send(404, {"error": f"unknown task {task_id}"})
+        else:
+            # every coordinator pull is a liveness signal for the
+            # orphan reaper: a referenced task is never abandoned
+            self.worker.task_manager.touch(task_id)
         return task
 
     # GET /v1/task/{id} — TaskStatus long-poll target
@@ -295,7 +299,8 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 parts[2], body["fragment"], splits,
                 partition=body.get("partition"),
                 sources=body.get("sources"),
-                traceparent=self.headers.get("traceparent"))
+                traceparent=self.headers.get("traceparent"),
+                deadline=body.get("deadline"))
         except InjectedFailure as e:
             # chaos at task intake (crash/drop/raise all surface to
             # the coordinator as a failed POST -> split reassignment)
@@ -410,6 +415,16 @@ class WorkerServer:
         self.heartbeat_interval_s = heartbeat_interval_s
         self._live_cursor = 0             # last DELIVERED change seq
         self._busy_prev = None            # (monotonic, busy_ms) sample
+        # orphan-reaper failover fence (round-22): the announce loop
+        # only reaps after a successful announce to a PRIMARY
+        # coordinator, and not until this monotonic stamp passes. A
+        # failed announce round, a coordinator rotation, or an answer
+        # from a still-RECONCILING promotee all push the fence forward —
+        # a promoted standby reattaching to this worker's live tasks
+        # must never find them reaped out from under it.
+        self.reap_fence_s = 30.0
+        self._reap_fence_until = 0.0
+        self._last_announce_role = "PRIMARY"
 
     def start(self) -> "WorkerServer":
         t1 = threading.Thread(target=self.httpd.serve_forever,
@@ -466,6 +481,7 @@ class WorkerServer:
                 except ValueError:
                     resp = {}
             self._adopt_coordinators(resp.get("coordinators"))
+            self._last_announce_role = resp.get("role", "PRIMARY")
 
         RetryPolicy(base_delay_s=0.1, max_delay_s=1.0,
                     max_attempts=max(1, attempts),
@@ -601,9 +617,23 @@ class WorkerServer:
         while not self._stop.is_set():
             try:
                 self.announce_once()
+                now = time.monotonic()
+                if self._last_announce_role != "PRIMARY":
+                    # mid-failover: the promotee is still reconciling
+                    # our inventory against its replayed ledger
+                    self._reap_fence_until = now + self.reap_fence_s
+                elif now >= self._reap_fence_until:
+                    try:
+                        self.task_manager.reap_orphans()
+                    except Exception:  # noqa: BLE001 — reap best-effort
+                        pass
             except Exception:
                 # coordinator down: rotate to the next address in the
-                # failover list for the following round and keep trying
+                # failover list for the following round and keep trying;
+                # fence the reaper — the silence may be a failover, and
+                # the promotee must find our tasks intact
+                self._reap_fence_until = \
+                    time.monotonic() + self.reap_fence_s
                 self._rotate_coordinator()
             interval = self.announce_interval_s
             if self.heartbeat_interval_s is not None:
